@@ -1,0 +1,38 @@
+"""Communication core: topology bootstrap + collectives.
+
+TPU-native replacement for the reference's single native component, the Lua/C
+MPI binding (SURVEY.md §2 comp. 1 and the native-component ledger): process
+bootstrap maps to ``jax.distributed``; rank/size map to TPU-slice discovery;
+collectives lower to XLA collectives (``lax.psum`` etc.) over ICI/DCN. The
+tagged point-to-point surface (Send/Recv/ANY_SOURCE) lives in
+``mpit_tpu.transport`` because it has no XLA analogue.
+"""
+
+from mpit_tpu.comm.topology import (  # noqa: F401
+    Topology,
+    init,
+    finalize,
+    is_initialized,
+    topology,
+    rank,
+    size,
+    process_rank,
+    process_count,
+)
+from mpit_tpu.comm.collectives import (  # noqa: F401
+    SUM,
+    PROD,
+    MAX,
+    MIN,
+    AVG,
+    allreduce,
+    allgather,
+    bcast,
+    barrier,
+    device_barrier,
+    psum,
+    pmean,
+    pmax,
+    pmin,
+    ppermute_ring,
+)

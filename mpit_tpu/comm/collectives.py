@@ -1,0 +1,155 @@
+"""Collectives: the TPU-native ``mpiT.Allreduce / Bcast / Barrier``.
+
+Reference parity (SURVEY.md §2 comp. 1, BASELINE.json:5): mpiT exposed MPI
+collectives over flat Torch storages. Here the collectives are XLA
+collectives over a mesh axis — they must be called *inside* an SPMD context
+(``jax.shard_map`` / ``jit`` over a Mesh) where the worker axis name is bound,
+and they lower to ICI all-reduces rather than host-mediated MPI. All
+functions are pytree-aware: a whole parameter pytree all-reduces in one call,
+matching the reference's flat-tensor usage without requiring flattening.
+
+Host-level process synchronization (``mpiT.Barrier`` outside compute) maps to
+``multihost_utils.sync_global_devices``.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+# `from mpit_tpu.comm.topology import ...` (by full module path) rather than
+# an attribute import: the package re-exports a `topology()` *function* that
+# shadows the submodule attribute of the same name.
+from mpit_tpu.comm.topology import topology as _current_topology
+
+# Reduction ops, mirroring mpiT.SUM/PROD/MAX/MIN constants (SURVEY.md §2 L2
+# row). AVG is a convenience the reference implemented as SUM + divide
+# (SURVEY.md §3(d): "grad /= size").
+SUM = "sum"
+PROD = "prod"
+MAX = "max"
+MIN = "min"
+AVG = "avg"
+
+_REDUCERS = {
+    SUM: lax.psum,
+    MAX: lax.pmax,
+    MIN: lax.pmin,
+}
+
+
+def _axis(axis_name: Optional[str]) -> str:
+    return axis_name if axis_name is not None else _current_topology().worker_axis
+
+
+def psum(tree: Any, axis_name: Optional[str] = None) -> Any:
+    return lax.psum(tree, _axis(axis_name))
+
+
+def pmean(tree: Any, axis_name: Optional[str] = None) -> Any:
+    return lax.pmean(tree, _axis(axis_name))
+
+
+def pmax(tree: Any, axis_name: Optional[str] = None) -> Any:
+    return lax.pmax(tree, _axis(axis_name))
+
+
+def pmin(tree: Any, axis_name: Optional[str] = None) -> Any:
+    return lax.pmin(tree, _axis(axis_name))
+
+
+def allreduce(tree: Any, op: str = SUM, axis_name: Optional[str] = None) -> Any:
+    """``mpiT.Allreduce``: reduce a pytree across the worker axis, all get it.
+
+    XLA has no product collective, so ``op=PROD`` falls back to
+    ``all_gather`` + ``prod`` — exact for any sign, but O(W) peak memory per
+    leaf; avoid PROD on large leaves.
+    """
+    axis = _axis(axis_name)
+    if op == AVG:
+        return lax.pmean(tree, axis)
+    if op == PROD:
+        return jax.tree.map(
+            lambda x: jnp.prod(lax.all_gather(x, axis), axis=0), tree
+        )
+    try:
+        reducer = _REDUCERS[op]
+    except KeyError:
+        raise ValueError(f"unknown reduction op: {op!r}") from None
+    return jax.tree.map(functools.partial(reducer, axis_name=axis), tree)
+
+
+def allgather(
+    tree: Any, axis_name: Optional[str] = None, tiled: bool = False
+) -> Any:
+    """All-gather each leaf across the worker axis (new leading worker dim,
+    or concatenated along axis 0 when ``tiled``)."""
+    axis = _axis(axis_name)
+    return jax.tree.map(
+        lambda x: lax.all_gather(x, axis, tiled=tiled), tree
+    )
+
+
+def bcast(tree: Any, root: int = 0, axis_name: Optional[str] = None) -> Any:
+    """``mpiT.Bcast``: every worker receives root's value.
+
+    Implemented as a masked psum — one collective, no gather of W copies:
+    ``psum(where(rank == root, x, 0))``. Exact for floats (no reduction
+    reordering across distinct values: all non-root contributions are 0).
+    """
+    axis = _axis(axis_name)
+    idx = lax.axis_index(axis)
+    world = lax.axis_size(axis)  # static inside shard_map
+    if isinstance(root, int) and not 0 <= root < world:
+        raise ValueError(
+            f"bcast root={root} out of range for worker axis of size {world}"
+        )
+
+    def _pick(x):
+        x = jnp.asarray(x)
+        zero = jnp.zeros_like(x)
+        contrib = jnp.where(idx == root, x, zero)
+        return lax.psum(contrib, axis)
+
+    return jax.tree.map(_pick, tree)
+
+
+def device_barrier(axis_name: Optional[str] = None):
+    """In-SPMD barrier: a psum of 1 forces a rendezvous on the worker axis.
+
+    SPMD programs are lockstep by construction, so this is rarely needed;
+    it exists for ``mpiT.Barrier`` parity inside compiled steps and returns
+    the world size (a free ``Comm_size`` check).
+    """
+    return lax.psum(jnp.ones((), jnp.int32), _axis(axis_name))
+
+
+def barrier(name: str = "mpit_barrier") -> None:
+    """Host-level barrier across processes (``mpiT.Barrier`` outside jit).
+
+    On a single process this is a no-op. Multi-host it blocks until every
+    process reaches the same named point.
+    """
+    if _current_topology().process_count > 1:
+        from jax.experimental import multihost_utils
+
+        multihost_utils.sync_global_devices(name)
+
+
+def ppermute_ring(
+    tree: Any, shift: int = 1, axis_name: Optional[str] = None
+) -> Any:
+    """Ring neighbor-exchange: each worker sends to ``(rank+shift) % W``.
+
+    The closest XLA analogue to point-to-point Send/Recv (SURVEY.md §7 "hard
+    parts": no tagged p2p on TPU). Used by ring-style algorithms; the PS
+    protocol instead uses ``mpit_tpu.transport``.
+    """
+    axis = _axis(axis_name)
+    n = lax.axis_size(axis)
+    perm = [(i, (i + shift) % n) for i in range(n)]
+    return jax.tree.map(lambda x: lax.ppermute(x, axis, perm), tree)

@@ -1,0 +1,215 @@
+"""Topology bootstrap: the TPU-native ``mpiT.Init / Comm_rank / Comm_size``.
+
+Reference parity (SURVEY.md §3(a), BASELINE.json:5): ``mpirun`` spawned N Lua
+processes which called ``mpiT.Init()`` then discovered ``rank``/``size`` from
+``MPI_COMM_WORLD``. Here the "world" is the TPU slice: processes bootstrap via
+``jax.distributed`` (when launched multi-host), devices are discovered from
+the slice, and the worker axis of the job is a ``jax.sharding.Mesh`` axis —
+one *device* per worker, rather than one OS process per worker, because on TPU
+the unit of compute is the chip and collectives ride ICI between chips.
+
+Two notions of identity therefore coexist and both are exposed:
+
+- ``process_rank()`` / ``process_count()`` — host-process identity
+  (``jax.process_index/count``); the moral equivalent of an MPI rank for
+  host-side work (logging, data sharding, the host-async PS transport).
+- ``rank()`` / ``size()`` — *worker* identity: position along the mesh's
+  worker ("dp") axis. Inside jit/shard_map this is ``lax.axis_index``.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+import threading
+from typing import Optional, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+# Default mesh axis for the data-parallel worker dimension. The reference's
+# only parallelism is data parallelism in three flavors (SURVEY.md §2
+# parallelism-strategy ledger), so a 1-D mesh is the common case.
+WORKER_AXIS = "dp"
+
+_lock = threading.Lock()
+_topology: Optional["Topology"] = None
+_distributed_initialized = False
+
+
+@dataclasses.dataclass(frozen=True)
+class Topology:
+    """World description produced by :func:`init`.
+
+    Attributes:
+      mesh: the global device mesh; axis ``axis_names[0]`` (default ``"dp"``)
+        is the worker axis used by the trainers.
+      devices: all addressable-or-not global devices, mesh order.
+      process_index / process_count: host-process identity.
+    """
+
+    mesh: Mesh
+    devices: tuple
+    process_index: int
+    process_count: int
+    platform: str
+
+    @property
+    def num_workers(self) -> int:
+        """Length of the worker axis (what ``size()``/collectives reduce over).
+
+        On a multi-axis mesh this is NOT the total device count — see
+        :attr:`num_devices`.
+        """
+        return int(self.mesh.devices.shape[0])
+
+    @property
+    def num_devices(self) -> int:
+        return int(np.prod(self.mesh.devices.shape))
+
+    @property
+    def worker_axis(self) -> str:
+        return self.mesh.axis_names[0]
+
+    @property
+    def local_devices(self) -> tuple:
+        return tuple(d for d in self.devices if d.process_index == self.process_index)
+
+    def worker_sharding(self, *trailing_axes: Optional[str]) -> NamedSharding:
+        """NamedSharding that shards the leading axis across workers."""
+        return NamedSharding(
+            self.mesh, PartitionSpec(self.worker_axis, *trailing_axes)
+        )
+
+    def replicated_sharding(self) -> NamedSharding:
+        return NamedSharding(self.mesh, PartitionSpec())
+
+
+def _should_init_distributed() -> bool:
+    """Multi-host bootstrap is opt-in via standard jax env vars.
+
+    On a single-host (or axon-tunnelled single chip) calling
+    ``jax.distributed.initialize`` without a coordinator either fails or
+    hangs, so only do it when the launcher says so — mirroring how the
+    reference only had a world when run under ``mpirun`` (SURVEY.md §3(a)).
+    """
+    if os.environ.get("MPIT_DISTRIBUTED", "").lower() in ("1", "true"):
+        return True
+    return bool(os.environ.get("JAX_COORDINATOR_ADDRESS"))
+
+
+def init(
+    axis_names: Sequence[str] = (WORKER_AXIS,),
+    mesh_shape: Optional[Sequence[int]] = None,
+    num_workers: Optional[int] = None,
+    devices: Optional[Sequence] = None,
+) -> Topology:
+    """Initialize the world: ``mpiT.Init()`` ≡ topology discovery + mesh.
+
+    Args:
+      axis_names: mesh axis names; first is the worker axis.
+      mesh_shape: explicit mesh shape (must multiply to #devices used).
+      num_workers: use only the first ``num_workers`` devices on a 1-D mesh
+        (handy for carving a sub-world, like an MPI sub-communicator).
+      devices: explicit device list (tests).
+
+    Idempotent: repeated calls return the existing topology unless
+    :func:`finalize` ran in between.
+    """
+    global _topology, _distributed_initialized
+    with _lock:
+        if _topology is not None:
+            explicit = (
+                tuple(axis_names) != (WORKER_AXIS,)
+                or mesh_shape is not None
+                or num_workers is not None
+                or devices is not None
+            )
+            if explicit:
+                raise RuntimeError(
+                    "mpit_tpu.init() called with explicit arguments but a "
+                    "topology already exists (possibly auto-created); call "
+                    "finalize() first to rebuild the world"
+                )
+            return _topology
+
+        if _should_init_distributed() and not _distributed_initialized:
+            jax.distributed.initialize()
+            _distributed_initialized = True
+
+        devs = list(devices if devices is not None else jax.devices())
+        if num_workers is not None:
+            if num_workers > len(devs):
+                raise ValueError(
+                    f"num_workers={num_workers} exceeds available devices "
+                    f"({len(devs)})"
+                )
+            devs = devs[:num_workers]
+
+        if mesh_shape is None:
+            mesh_shape = (len(devs),) + (1,) * (len(axis_names) - 1)
+        if int(np.prod(mesh_shape)) != len(devs):
+            raise ValueError(
+                f"mesh_shape {tuple(mesh_shape)} does not cover {len(devs)} devices"
+            )
+        mesh = Mesh(
+            np.asarray(devs, dtype=object).reshape(tuple(mesh_shape)),
+            axis_names=tuple(axis_names),
+        )
+        _topology = Topology(
+            mesh=mesh,
+            devices=tuple(devs),
+            process_index=jax.process_index(),
+            process_count=jax.process_count(),
+            platform=devs[0].platform if devs else "none",
+        )
+        return _topology
+
+
+def finalize() -> None:
+    """``mpiT.Finalize()``: drop the world. Safe to call when uninitialized.
+
+    In multi-host mode this also shuts down the ``jax.distributed`` client so
+    a later :func:`init` can bootstrap again; single-host it only drops the
+    mesh (XLA needs no collective teardown).
+    """
+    global _topology, _distributed_initialized
+    with _lock:
+        _topology = None
+        if _distributed_initialized:
+            jax.distributed.shutdown()
+            _distributed_initialized = False
+
+
+def is_initialized() -> bool:
+    return _topology is not None
+
+
+def topology() -> Topology:
+    """The current topology, auto-initializing with defaults if needed."""
+    if _topology is None:
+        return init()
+    return _topology
+
+
+def process_rank() -> int:
+    """Host-process index (≡ MPI rank of the host in multi-host jobs)."""
+    return topology().process_index
+
+
+def process_count() -> int:
+    return topology().process_count
+
+
+def rank():
+    """Worker id. Inside jit/shard_map: a traced ``lax.axis_index`` over the
+    worker axis. Outside a tracing context this raises — host code should use
+    :func:`process_rank` (there is no single "my device" outside SPMD).
+    """
+    return jax.lax.axis_index(topology().worker_axis)
+
+
+def size() -> int:
+    """Number of workers (devices on the worker axis) — ``mpiT.Comm_size``."""
+    return topology().num_workers

@@ -1,0 +1,8 @@
+"""Utilities: flat-parameter handling, config, logging, metrics, checkpoint."""
+
+from mpit_tpu.utils.params import (  # noqa: F401
+    FlatParamSpec,
+    flatten_params,
+    unflatten_params,
+    tree_zeros_like,
+)

@@ -1,0 +1,59 @@
+"""Flat-parameter utilities: the TPU-native ``model:getParameters()``.
+
+Reference parity (SURVEY.md §2 comp. 4, BASELINE.json:5): mpiT's pclient
+flattened an ``nn.Module``'s parameters into one contiguous Torch storage so
+the whole model moved as a single MPI buffer. The jax equivalent is
+``jax.flatten_util.ravel_pytree``: one flat vector per model, with a cached
+static unravel spec so flatten/unflatten round-trips stay out of the hot path
+(the unravel closure is jit-traceable).
+
+Unlike Torch's in-place storage aliasing, jax arrays are immutable — the flat
+vector is a *copy*, and updates flow back through :func:`unflatten_params`.
+Trainers that want zero-copy semantics simply keep the flat vector as the
+source of truth and unflatten per step inside jit (XLA fuses the reshapes:
+they are free at runtime).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable
+
+import jax
+import jax.numpy as jnp
+from jax.flatten_util import ravel_pytree
+
+
+@dataclasses.dataclass(frozen=True)
+class FlatParamSpec:
+    """Static description of a flattened pytree: size + unravel closure."""
+
+    size: int
+    dtype: Any
+    unravel: Callable[[jax.Array], Any]
+
+    def __repr__(self) -> str:  # avoid printing the closure
+        return f"FlatParamSpec(size={self.size}, dtype={self.dtype})"
+
+
+def flatten_params(tree: Any) -> tuple[jax.Array, FlatParamSpec]:
+    """Flatten a parameter pytree to one 1-D vector (≡ ``getParameters()``).
+
+    Returns ``(flat, spec)``; ``spec.unravel(flat)`` reproduces the pytree
+    with original shapes/dtypes. Safe under jit.
+    """
+    flat, unravel = ravel_pytree(tree)
+    return flat, FlatParamSpec(size=flat.size, dtype=flat.dtype, unravel=unravel)
+
+
+def unflatten_params(spec: FlatParamSpec, flat: jax.Array) -> Any:
+    """Inverse of :func:`flatten_params`."""
+    if flat.shape != (spec.size,):
+        raise ValueError(
+            f"flat vector shape {flat.shape} does not match spec ({spec.size},)"
+        )
+    return spec.unravel(flat)
+
+
+def tree_zeros_like(tree: Any) -> Any:
+    return jax.tree.map(jnp.zeros_like, tree)

@@ -1,0 +1,49 @@
+"""Test scaffolding: simulate an 8-device TPU-like mesh on CPU.
+
+The reference's test strategy was "multi-process single-node MPI simulates the
+cluster" (SURVEY.md §4). The TPU-native equivalent: force the XLA host
+platform to expose 8 virtual CPU devices and run the real shard_map/pjit code
+paths against them. Must run before jax initializes its backends, hence the
+env mutation at module import time.
+"""
+
+import os
+
+import re
+
+_FLAG = "--xla_force_host_platform_device_count=8"
+_existing = os.environ.get("XLA_FLAGS", "")
+# Replace any pre-existing device-count flag (CI images sometimes set one);
+# the tests hard-assume 8 workers.
+_cleaned = re.sub(r"--xla_force_host_platform_device_count=\d+", "", _existing)
+os.environ["XLA_FLAGS"] = (_cleaned + " " + _FLAG).strip()
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ.setdefault("JAX_ENABLE_X64", "0")
+
+import pytest  # noqa: E402
+import jax  # noqa: E402
+
+# Some images register a hardware backend from sitecustomize at interpreter
+# startup (before this conftest runs), which pins jax's platform despite the
+# env var above. Re-pin to CPU through the config API — effective as long as
+# no computation has run yet.
+jax.config.update("jax_platforms", "cpu")
+
+import mpit_tpu  # noqa: E402
+
+
+@pytest.fixture(autouse=True)
+def _fresh_topology():
+    """Each test starts with an uninitialized world (mpiT.Finalize parity)."""
+    mpit_tpu.finalize()
+    yield
+    mpit_tpu.finalize()
+
+
+@pytest.fixture
+def topo8():
+    return mpit_tpu.init()
+
+
+def pytest_report_header(config):
+    return f"mpit_tpu test mesh: {jax.device_count()} virtual CPU devices"
