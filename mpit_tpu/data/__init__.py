@@ -1,0 +1,27 @@
+"""Datasets: the TPU-native equivalent of the reference's data loading.
+
+Reference parity (SURVEY.md §2 comp. 8): the reference loaded MNIST (and, in
+the driver configs, CIFAR-10/ImageNet/PTB) via torch dataset packages, with
+per-rank sharding by worker id. Here every dataset is exposed as numpy arrays
+with (a) an on-disk loader for the standard binary formats when files are
+present under ``$MPIT_DATA_DIR``, and (b) a deterministic *learnable*
+synthetic fallback for network-less environments — class-conditional patterns
+a real model trains to high accuracy on, so end-to-end convergence tests are
+meaningful without downloads.
+
+Per-worker sharding is a pure function of (process_rank, worker id), matching
+the reference's rank-based splits.
+"""
+
+from mpit_tpu.data.synthetic import (  # noqa: F401
+    synthetic_image_classification,
+    synthetic_lm_corpus,
+)
+from mpit_tpu.data.datasets import (  # noqa: F401
+    load_mnist,
+    load_cifar10,
+    load_imagenet_like,
+    load_ptb,
+    shard_for_worker,
+    Batches,
+)

@@ -1,0 +1,182 @@
+"""Dataset loaders with on-disk fast path + synthetic fallback.
+
+On-disk formats supported when present under ``$MPIT_DATA_DIR``:
+- MNIST: the standard idx files (``train-images-idx3-ubyte`` etc.), parsed
+  natively (see ``mpit_tpu.native``) or in numpy.
+- CIFAR-10: the python/bin batches are NOT parsed here (keep the surface
+  small); synthetic CIFAR-shaped data is used unless ``.npz`` caches exist.
+
+Everything returns plain numpy; device placement and sharding are the
+trainers' job (data loading stays on host, off the TPU hot path).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import gzip
+import os
+import struct
+from typing import Iterator, Optional
+
+import numpy as np
+
+from mpit_tpu.data.synthetic import (
+    synthetic_image_classification,
+    synthetic_lm_corpus,
+)
+
+
+def _data_dir() -> Optional[str]:
+    d = os.environ.get("MPIT_DATA_DIR")
+    return d if d and os.path.isdir(d) else None
+
+
+def _read_idx(path: str) -> np.ndarray:
+    """Parse an MNIST idx file (optionally gzipped)."""
+    opener = gzip.open if path.endswith(".gz") else open
+    with opener(path, "rb") as f:
+        magic = struct.unpack(">I", f.read(4))[0]
+        ndim = magic & 0xFF
+        dims = struct.unpack(">" + "I" * ndim, f.read(4 * ndim))
+        data = np.frombuffer(f.read(), dtype=np.uint8)
+    return data.reshape(dims)
+
+
+def _find(dirname: str, stem: str) -> Optional[str]:
+    for suffix in ("", ".gz"):
+        p = os.path.join(dirname, stem + suffix)
+        if os.path.exists(p):
+            return p
+    return None
+
+
+def load_mnist(synthetic_train: int = 8192, synthetic_test: int = 2048):
+    """MNIST as (x_train, y_train, x_test, y_test), images (N,28,28,1) in
+    [0,1]. Falls back to learnable synthetic data when no files exist."""
+    d = _data_dir()
+    if d:
+        paths = {
+            "xtr": _find(d, "train-images-idx3-ubyte"),
+            "ytr": _find(d, "train-labels-idx1-ubyte"),
+            "xte": _find(d, "t10k-images-idx3-ubyte"),
+            "yte": _find(d, "t10k-labels-idx1-ubyte"),
+        }
+        if all(paths.values()):
+            x_tr = _read_idx(paths["xtr"]).astype(np.float32)[..., None] / 255.0
+            y_tr = _read_idx(paths["ytr"]).astype(np.int32)
+            x_te = _read_idx(paths["xte"]).astype(np.float32)[..., None] / 255.0
+            y_te = _read_idx(paths["yte"]).astype(np.int32)
+            return x_tr, y_tr, x_te, y_te
+    return synthetic_image_classification(
+        synthetic_train, synthetic_test, (28, 28, 1), 10, seed=0
+    )
+
+
+def load_cifar10(synthetic_train: int = 8192, synthetic_test: int = 2048):
+    """CIFAR-10-shaped data (N,32,32,3); synthetic unless an .npz cache
+    (``cifar10.npz`` with x_train/y_train/x_test/y_test) is present."""
+    d = _data_dir()
+    if d:
+        p = os.path.join(d, "cifar10.npz")
+        if os.path.exists(p):
+            z = np.load(p)
+            return (
+                z["x_train"].astype(np.float32),
+                z["y_train"].astype(np.int32),
+                z["x_test"].astype(np.float32),
+                z["y_test"].astype(np.int32),
+            )
+    return synthetic_image_classification(
+        synthetic_train, synthetic_test, (32, 32, 3), 10, seed=1
+    )
+
+
+def load_imagenet_like(
+    synthetic_train: int = 2048,
+    synthetic_test: int = 512,
+    image_size: int = 224,
+    num_classes: int = 1000,
+):
+    """ImageNet-shaped synthetic data for the AlexNet/ResNet-50 configs
+    (BASELINE.json:9-10). Real ImageNet is out of scope in this image; the
+    benchmark measures throughput, for which shape is what matters."""
+    return synthetic_image_classification(
+        synthetic_train,
+        synthetic_test,
+        (image_size, image_size, 3),
+        num_classes,
+        seed=2,
+    )
+
+
+def load_ptb(
+    synthetic_tokens: int = 200_000, vocab_size: int = 10_000
+) -> tuple[np.ndarray, np.ndarray, int]:
+    """PTB-shaped token streams (train, valid, vocab_size). Real PTB
+    (``ptb.train.txt``/``ptb.valid.txt`` under $MPIT_DATA_DIR) when present;
+    synthetic Markov corpus otherwise."""
+    d = _data_dir()
+    if d:
+        tr = os.path.join(d, "ptb.train.txt")
+        va = os.path.join(d, "ptb.valid.txt")
+        if os.path.exists(tr) and os.path.exists(va):
+            with open(tr) as f:
+                train_words = f.read().replace("\n", " <eos> ").split()
+            with open(va) as f:
+                valid_words = f.read().replace("\n", " <eos> ").split()
+            vocab = {w: i for i, w in enumerate(sorted(set(train_words)))}
+            unk = vocab.get("<unk>", 0)
+            t = np.array([vocab[w] for w in train_words], dtype=np.int32)
+            v = np.array(
+                [vocab.get(w, unk) for w in valid_words], dtype=np.int32
+            )
+            return t, v, len(vocab)
+    toks = synthetic_lm_corpus(synthetic_tokens, vocab_size, seed=3)
+    split = int(len(toks) * 0.9)
+    return toks[:split], toks[split:], vocab_size
+
+
+def shard_for_worker(
+    x: np.ndarray, worker: int, num_workers: int
+) -> np.ndarray:
+    """Static per-worker shard by worker id (reference: per-rank split,
+    SURVEY.md §2 comp. 8). Truncates to equal shard sizes — SPMD needs
+    identical shapes per worker."""
+    per = len(x) // num_workers
+    return x[worker * per : (worker + 1) * per]
+
+
+@dataclasses.dataclass
+class Batches:
+    """Host-side minibatch iterator producing *global* batches.
+
+    Yields arrays with leading dim ``global_batch = per_worker_batch * W``;
+    the trainer shards the leading axis onto the worker mesh axis. Shuffles
+    per epoch with a deterministic seed (reproducible across restarts —
+    checkpoint/resume needs this). The trailing remainder of each epoch is
+    always dropped: SPMD steps need identical batch shapes."""
+
+    x: np.ndarray
+    y: np.ndarray
+    global_batch: int
+    seed: int = 0
+
+    def __post_init__(self):
+        if len(self.x) != len(self.y):
+            raise ValueError("x and y length mismatch")
+        if len(self.x) < self.global_batch:
+            raise ValueError(
+                f"dataset of {len(self.x)} samples cannot fill one global "
+                f"batch of {self.global_batch}"
+            )
+
+    def epoch(self, epoch_index: int) -> Iterator[tuple[np.ndarray, np.ndarray]]:
+        rng = np.random.default_rng(self.seed + epoch_index)
+        order = rng.permutation(len(self.x))
+        n_full = len(self.x) // self.global_batch
+        for b in range(n_full):
+            idx = order[b * self.global_batch : (b + 1) * self.global_batch]
+            yield self.x[idx], self.y[idx]
+
+    def steps_per_epoch(self) -> int:
+        return len(self.x) // self.global_batch

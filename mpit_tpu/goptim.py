@@ -1,0 +1,80 @@
+"""goptim — distributed optimizer math (EASGD / EAMSGD / Downpour).
+
+Reference parity (SURVEY.md §2 comp. 5): the reference's ``goptim`` provided
+torch-optim-style functions ``geasgd`` / ``gdownpour`` that drove the
+pclient push-pull every τ steps. Here the *math* lives in pure jittable
+functions (this module) and the *orchestration* lives in the trainers
+(``mpit_tpu.parallel.easgd`` / ``downpour``) — the split jax rewards: pure
+update rules compose with jit/scan/shard_map, while the reference interleaved
+math and MPI calls in one loop.
+
+EASGD (Zhang, Choromanska, LeCun, NeurIPS 2015 — the paper the reference
+implements; arXiv:1412.6651):
+
+  every τ local SGD steps, with elastic coupling α and old center x̃_t:
+    client:  x_i ← x_i − α (x_i − x̃_t)
+    center:  x̃  ← x̃_t + α Σ_i (x_i − x̃_t)          (= x̃ + αW · mean_i diff)
+
+EAMSGD = EASGD with momentum in the local steps (the local optimizer's
+concern — pass ``optax.sgd(lr, momentum=m)``).
+
+Downpour (Dean et al. 2012, as re-expressed by the EASGD paper's baselines):
+workers run local steps, push accumulated updates to the center every τ
+steps, and pull the (possibly stale) center back.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+import jax
+from jax import lax
+
+
+def elastic_client_move(params: Any, center: Any, alpha: float) -> Any:
+    """x_i ← x_i − α (x_i − x̃): pull the client toward the center."""
+    return jax.tree.map(lambda p, c: p - alpha * (p - c), params, center)
+
+
+def elastic_center_move(
+    center: Any, params: Any, alpha: float, axis_name: str
+) -> Any:
+    """x̃ ← x̃ + α Σ_i (x_i − x̃): pull the center toward the clients.
+
+    Must run inside SPMD over ``axis_name``; the sum over clients is one
+    ``psum`` (this is exactly where the reference's pserver applied its
+    per-message elastic update, SURVEY.md §3(c) — the collective form is the
+    mathematically identical symmetric-round version, §5 item (i))."""
+    total_diff = lax.psum(
+        jax.tree.map(lambda p, c: p - c, params, center), axis_name
+    )
+    return jax.tree.map(lambda c, d: c + alpha * d, center, total_diff)
+
+
+def easgd_round(
+    params: Any, center: Any, alpha: float, axis_name: str
+) -> tuple[Any, Any]:
+    """One synchronous elastic-averaging exchange; returns (params, center).
+
+    Both moves use the *old* center, per the paper's update order."""
+    new_params = elastic_client_move(params, center, alpha)
+    new_center = elastic_center_move(center, params, alpha, axis_name)
+    return new_params, new_center
+
+
+def downpour_push(
+    center: Any, accumulated_updates: Any, axis_name: str, average: bool = True
+) -> Any:
+    """Server-side apply of pushed worker updates (one psum).
+
+    ``average=True`` is the model-averaging flavor named by BASELINE.json:9;
+    ``False`` sums raw updates (classic Downpour grad push)."""
+    op = lax.pmean if average else lax.psum
+    total = op(accumulated_updates, axis_name)
+    return jax.tree.map(lambda c, u: c + u, center, total)
+
+
+def downpour_pull(center: Any, stale_center: Optional[Any] = None) -> Any:
+    """Worker pull: replace local params with the center (or a stale snapshot
+    when emulating asynchrony — SURVEY.md §7 step 4's delay buffer)."""
+    return stale_center if stale_center is not None else center

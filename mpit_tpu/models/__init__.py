@@ -1,0 +1,45 @@
+"""Model zoo for the BASELINE workload configs.
+
+Reference parity: the reference built models with torch ``nn`` inside its
+example scripts (SURVEY.md §2 comps. 6, 8). Here they are flax modules,
+bfloat16 compute / float32 params by default (MXU-friendly), one per
+BASELINE.json config:
+
+- :class:`LeNet`      — MNIST async-SGD (config 1)
+- :class:`VGGSmall`   — CIFAR-10 sync DP (config 2)
+- :class:`AlexNet`    — ImageNet Downpour (config 3)
+- :class:`ResNet50`   — ImageNet sync allreduce stress (config 4)
+- :class:`LSTMLM`     — PTB EASGD (config 5)
+"""
+
+from mpit_tpu.models.lenet import LeNet  # noqa: F401
+from mpit_tpu.models.mlp import MLP  # noqa: F401
+
+_REGISTRY = {"lenet": LeNet, "mlp": MLP}
+
+
+def get_model(name: str, **kwargs):
+    """Construct a model by registry name (lazily imported to keep startup
+    light)."""
+    global _REGISTRY
+    name = name.lower()
+    if name not in _REGISTRY:
+        if name in ("vgg", "vgg_small", "vggsmall"):
+            from mpit_tpu.models.vgg import VGGSmall
+
+            _REGISTRY[name] = VGGSmall
+        elif name == "alexnet":
+            from mpit_tpu.models.alexnet import AlexNet
+
+            _REGISTRY[name] = AlexNet
+        elif name in ("resnet50", "resnet"):
+            from mpit_tpu.models.resnet import ResNet50
+
+            _REGISTRY[name] = ResNet50
+        elif name in ("lstm", "lstm_lm", "ptb_lstm"):
+            from mpit_tpu.models.lstm import LSTMLM
+
+            _REGISTRY[name] = LSTMLM
+        else:
+            raise ValueError(f"unknown model: {name!r}")
+    return _REGISTRY[name](**kwargs)

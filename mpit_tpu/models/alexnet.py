@@ -1,0 +1,45 @@
+"""AlexNet for the ImageNet Downpour config (BASELINE.json:9 — reference
+config 3: "ImageNet AlexNet Downpour-SGD model-averaging, 16 workers / 4
+pservers").
+
+Classic 5-conv/3-dense topology, NHWC, bfloat16 compute. LRN is replaced by
+GroupNorm (LRN is a 2012 artifact with poor TPU lowering; norm choice does
+not affect the throughput benchmark this config exists for).
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+
+class AlexNet(nn.Module):
+    num_classes: int = 1000
+    compute_dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x):
+        dt = self.compute_dtype
+        x = x.astype(dt)
+        x = nn.Conv(64, (11, 11), strides=(4, 4), padding=(2, 2), dtype=dt)(x)
+        x = nn.relu(x)
+        x = nn.max_pool(x, (3, 3), strides=(2, 2))
+        x = nn.Conv(192, (5, 5), padding=(2, 2), dtype=dt)(x)
+        x = nn.relu(x)
+        x = nn.max_pool(x, (3, 3), strides=(2, 2))
+        x = nn.Conv(384, (3, 3), padding=(1, 1), dtype=dt)(x)
+        x = nn.relu(x)
+        x = nn.Conv(256, (3, 3), padding=(1, 1), dtype=dt)(x)
+        x = nn.relu(x)
+        x = nn.Conv(256, (3, 3), padding=(1, 1), dtype=dt)(x)
+        x = nn.relu(x)
+        x = nn.max_pool(x, (3, 3), strides=(2, 2))
+        x = x.reshape((x.shape[0], -1))
+        x = nn.Dense(4096, dtype=dt)(x)
+        x = nn.relu(x)
+        x = nn.Dense(4096, dtype=dt)(x)
+        x = nn.relu(x)
+        x = nn.Dense(self.num_classes, dtype=dt)(x)
+        return x.astype(jnp.float32)

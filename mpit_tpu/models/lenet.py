@@ -1,0 +1,34 @@
+"""LeNet-5-style MNIST model (BASELINE.json:7 — the reference `ptest.lua`
+example trained a LeNet-style torch-nn model; SURVEY.md §2 comp. 6).
+
+TPU notes: bfloat16 activations keep the convs on the MXU; params stay
+float32 (master copy) and logits are cast back to float32 for a stable
+softmax. NHWC layout throughout (XLA:TPU's native conv layout).
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+
+class LeNet(nn.Module):
+    num_classes: int = 10
+    compute_dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x):
+        x = x.astype(self.compute_dtype)
+        x = nn.Conv(32, (5, 5), padding="SAME", dtype=self.compute_dtype)(x)
+        x = nn.relu(x)
+        x = nn.max_pool(x, (2, 2), strides=(2, 2))
+        x = nn.Conv(64, (5, 5), padding="SAME", dtype=self.compute_dtype)(x)
+        x = nn.relu(x)
+        x = nn.max_pool(x, (2, 2), strides=(2, 2))
+        x = x.reshape((x.shape[0], -1))
+        x = nn.Dense(256, dtype=self.compute_dtype)(x)
+        x = nn.relu(x)
+        x = nn.Dense(self.num_classes, dtype=self.compute_dtype)(x)
+        return x.astype(jnp.float32)

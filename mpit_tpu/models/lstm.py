@@ -1,0 +1,37 @@
+"""LSTM language model for the PTB EASGD config (BASELINE.json:11 —
+reference config 5: "PTB LSTM language model EASGD (small frequent async
+updates, non-vision)").
+
+Embedding → stacked LSTM (``nn.RNN`` = lax.scan over the sequence, so the
+whole unroll is one compiled loop — no per-timestep dispatch) → tied-size
+projection to the vocab. Takes (B, T) int tokens, returns (B, T, V) float32
+logits for next-token prediction; compute in bfloat16 (the matmul-heavy
+gates ride the MXU), params float32.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+
+class LSTMLM(nn.Module):
+    vocab_size: int = 10_000
+    embed_dim: int = 256
+    hidden: int = 512
+    num_layers: int = 2
+    compute_dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, tokens):
+        x = nn.Embed(
+            self.vocab_size, self.embed_dim, dtype=self.compute_dtype
+        )(tokens)
+        for _ in range(self.num_layers):
+            x = nn.RNN(
+                nn.OptimizedLSTMCell(self.hidden, dtype=self.compute_dtype)
+            )(x)
+        logits = nn.Dense(self.vocab_size, dtype=self.compute_dtype)(x)
+        return logits.astype(jnp.float32)

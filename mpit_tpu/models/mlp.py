@@ -1,0 +1,29 @@
+"""Small MLP classifier.
+
+Not a reference config — exists (a) as the cheap-to-compile model the e2e
+tests train (SURVEY.md §4's multi-device tests need fast XLA compiles on the
+simulated CPU mesh), and (b) as the minimal example model for docs.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Sequence
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+
+class MLP(nn.Module):
+    num_classes: int = 10
+    hidden: Sequence[int] = (128,)
+    compute_dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x):
+        x = x.astype(self.compute_dtype)
+        x = x.reshape((x.shape[0], -1))
+        for h in self.hidden:
+            x = nn.Dense(h, dtype=self.compute_dtype)(x)
+            x = nn.relu(x)
+        x = nn.Dense(self.num_classes, dtype=self.compute_dtype)(x)
+        return x.astype(jnp.float32)

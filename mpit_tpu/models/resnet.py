@@ -1,0 +1,68 @@
+"""ResNet-50 for the large-tensor collective stress config (BASELINE.json:10
+— reference config 4: "ResNet-50 ImageNet sync allreduce").
+
+Bottleneck-v1.5 topology (stride in the 3×3), NHWC, bfloat16 compute /
+float32 params. GroupNorm replaces BatchNorm so the module is a pure
+function of params — sync DP then needs no cross-replica stats collective
+beyond the gradient all-reduce this config exists to stress.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Any, Sequence
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+
+class Bottleneck(nn.Module):
+    features: int
+    strides: tuple[int, int] = (1, 1)
+    compute_dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x):
+        dt = self.compute_dtype
+        conv = partial(nn.Conv, use_bias=False, dtype=dt)
+        norm = partial(nn.GroupNorm, num_groups=32, dtype=dt)
+        residual = x
+        y = conv(self.features, (1, 1))(x)
+        y = nn.relu(norm()(y))
+        y = conv(self.features, (3, 3), strides=self.strides, padding="SAME")(y)
+        y = nn.relu(norm()(y))
+        y = conv(self.features * 4, (1, 1))(y)
+        y = norm()(y)
+        if residual.shape != y.shape:
+            residual = conv(self.features * 4, (1, 1), strides=self.strides)(
+                residual
+            )
+            residual = norm()(residual)
+        return nn.relu(y + residual)
+
+
+class ResNet50(nn.Module):
+    num_classes: int = 1000
+    stage_sizes: Sequence[int] = (3, 4, 6, 3)
+    compute_dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x):
+        dt = self.compute_dtype
+        x = x.astype(dt)
+        x = nn.Conv(
+            64, (7, 7), strides=(2, 2), padding=(3, 3), use_bias=False, dtype=dt
+        )(x)
+        x = nn.relu(nn.GroupNorm(num_groups=32, dtype=dt)(x))
+        x = nn.max_pool(x, (3, 3), strides=(2, 2), padding="SAME")
+        for stage, blocks in enumerate(self.stage_sizes):
+            for block in range(blocks):
+                strides = (2, 2) if stage > 0 and block == 0 else (1, 1)
+                x = Bottleneck(
+                    features=64 * 2**stage,
+                    strides=strides,
+                    compute_dtype=dt,
+                )(x)
+        x = jnp.mean(x, axis=(1, 2))
+        x = nn.Dense(self.num_classes, dtype=dt)(x)
+        return x.astype(jnp.float32)

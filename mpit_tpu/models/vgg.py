@@ -1,0 +1,42 @@
+"""VGG-small for CIFAR-10 (BASELINE.json:8 — the reference's config 2 trained
+a small VGG-style torch-nn convnet with sync allreduce DP).
+
+bfloat16 compute / float32 params; NHWC; 3×3 conv stacks with max-pool,
+GroupNorm instead of BatchNorm — no mutable batch statistics, so the module
+stays a pure params->logits function (jit/shard_map-friendly, and immune to
+the cross-replica BN-stats question sync DP would otherwise raise).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Sequence
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+
+class VGGSmall(nn.Module):
+    num_classes: int = 10
+    widths: Sequence[int] = (64, 128, 256)
+    convs_per_block: int = 2
+    compute_dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x):
+        x = x.astype(self.compute_dtype)
+        for width in self.widths:
+            for _ in range(self.convs_per_block):
+                x = nn.Conv(
+                    width, (3, 3), padding="SAME", use_bias=False,
+                    dtype=self.compute_dtype,
+                )(x)
+                x = nn.GroupNorm(num_groups=32, dtype=self.compute_dtype)(x)
+                x = nn.relu(x)
+            x = nn.max_pool(x, (2, 2), strides=(2, 2))
+        # flatten, as in classic VGG: the spatial arrangement carries class
+        # evidence that a global average pool would integrate away
+        x = x.reshape((x.shape[0], -1))
+        x = nn.Dense(512, dtype=self.compute_dtype)(x)
+        x = nn.relu(x)
+        x = nn.Dense(self.num_classes, dtype=self.compute_dtype)(x)
+        return x.astype(jnp.float32)

@@ -1,0 +1,16 @@
+"""Trainers: the TPU-native equivalents of the reference's asyncsgd layer.
+
+- :mod:`mpit_tpu.parallel.sync`     — synchronous allreduce data parallelism
+  (SURVEY.md §2 comp. 7, call stack §3(d)).
+- :mod:`mpit_tpu.parallel.easgd`    — EASGD/EAMSGD in collective formulation
+  (SURVEY.md §2 comp. 5, §5 backend mapping item (i)).
+- :mod:`mpit_tpu.parallel.downpour` — Downpour grad-push/param-pull with
+  emulated staleness (same mapping).
+- :mod:`mpit_tpu.parallel.pserver` / ``pclient`` — host-async
+  parameter-server fidelity mode (SURVEY.md §2 comps. 3-4, §5 item (ii)).
+"""
+
+from mpit_tpu.parallel.common import TrainState, cross_entropy_loss  # noqa: F401
+from mpit_tpu.parallel.sync import DataParallelTrainer  # noqa: F401
+from mpit_tpu.parallel.easgd import EASGDTrainer, EASGDState  # noqa: F401
+from mpit_tpu.parallel.downpour import DownpourTrainer, DownpourState  # noqa: F401

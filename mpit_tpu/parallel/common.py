@@ -1,0 +1,180 @@
+"""Shared trainer plumbing: train state, losses, batch sharding."""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Optional
+
+import flax.struct
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+
+@flax.struct.dataclass
+class TrainState:
+    """Replicated training state (params + optimizer state + step).
+
+    The reference's analogue is the flat parameter vector each pclient held
+    plus torch-optim state tables (SURVEY.md §2 comps. 4-5); here state is a
+    pytree and flattening is only done where a flat buffer genuinely helps
+    (PS transport), not for every update.
+    """
+
+    params: Any
+    opt_state: Any
+    step: jax.Array
+
+    @classmethod
+    def create(cls, params, optimizer: optax.GradientTransformation):
+        return cls(
+            params=params,
+            opt_state=optimizer.init(params),
+            step=jnp.zeros((), jnp.int32),
+        )
+
+
+def cross_entropy_loss(logits: jax.Array, labels: jax.Array) -> jax.Array:
+    return optax.softmax_cross_entropy_with_integer_labels(
+        logits, labels
+    ).mean()
+
+
+def default_loss_fn(apply_fn: Callable) -> Callable:
+    """(params, x, y) -> scalar loss, for classification models."""
+
+    def loss_fn(params, x, y):
+        logits = apply_fn({"params": params}, x)
+        return cross_entropy_loss(logits, y)
+
+    return loss_fn
+
+
+def check_global_batch(global_batch: int, num_workers: int) -> int:
+    if global_batch % num_workers != 0:
+        raise ValueError(
+            f"global batch {global_batch} not divisible by {num_workers} "
+            "workers (SPMD shards must be equal)"
+        )
+    return global_batch // num_workers
+
+
+def accuracy(logits: np.ndarray, labels: np.ndarray) -> float:
+    return float((np.argmax(logits, -1) == labels).mean())
+
+
+class RoundTrainer:
+    """Shared machinery for τ-round trainers (EASGD, Downpour).
+
+    Subclasses set, in __init__: ``topo``, ``tau``, ``_round`` (jitted round
+    step taking (state, x(W,τ,B,...), y(W,τ,B,...))), ``_eval`` (jitted
+    (params, x, y) -> summed-correct, or None when model-less), and implement
+    ``center_params(state)``.
+    """
+
+    topo: Any
+    tau: int
+    _round: Callable
+    _eval: Optional[Callable]
+
+    _log_tag = "round"
+
+    def center_params(self, state):
+        raise NotImplementedError
+
+    def round_batches(self, x_round: np.ndarray, y_round: np.ndarray):
+        """Reshape τ stacked global batches (τ, W·B, ...) → (W, τ, B, ...)."""
+        tau, w = self.tau, self.topo.num_workers
+        if x_round.shape[0] != tau:
+            raise ValueError(
+                f"need {tau} stacked batches, got {x_round.shape[0]}"
+            )
+        b = check_global_batch(x_round.shape[1], w)
+        xr = x_round.reshape(tau, w, b, *x_round.shape[2:]).swapaxes(0, 1)
+        yr = y_round.reshape(tau, w, b, *y_round.shape[2:]).swapaxes(0, 1)
+        return xr, yr
+
+    def step(self, state, x_round, y_round):
+        """One exchange round: τ local steps + the collective. Inputs are τ
+        stacked global batches, shape (τ, W·B, ...)."""
+        xr, yr = self.round_batches(np.asarray(x_round), np.asarray(y_round))
+        return self._round(state, xr, yr)
+
+    def fit(self, batches, state, epochs: int = 1, log_every: int = 0):
+        """Epoch loop grouping minibatches into τ-rounds. A trailing group
+        smaller than τ is dropped (SPMD rounds have a fixed shape); raises if
+        that leaves zero full rounds, rather than silently doing nothing."""
+        buf_x, buf_y, metrics = [], [], None
+        rounds = 0
+        for e in range(epochs):
+            for x, y in batches.epoch(e):
+                buf_x.append(x)
+                buf_y.append(y)
+                if len(buf_x) == self.tau:
+                    state, metrics = self.step(
+                        state, np.stack(buf_x), np.stack(buf_y)
+                    )
+                    buf_x, buf_y = [], []
+                    rounds += 1
+                    if log_every and rounds % log_every == 0:
+                        print(
+                            f"[{self._log_tag}] round={rounds} "
+                            f"loss={float(metrics['loss']):.4f}"
+                        )
+        if rounds == 0:
+            raise ValueError(
+                f"fit() produced no full rounds: {epochs} epoch(s) of "
+                f"{batches.steps_per_epoch()} step(s) < tau={self.tau}"
+            )
+        if buf_x:
+            print(
+                f"[{self._log_tag}] dropped {len(buf_x)} trailing batch(es) "
+                f"(< tau={self.tau})"
+            )
+        return state, metrics
+
+    def evaluate(self, state, x, y, batch: int = 1024) -> float:
+        """Accuracy of the CENTER variable (the consensus model — what the
+        reference's pserver held and reported)."""
+        if self._eval is None:
+            raise ValueError(
+                "evaluate() requires a model; this trainer was built with "
+                "model=None (loss-only math mode)"
+            )
+        w = self.topo.num_workers
+        batch = (batch // w) * w or w
+        n = (len(x) // batch) * batch
+        if n == 0:
+            raise ValueError("eval set smaller than one global batch")
+        correct = 0
+        center = self.center_params(state)
+        for i in range(0, n, batch):
+            correct += int(
+                self._eval(center, x[i : i + batch], y[i : i + batch])
+            )
+        return correct / n
+
+
+def build_center_eval(model, topo) -> Optional[Callable]:
+    """Jitted shard_map eval returning the summed correct-count across the
+    worker axis, or None when model-less."""
+    if model is None:
+        return None
+    from jax.sharding import PartitionSpec as P
+
+    axis = topo.worker_axis
+
+    def eval_step(params, x, y):
+        logits = model.apply({"params": params}, x)
+        correct = jnp.sum(jnp.argmax(logits, -1) == y)
+        return jax.lax.psum(correct, axis)
+
+    return jax.jit(
+        jax.shard_map(
+            eval_step,
+            mesh=topo.mesh,
+            in_specs=(P(), P(axis), P(axis)),
+            out_specs=P(),
+            check_vma=False,
+        )
+    )

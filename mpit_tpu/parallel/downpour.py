@@ -1,0 +1,200 @@
+"""Downpour-SGD trainer (grad push / param pull, model-averaging flavor).
+
+Reference parity: goptim's ``gdownpour`` (SURVEY.md §2 comp. 5,
+BASELINE.json:9 "Downpour-SGD model-averaging"). In the reference, workers
+pushed gradients (or params) to parameter servers and pulled fresh params
+every τ steps, tolerating staleness from message interleaving. Collective
+re-expression (SURVEY.md §5 item (i)): the push is one psum/pmean of the
+workers' accumulated updates into the replicated center (the server's apply),
+the pull replaces worker params with the center. Protocol staleness is
+emulated *exactly and reproducibly* with a center-history ring: workers pull
+the center from ``staleness`` rounds ago, which bounds the gradient age the
+way a real async PS does on average — and unlike the MPI version, the
+staleness is controlled, so its effect on convergence is testable
+(SURVEY.md §5 "race detection": property tests replace nondeterminism).
+
+True host-async Downpour (unbounded staleness, per-message ordering) lives in
+the host-async PS mode (``mpit_tpu.parallel.pserver``, in progress).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Optional
+
+import flax.struct
+import jax
+import jax.numpy as jnp
+import optax
+from jax.sharding import PartitionSpec as P
+
+import mpit_tpu.comm.topology as _topo_mod
+from mpit_tpu import goptim
+from mpit_tpu.comm.topology import Topology
+from mpit_tpu.parallel import common
+from mpit_tpu.parallel.easgd import _put0, _stack, _take0
+
+
+@flax.struct.dataclass
+class DownpourState:
+    worker_params: Any  # leading worker axis, sharded
+    worker_opt: Any  # leading worker axis, sharded
+    center: Any  # replicated
+    server_opt: Any  # replicated server-side optimizer state
+    center_history: Any  # leading axis (staleness+1), replicated; [0] = oldest
+    round: jax.Array
+
+
+class DownpourTrainer(common.RoundTrainer):
+    """Downpour: τ local steps, push accumulated grads, pull (stale) center.
+
+    Args:
+      optimizer: local worker optimizer.
+      server_optimizer: applied to the pushed (averaged) gradient sum at the
+        center; defaults to plain SGD with lr=1.0 on the accumulated local
+        *updates* — i.e. model averaging, the BASELINE.json:9 flavor.
+      tau: push/pull period.
+      staleness: rounds of center age workers see on pull (0 = fresh).
+    """
+
+    def __init__(
+        self,
+        model,
+        optimizer: optax.GradientTransformation,
+        topo: Optional[Topology] = None,
+        loss_fn: Optional[Callable] = None,
+        server_optimizer: Optional[optax.GradientTransformation] = None,
+        tau: int = 4,
+        staleness: int = 0,
+        donate_state: bool = True,
+    ):
+        self.model = model
+        self.optimizer = optimizer
+        self.topo = topo if topo is not None else _topo_mod.topology()
+        self.tau = int(tau)
+        self.staleness = int(staleness)
+        if self.staleness < 0:
+            raise ValueError("staleness must be >= 0")
+        self.server_optimizer = server_optimizer
+        self.loss_fn = (
+            loss_fn
+            if loss_fn is not None
+            else common.default_loss_fn(model.apply)
+        )
+        axis = self.topo.worker_axis
+        mesh = self.topo.mesh
+
+        def round_step(state: DownpourState, x, y):
+            params = _take0(state.worker_params)
+            opt = _take0(state.worker_opt)
+            start = params
+
+            def local_step(carry, batch):
+                p, o = carry
+                bx, by = batch
+                loss, g = jax.value_and_grad(self.loss_fn)(p, bx, by)
+                updates, o = self.optimizer.update(g, o, p)
+                p = optax.apply_updates(p, updates)
+                return (p, o), loss
+
+            (params, opt), losses = jax.lax.scan(
+                local_step, (params, opt), (x[0], y[0])
+            )
+            # push: accumulated local update = params - start
+            delta = jax.tree.map(lambda a, b: a - b, params, start)
+            if self.server_optimizer is None:
+                # model averaging: center += mean_i(delta_i)
+                center = goptim.downpour_push(
+                    state.center, delta, axis, average=True
+                )
+                server_opt = state.server_opt
+            else:
+                # classic: server optimizer consumes -mean(delta) as a grad
+                mean_delta = jax.lax.pmean(delta, axis)
+                pseudo_grad = jax.tree.map(lambda d: -d, mean_delta)
+                updates, server_opt = self.server_optimizer.update(
+                    pseudo_grad, state.server_opt, state.center
+                )
+                center = optax.apply_updates(state.center, updates)
+
+            # staleness ring: append new center, pull the oldest
+            history = jax.tree.map(
+                lambda h, c: jnp.concatenate([h[1:], c[None]], axis=0),
+                state.center_history,
+                center,
+            )
+            pulled = jax.tree.map(lambda h: h[0], history)
+            params = goptim.downpour_pull(center, pulled)
+            return (
+                DownpourState(
+                    worker_params=_put0(params),
+                    worker_opt=_put0(opt),
+                    center=center,
+                    server_opt=server_opt,
+                    center_history=history,
+                    round=state.round + 1,
+                ),
+                {"loss": jnp.mean(jax.lax.pmean(losses, axis))},
+            )
+
+        state_specs = DownpourState(
+            worker_params=P(axis),
+            worker_opt=P(axis),
+            center=P(),
+            server_opt=P(),
+            center_history=P(),
+            round=P(),
+        )
+        self._round = jax.jit(
+            jax.shard_map(
+                round_step,
+                mesh=mesh,
+                in_specs=(state_specs, P(axis), P(axis)),
+                out_specs=(state_specs, P()),
+                check_vma=False,
+            ),
+            donate_argnums=(0,) if donate_state else (),
+        )
+
+        self._eval = common.build_center_eval(model, self.topo)
+        self._log_tag = "downpour"
+
+    def init_state(self, rng, sample_x=None, params: Any = None) -> DownpourState:
+        if params is None:
+            params = self.model.init(rng, jnp.asarray(sample_x))["params"]
+        w = self.topo.num_workers
+        server_opt = (
+            self.server_optimizer.init(params)
+            if self.server_optimizer is not None
+            else ()
+        )
+        history = jax.tree.map(
+            lambda a: jnp.broadcast_to(
+                a[None], (self.staleness + 1, *a.shape)
+            ),
+            params,
+        )
+        state = DownpourState(
+            worker_params=_stack(params, w),
+            worker_opt=_stack(self.optimizer.init(params), w),
+            center=params,
+            server_opt=server_opt,
+            center_history=history,
+            round=jnp.zeros((), jnp.int32),
+        )
+        rep = self.topo.replicated_sharding()
+        shardings = DownpourState(
+            worker_params=jax.tree.map(
+                lambda _: self.topo.worker_sharding(), state.worker_params
+            ),
+            worker_opt=jax.tree.map(
+                lambda _: self.topo.worker_sharding(), state.worker_opt
+            ),
+            center=jax.tree.map(lambda _: rep, state.center),
+            server_opt=jax.tree.map(lambda _: rep, state.server_opt),
+            center_history=jax.tree.map(lambda _: rep, state.center_history),
+            round=rep,
+        )
+        return jax.device_put(state, shardings)
+
+    def center_params(self, state: DownpourState):
+        return state.center

@@ -1,0 +1,49 @@
+"""Model zoo shape/registry tests (one forward per model, float32 on CPU)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from mpit_tpu.models import get_model
+
+
+@pytest.mark.parametrize(
+    "name,kwargs,x_shape,out_shape",
+    [
+        ("lenet", {}, (2, 28, 28, 1), (2, 10)),
+        ("mlp", {}, (2, 28, 28, 1), (2, 10)),
+        ("vgg_small", {}, (2, 32, 32, 3), (2, 10)),
+        ("alexnet", {"num_classes": 100}, (2, 224, 224, 3), (2, 100)),
+        ("lstm", {"vocab_size": 50, "embed_dim": 8, "hidden": 16}, None, None),
+    ],
+)
+def test_forward_shapes(name, kwargs, x_shape, out_shape):
+    model = get_model(name, compute_dtype=jnp.float32, **kwargs)
+    if name == "lstm":
+        x = np.zeros((2, 12), np.int32)
+        out_shape = (2, 12, 50)
+    else:
+        x = np.zeros(x_shape, np.float32)
+    variables = model.init(jax.random.key(0), x)
+    out = model.apply(variables, x)
+    assert out.shape == out_shape
+    assert out.dtype == jnp.float32
+
+
+def test_resnet50_forward_and_param_count():
+    model = get_model("resnet50", num_classes=10, compute_dtype=jnp.float32)
+    x = np.zeros((1, 64, 64, 3), np.float32)
+    variables = model.init(jax.random.key(0), x)
+    out = model.apply(variables, x)
+    assert out.shape == (1, 10)
+    n_params = sum(
+        int(np.prod(p.shape)) for p in jax.tree.leaves(variables["params"])
+    )
+    # ResNet-50 trunk ~23.5M params (without the 1000-class head)
+    assert 20e6 < n_params < 30e6, n_params
+
+
+def test_unknown_model_raises():
+    with pytest.raises(ValueError, match="unknown model"):
+        get_model("transformer9000")
