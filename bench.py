@@ -1,0 +1,120 @@
+"""Benchmark: async-SGD (EASGD) samples/sec/chip on MNIST LeNet.
+
+North-star metric per BASELINE.json:2. The reference published no numbers
+(BASELINE.json:13); its bundled example ran Torch7 on CPU (BASELINE.json:7),
+so ``vs_baseline`` is measured against the same LeNet training loop in
+torch (CPU) built here — the closest live stand-in for the reference stack.
+
+Prints ONE JSON line:
+  {"metric": ..., "value": N, "unit": "samples/sec/chip", "vs_baseline": N}
+Extra fields are informative; the driver keys on the four required ones.
+"""
+
+import json
+import sys
+import time
+
+import numpy as np
+
+
+def bench_jax(per_worker_batch: int = 256, tau: int = 4) -> dict:
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    import mpit_tpu
+    from mpit_tpu.data import load_mnist
+    from mpit_tpu.models import LeNet
+    from mpit_tpu.parallel import EASGDTrainer
+
+    topo = mpit_tpu.init()
+    w = topo.num_workers
+    x_tr, y_tr, *_ = load_mnist(synthetic_train=4096)
+    trainer = EASGDTrainer(
+        LeNet(), optax.sgd(0.05, momentum=0.9), topo, tau=tau
+    )
+    state = trainer.init_state(jax.random.key(0), x_tr[:2])
+
+    gb = per_worker_batch * w
+    rng = np.random.default_rng(0)
+    idx = rng.integers(0, len(x_tr), tau * gb)
+    xr = x_tr[idx].reshape(tau, gb, 28, 28, 1)
+    yr = y_tr[idx].reshape(tau, gb)
+
+    # warmup (compile)
+    for _ in range(3):
+        state, m = trainer.step(state, xr, yr)
+    jax.block_until_ready(m["loss"])
+
+    rounds = 30
+    t0 = time.perf_counter()
+    for _ in range(rounds):
+        state, m = trainer.step(state, xr, yr)
+    jax.block_until_ready(m["loss"])
+    dt = time.perf_counter() - t0
+
+    samples = rounds * tau * gb
+    return {
+        "samples_per_sec": samples / dt,
+        "samples_per_sec_per_chip": samples / dt / w,
+        "chips": w,
+        "platform": topo.platform,
+        "tau": tau,
+        "per_worker_batch": per_worker_batch,
+    }
+
+
+def bench_torch_cpu(batch: int = 256, steps: int = 12) -> float:
+    """Reference-stack stand-in: the same LeNet trained with torch on CPU
+    (the reference's ptest example ran Torch on CPU, BASELINE.json:7)."""
+    try:
+        import torch
+        import torch.nn as tnn
+    except Exception:
+        return float("nan")
+
+    torch.manual_seed(0)
+    model = tnn.Sequential(
+        tnn.Conv2d(1, 32, 5, padding=2), tnn.ReLU(), tnn.MaxPool2d(2),
+        tnn.Conv2d(32, 64, 5, padding=2), tnn.ReLU(), tnn.MaxPool2d(2),
+        tnn.Flatten(),
+        tnn.Linear(64 * 7 * 7, 256), tnn.ReLU(),
+        tnn.Linear(256, 10),
+    )
+    opt = torch.optim.SGD(model.parameters(), lr=0.05, momentum=0.9)
+    loss_fn = tnn.CrossEntropyLoss()
+    x = torch.rand(batch, 1, 28, 28)
+    y = torch.randint(0, 10, (batch,))
+    # warmup
+    for _ in range(2):
+        opt.zero_grad(); loss_fn(model(x), y).backward(); opt.step()
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        opt.zero_grad(); loss_fn(model(x), y).backward(); opt.step()
+    dt = time.perf_counter() - t0
+    return batch * steps / dt
+
+
+def main():
+    jax_res = bench_jax()
+    torch_sps = bench_torch_cpu()
+    value = jax_res["samples_per_sec_per_chip"]
+    # no torch -> no baseline measurement; report null, not fake parity
+    vs = round(value / torch_sps, 2) if np.isfinite(torch_sps) else None
+    out = {
+        "metric": "easgd_mnist_lenet_throughput",
+        "value": round(value, 1),
+        "unit": "samples/sec/chip",
+        "vs_baseline": vs,
+        "baseline": "torch-cpu LeNet train step (reference ran Torch on CPU)",
+        "baseline_samples_per_sec": round(torch_sps, 1)
+        if np.isfinite(torch_sps)
+        else None,
+        "chips": jax_res["chips"],
+        "platform": jax_res["platform"],
+    }
+    print(json.dumps(out))
+
+
+if __name__ == "__main__":
+    sys.exit(main())
