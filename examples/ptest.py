@@ -24,8 +24,20 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 def main():
     p = argparse.ArgumentParser(description=__doc__)
-    p.add_argument("--algo", choices=["easgd", "downpour", "sync"],
-                   default="easgd")
+    p.add_argument("--algo",
+                   choices=["easgd", "downpour", "sync",
+                            "ps-easgd", "ps-downpour"],
+                   default="easgd",
+                   help="easgd/downpour/sync = collective trainers (fast "
+                        "path); ps-* = host-async pserver/pclient fidelity "
+                        "mode (the reference's literal 2-pclient+1-pserver "
+                        "shape)")
+    p.add_argument("--clients", type=int, default=2,
+                   help="pclients (ps-* algos; reference default 2)")
+    p.add_argument("--servers", type=int, default=1,
+                   help="pservers (ps-* algos; reference default 1)")
+    p.add_argument("--steps", type=int, default=200,
+                   help="local steps per client (ps-* algos)")
     p.add_argument("--model", default="lenet")
     p.add_argument("--lr", type=float, default=0.05)
     p.add_argument("--momentum", type=float, default=0.9)
@@ -66,6 +78,38 @@ def main():
     x_tr, y_tr, x_te, y_te = load_mnist(synthetic_train=args.train_size)
     model = get_model(args.model)
     opt = optax.sgd(args.lr, momentum=args.momentum)
+
+    if args.algo.startswith("ps-"):
+        from mpit_tpu.parallel import AsyncPSTrainer
+
+        # same default coupling rule as the collective path: alpha = 0.9/W
+        # with W = number of clients
+        ps_alpha = (
+            args.alpha if args.alpha is not None else 0.9 / args.clients
+        )
+        trainer = AsyncPSTrainer(
+            model, opt,
+            num_clients=args.clients, num_servers=args.servers,
+            algo=args.algo.removeprefix("ps-"),
+            alpha=ps_alpha,
+            tau=args.tau,
+        )
+        per_client_batch = max(args.global_batch // args.clients, 1)
+        t0 = time.perf_counter()
+        center, stats = trainer.train(
+            x_tr, y_tr, steps=args.steps, batch_size=per_client_batch
+        )
+        dt = time.perf_counter() - t0
+        acc = trainer.evaluate(center, x_te, y_te)
+        samples = args.steps * per_client_batch * args.clients
+        print(
+            f"[ptest] {args.algo} ({args.clients} pclients + "
+            f"{args.servers} pservers): test acc={acc:.4f} "
+            f"loss={stats['mean_final_loss']:.4f} wall={dt:.1f}s "
+            f"({samples / dt:.0f} samples/sec) "
+            f"server_counts={stats['server_counts']}"
+        )
+        return
 
     if args.algo == "easgd":
         trainer = EASGDTrainer(model, opt, topo, alpha=args.alpha,

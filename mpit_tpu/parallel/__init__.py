@@ -14,3 +14,6 @@ from mpit_tpu.parallel.common import TrainState, cross_entropy_loss  # noqa: F40
 from mpit_tpu.parallel.sync import DataParallelTrainer  # noqa: F401
 from mpit_tpu.parallel.easgd import EASGDTrainer, EASGDState  # noqa: F401
 from mpit_tpu.parallel.downpour import DownpourTrainer, DownpourState  # noqa: F401
+from mpit_tpu.parallel.pserver import PServer  # noqa: F401
+from mpit_tpu.parallel.pclient import PClient  # noqa: F401
+from mpit_tpu.parallel.ps_trainer import AsyncPSTrainer  # noqa: F401

@@ -1,0 +1,80 @@
+"""pclient — worker-side stub for the host-async parameter server.
+
+Reference parity (SURVEY.md §2 comp. 4): the reference's ``pclient`` owned
+the worker→server mapping, flattened the model (``getParameters()``), and
+exposed async fetch/push used by goptim every τ steps. Same role here: it
+splits the flat vector across the server partition (``partition_bounds``),
+talks the tag protocol over ``mpit_tpu.transport``, and leaves all actual
+training math to the caller — compute stays jit-compiled on device, only
+flat numpy chunks cross the transport.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import numpy as np
+
+from mpit_tpu.parallel.pserver import (
+    TAG_FETCH,
+    TAG_PARAM,
+    TAG_PUSH_DELTA,
+    TAG_PUSH_EASGD,
+    TAG_STOP,
+    partition_bounds,
+)
+from mpit_tpu.transport import Transport
+
+
+class PClient:
+    """Client stub: fetch / push against a set of sharded pservers.
+
+    ``server_ranks[s]`` owns flat chunk s of a ``param_size`` vector.
+    """
+
+    def __init__(
+        self,
+        transport: Transport,
+        server_ranks: Sequence[int],
+        param_size: int,
+        timeout: Optional[float] = 60.0,
+    ):
+        self.transport = transport
+        self.server_ranks = list(server_ranks)
+        self.param_size = int(param_size)
+        self.bounds = partition_bounds(self.param_size, len(self.server_ranks))
+        self.timeout = timeout
+
+    def fetch(self) -> np.ndarray:
+        """Gather the full flat center from all servers (async fan-out:
+        request every chunk before waiting on any — the reference's
+        ``async_fetch_param`` shape, SURVEY.md §3(b))."""
+        for rank in self.server_ranks:
+            self.transport.send(rank, TAG_FETCH, None)
+        out = np.empty(self.param_size, np.float32)
+        for rank, (start, end) in zip(self.server_ranks, self.bounds):
+            msg = self.transport.recv(rank, TAG_PARAM, timeout=self.timeout)
+            out[start:end] = msg.payload
+        return out
+
+    def push_easgd(self, flat_params: np.ndarray) -> None:
+        """Push local params; each server does its elastic center move."""
+        self._scatter(TAG_PUSH_EASGD, flat_params)
+
+    def push_delta(self, flat_delta: np.ndarray) -> None:
+        """Push an accumulated update (Downpour grad/delta apply)."""
+        self._scatter(TAG_PUSH_DELTA, flat_delta)
+
+    def stop(self) -> None:
+        """Detach from every server (teardown protocol, SURVEY.md §3(e))."""
+        for rank in self.server_ranks:
+            self.transport.send(rank, TAG_STOP, None)
+
+    def _scatter(self, tag: int, flat: np.ndarray) -> None:
+        flat = np.asarray(flat, np.float32)
+        if flat.shape != (self.param_size,):
+            raise ValueError(
+                f"flat vector shape {flat.shape} != ({self.param_size},)"
+            )
+        for rank, (start, end) in zip(self.server_ranks, self.bounds):
+            self.transport.send(rank, tag, flat[start:end])

@@ -1,0 +1,119 @@
+"""pserver — host-async parameter-server actor (fidelity mode).
+
+Reference parity (SURVEY.md §2 comp. 3, §3(c)): the reference's ``pserver``
+held the center parameter vector as a flat tensor and ran a blocking
+``Recv(ANY_SOURCE)`` loop, dispatching on message tag (fetch / push / stop).
+This is that actor, TPU-style: the center lives in host memory as a numpy
+chunk (device arrays would pin a chip per server for no benefit — the server
+does O(bytes) axpy work, which is memory-bound host arithmetic), clients'
+compute stays jit-compiled on device, and the protocol runs over
+``mpit_tpu.transport`` (threads in-process, TCP across hosts).
+
+Sharding: with S servers, the flat parameter vector is split into S
+contiguous chunks (``np.array_split`` boundaries); server s owns chunk s —
+the reference's worker→server mapping generalized to BASELINE.json:9's
+"16 workers / 4 pservers" config.
+
+Protocol tags (client → server unless noted):
+  FETCH       ()                server replies PARAM(chunk) to requester
+  PUSH_EASGD  (x_chunk)         center += alpha * (x_chunk - center)
+  PUSH_DELTA  (delta_chunk)     center += server_lr * delta_chunk
+  PARAM       (chunk)           server → client fetch reply
+  STOP        ()                client detaches; server exits when all did
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Optional
+
+import numpy as np
+
+from mpit_tpu.transport import ANY_SOURCE, ANY_TAG, Transport
+
+TAG_FETCH = 1
+TAG_PUSH_EASGD = 2
+TAG_PUSH_DELTA = 3
+TAG_PARAM = 4
+TAG_STOP = 5
+
+
+def partition_bounds(total: int, num_servers: int) -> list[tuple[int, int]]:
+    """Contiguous chunk [start, end) per server (np.array_split boundaries)."""
+    sizes = [len(a) for a in np.array_split(np.empty(total, np.uint8), num_servers)]
+    bounds, start = [], 0
+    for s in sizes:
+        bounds.append((start, start + s))
+        start += s
+    return bounds
+
+
+class PServer:
+    """One parameter-server actor owning a chunk of the flat center vector.
+
+    Run ``start()`` in its own thread/process; it blocks in the recv loop
+    until every expected client sent STOP (the reference's teardown,
+    SURVEY.md §3(e)).
+    """
+
+    def __init__(
+        self,
+        transport: Transport,
+        center_chunk: np.ndarray,
+        num_clients: int,
+        alpha: float = 0.5,
+        server_lr: float = 1.0,
+    ):
+        self.transport = transport
+        self.center = np.array(center_chunk, dtype=np.float32, copy=True)
+        self.num_clients = num_clients
+        self.alpha = float(alpha)
+        self.server_lr = float(server_lr)
+        self.counts = {"fetch": 0, "push_easgd": 0, "push_delta": 0}
+        self.error: Optional[BaseException] = None
+        self._lock = threading.Lock()
+
+    def start(self) -> None:
+        """Recv loop; stores any exception in ``self.error`` (a daemon
+        thread's traceback would otherwise vanish while clients block into
+        RecvTimeout with the root cause lost)."""
+        try:
+            self._serve()
+        except BaseException as e:
+            self.error = e
+            raise
+
+    def _serve(self) -> None:
+        stopped = 0
+        while stopped < self.num_clients:
+            msg = self.transport.recv(ANY_SOURCE, ANY_TAG)
+            if msg.tag == TAG_FETCH:
+                with self._lock:
+                    snapshot = self.center.copy()
+                    self.counts["fetch"] += 1
+                self.transport.send(msg.src, TAG_PARAM, snapshot)
+            elif msg.tag == TAG_PUSH_EASGD:
+                with self._lock:
+                    # elastic move toward the client (SURVEY.md §3(c) push)
+                    self.center += self.alpha * (
+                        np.asarray(msg.payload) - self.center
+                    )
+                    self.counts["push_easgd"] += 1
+            elif msg.tag == TAG_PUSH_DELTA:
+                with self._lock:
+                    self.center += self.server_lr * np.asarray(msg.payload)
+                    self.counts["push_delta"] += 1
+            elif msg.tag == TAG_STOP:
+                stopped += 1
+            else:
+                raise ValueError(f"pserver: unknown tag {msg.tag}")
+
+    def snapshot(self) -> np.ndarray:
+        with self._lock:
+            return self.center.copy()
+
+
+def spawn_server_thread(server: PServer) -> threading.Thread:
+    t = threading.Thread(target=server.start, daemon=True, name="mpit-pserver")
+    t.start()
+    return t

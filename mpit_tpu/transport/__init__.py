@@ -1,0 +1,33 @@
+"""Tagged point-to-point message transport (host-side).
+
+Reference parity (SURVEY.md §2 comp. 1, §3(b)-(c)): the reference's PS
+protocol ran on ``MPI_Send/Recv/Isend/Irecv`` with message *tags* and
+``ANY_SOURCE`` receives — semantics XLA collectives cannot express
+(SURVEY.md §7 "hard parts": no tagged p2p on TPU). This package provides
+those semantics on the host, where they belong on TPU: compute stays in
+jit-compiled XLA programs, while the asynchronous parameter-server *protocol*
+(small, latency-tolerant, order-sensitive) moves over host queues or TCP —
+the same split the reference had between Torch compute and MPI transport.
+
+Two implementations behind one interface:
+
+- :class:`InProcTransport` — ranks are threads in one process, delivery via
+  an in-memory broker. Used by the host-async PS trainer when all workers
+  share one host (the reference's single-node ``mpirun -n N`` case).
+- :class:`SocketTransport` — ranks are processes, delivery over TCP
+  (DCN-style). Rendezvous via ``MPIT_TRANSPORT_HOSTS`` or localhost ports.
+
+Ordering guarantee (matching MPI): messages between a fixed (src, dst) pair
+with the same tag are received in send order; ANY_SOURCE/ANY_TAG receives
+scan in arrival order.
+"""
+
+from mpit_tpu.transport.base import (  # noqa: F401
+    ANY_SOURCE,
+    ANY_TAG,
+    Message,
+    RecvTimeout,
+    Transport,
+)
+from mpit_tpu.transport.inproc import Broker, InProcTransport  # noqa: F401
+from mpit_tpu.transport.socket_transport import SocketTransport  # noqa: F401

@@ -1,0 +1,100 @@
+"""Transport interface: mpiT's Send/Recv/Isend/Irecv/Probe surface."""
+
+from __future__ import annotations
+
+import dataclasses
+import threading
+from typing import Any, Optional
+
+ANY_SOURCE = -1
+ANY_TAG = -1
+
+
+class RecvTimeout(Exception):
+    """recv()/probe() deadline expired (the reference would simply hang —
+    SURVEY.md §5 failure detection: 'a dead rank hangs the job')."""
+
+
+@dataclasses.dataclass
+class Message:
+    src: int
+    dst: int
+    tag: int
+    payload: Any
+
+    def matches(self, src: int, tag: int) -> bool:
+        return (src == ANY_SOURCE or src == self.src) and (
+            tag == ANY_TAG or tag == self.tag
+        )
+
+
+class SendHandle:
+    """Handle returned by isend (completes immediately for queued local
+    delivery; socket sends complete when written)."""
+
+    def __init__(self):
+        self._done = threading.Event()
+
+    def set_done(self):
+        self._done.set()
+
+    def wait(self, timeout: Optional[float] = None) -> bool:
+        ok = self._done.wait(timeout)
+        if not ok:
+            raise RecvTimeout("isend not complete before timeout")
+        return True
+
+
+class RecvHandle:
+    """Handle returned by irecv; wait() yields the Message."""
+
+    def __init__(self, fetch):
+        self._fetch = fetch
+        self._msg: Optional[Message] = None
+
+    def wait(self, timeout: Optional[float] = None) -> Message:
+        if self._msg is None:
+            self._msg = self._fetch(timeout)
+        return self._msg
+
+
+class Transport:
+    """Abstract tagged p2p transport for one rank.
+
+    mpiT surface mapping: Send/Recv/Isend/Irecv/Wait/Probe with tags and
+    ANY_SOURCE (SURVEY.md §2 L2 row). ``rank``/``size`` here are *transport*
+    ranks (host actors: pservers + pclients), distinct from the device-mesh
+    worker ids of the collective trainers.
+    """
+
+    rank: int
+    size: int
+
+    def send(self, dst: int, tag: int, payload: Any) -> None:
+        raise NotImplementedError
+
+    def recv(
+        self,
+        src: int = ANY_SOURCE,
+        tag: int = ANY_TAG,
+        timeout: Optional[float] = None,
+    ) -> Message:
+        raise NotImplementedError
+
+    def isend(self, dst: int, tag: int, payload: Any) -> SendHandle:
+        h = SendHandle()
+        self.send(dst, tag, payload)
+        h.set_done()
+        return h
+
+    def irecv(self, src: int = ANY_SOURCE, tag: int = ANY_TAG) -> RecvHandle:
+        return RecvHandle(lambda timeout: self.recv(src, tag, timeout))
+
+    def probe(
+        self, src: int = ANY_SOURCE, tag: int = ANY_TAG
+    ) -> bool:
+        """Non-blocking: is a matching message waiting?"""
+        raise NotImplementedError
+
+    def close(self) -> None:
+        pass

@@ -1,0 +1,97 @@
+"""In-process transport: ranks are threads, delivery via a shared broker.
+
+The TPU replacement for single-host ``mpirun -n N``: the reference simulated
+a cluster with N co-located MPI processes (SURVEY.md §4); here N actors are
+threads around one (or a few) accelerators, and the broker provides MPI-like
+tagged mailboxes. Python threads are fine for this: clients spend their time
+inside jit-compiled XLA computations (GIL released), and the protocol
+messages are small.
+"""
+
+from __future__ import annotations
+
+import collections
+import threading
+import time
+from typing import Any, Optional
+
+from mpit_tpu.transport.base import (
+    ANY_SOURCE,
+    ANY_TAG,
+    Message,
+    RecvTimeout,
+    Transport,
+)
+
+
+class Broker:
+    """Shared mailbox set for ``size`` ranks with MPI-like matching."""
+
+    def __init__(self, size: int):
+        self.size = size
+        self._queues = [collections.deque() for _ in range(size)]
+        self._conds = [threading.Condition() for _ in range(size)]
+
+    def put(self, msg: Message) -> None:
+        if not 0 <= msg.dst < self.size:
+            raise ValueError(f"dst {msg.dst} out of range (size {self.size})")
+        cond = self._conds[msg.dst]
+        with cond:
+            self._queues[msg.dst].append(msg)
+            cond.notify_all()
+
+    def get(
+        self,
+        dst: int,
+        src: int = ANY_SOURCE,
+        tag: int = ANY_TAG,
+        timeout: Optional[float] = None,
+    ) -> Message:
+        cond = self._conds[dst]
+        deadline = None if timeout is None else time.monotonic() + timeout
+        with cond:
+            while True:
+                q = self._queues[dst]
+                # scan in arrival order: preserves per-(src,tag) FIFO, and
+                # gives ANY_SOURCE the MPI arrival-order semantics
+                for i, msg in enumerate(q):
+                    if msg.matches(src, tag):
+                        del q[i]
+                        return msg
+                if deadline is None:
+                    cond.wait()
+                else:
+                    remaining = deadline - time.monotonic()
+                    if remaining <= 0 or not cond.wait(remaining):
+                        raise RecvTimeout(
+                            f"rank {dst}: no message from src={src} "
+                            f"tag={tag} within {timeout}s"
+                        )
+
+    def peek(self, dst: int, src: int = ANY_SOURCE, tag: int = ANY_TAG) -> bool:
+        with self._conds[dst]:
+            return any(m.matches(src, tag) for m in self._queues[dst])
+
+    def transports(self) -> list["InProcTransport"]:
+        return [InProcTransport(self, r) for r in range(self.size)]
+
+
+class InProcTransport(Transport):
+    def __init__(self, broker: Broker, rank: int):
+        self.broker = broker
+        self.rank = rank
+        self.size = broker.size
+
+    def send(self, dst: int, tag: int, payload: Any) -> None:
+        self.broker.put(Message(src=self.rank, dst=dst, tag=tag, payload=payload))
+
+    def recv(
+        self,
+        src: int = ANY_SOURCE,
+        tag: int = ANY_TAG,
+        timeout: Optional[float] = None,
+    ) -> Message:
+        return self.broker.get(self.rank, src, tag, timeout)
+
+    def probe(self, src: int = ANY_SOURCE, tag: int = ANY_TAG) -> bool:
+        return self.broker.peek(self.rank, src, tag)

@@ -1,0 +1,182 @@
+"""TCP transport: ranks are processes, tagged delivery over sockets.
+
+The DCN-style control plane for the host-async PS mode across hosts (the
+reference's multi-node MPI case, SURVEY.md §2 distributed-backend row). Data
+parallel *gradient* traffic should ride XLA collectives over ICI — this
+transport is for the PS protocol's small, latency-tolerant messages.
+
+Wire format: 8-byte big-endian length + pickle(protocol 5) of
+(src, tag, payload). Each rank listens on one port; outbound connections are
+cached per destination. A background acceptor/reader thread feeds a local
+:class:`Broker` mailbox, so recv semantics (tags, ANY_SOURCE, per-(src,tag)
+FIFO) are identical to :class:`InProcTransport`.
+
+Rendezvous: ``MPIT_TRANSPORT_HOSTS="host0:port0,host1:port1,..."`` (index =
+rank), or ``addresses=`` in the constructor; defaults to
+``127.0.0.1:(base_port+rank)`` for single-host multi-process runs.
+"""
+
+from __future__ import annotations
+
+import os
+import pickle
+import socket
+import struct
+import threading
+from typing import Any, Optional, Sequence
+
+from mpit_tpu.transport.base import ANY_SOURCE, ANY_TAG, Message, Transport
+from mpit_tpu.transport.inproc import Broker
+
+_LEN = struct.Struct(">Q")
+
+
+def _addresses(size: int, base_port: int) -> list[tuple[str, int]]:
+    env = os.environ.get("MPIT_TRANSPORT_HOSTS")
+    if env:
+        out = []
+        for part in env.split(","):
+            host, port = part.rsplit(":", 1)
+            out.append((host, int(port)))
+        if len(out) != size:
+            raise ValueError(
+                f"MPIT_TRANSPORT_HOSTS has {len(out)} entries, need {size}"
+            )
+        return out
+    return [("127.0.0.1", base_port + r) for r in range(size)]
+
+
+def _recv_exact(sock: socket.socket, n: int) -> bytes:
+    buf = bytearray()
+    while len(buf) < n:
+        chunk = sock.recv(n - len(buf))
+        if not chunk:
+            raise ConnectionError("peer closed")
+        buf.extend(chunk)
+    return bytes(buf)
+
+
+class SocketTransport(Transport):
+    def __init__(
+        self,
+        rank: int,
+        size: int,
+        base_port: int = 29_500,
+        addresses: Optional[Sequence[tuple[str, int]]] = None,
+    ):
+        self.rank = rank
+        self.size = size
+        self._addrs = (
+            list(addresses) if addresses is not None else _addresses(size, base_port)
+        )
+        # local mailbox reuses the broker's matching logic (1 "rank" = me)
+        self._mailbox = Broker(1)
+        self._out: dict[int, socket.socket] = {}
+        self._out_cache_lock = threading.Lock()  # guards the dict only
+        # per-destination lock: a slow connect/send to one rank must not
+        # serialize traffic to healthy ranks
+        self._dst_locks: dict[int, threading.Lock] = {}
+        self._closing = threading.Event()
+
+        self._listener = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._listener.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._listener.bind(self._addrs[rank])
+        self._listener.listen(size)
+        self._accept_thread = threading.Thread(
+            target=self._accept_loop, daemon=True
+        )
+        self._accept_thread.start()
+
+    # -- wire -------------------------------------------------------------
+
+    def _accept_loop(self):
+        while not self._closing.is_set():
+            try:
+                conn, _ = self._listener.accept()
+            except OSError:
+                return
+            threading.Thread(
+                target=self._read_loop, args=(conn,), daemon=True
+            ).start()
+
+    def _read_loop(self, conn: socket.socket):
+        try:
+            while not self._closing.is_set():
+                (length,) = _LEN.unpack(_recv_exact(conn, _LEN.size))
+                src, tag, payload = pickle.loads(_recv_exact(conn, length))
+                self._mailbox.put(
+                    Message(src=src, dst=0, tag=tag, payload=payload)
+                )
+        except (ConnectionError, OSError):
+            return
+
+    def _dst_lock(self, dst: int) -> threading.Lock:
+        with self._out_cache_lock:
+            lock = self._dst_locks.get(dst)
+            if lock is None:
+                lock = self._dst_locks[dst] = threading.Lock()
+            return lock
+
+    def _connection(self, dst: int) -> socket.socket:
+        """Cached outbound socket; caller must hold the dst lock."""
+        with self._out_cache_lock:
+            sock = self._out.get(dst)
+        if sock is None:
+            sock = socket.create_connection(self._addrs[dst], timeout=30)
+            # back to blocking mode: a mid-frame timeout would desync the
+            # length-prefixed stream for every later frame
+            sock.settimeout(None)
+            sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            with self._out_cache_lock:
+                self._out[dst] = sock
+        return sock
+
+    def _evict(self, dst: int) -> None:
+        with self._out_cache_lock:
+            sock = self._out.pop(dst, None)
+        if sock is not None:
+            try:
+                sock.close()
+            except OSError:
+                pass
+
+    # -- Transport API ----------------------------------------------------
+
+    def send(self, dst: int, tag: int, payload: Any) -> None:
+        blob = pickle.dumps((self.rank, tag, payload), protocol=5)
+        frame = _LEN.pack(len(blob)) + blob
+        with self._dst_lock(dst):
+            try:
+                self._connection(dst).sendall(frame)
+            except (ConnectionError, OSError):
+                # stale cached socket (peer restarted): reconnect once.
+                # Whole-frame retry is safe — the reader discards the
+                # connection on any partial frame.
+                self._evict(dst)
+                self._connection(dst).sendall(frame)
+
+    def recv(
+        self,
+        src: int = ANY_SOURCE,
+        tag: int = ANY_TAG,
+        timeout: Optional[float] = None,
+    ) -> Message:
+        msg = self._mailbox.get(0, src, tag, timeout)
+        return Message(src=msg.src, dst=self.rank, tag=msg.tag, payload=msg.payload)
+
+    def probe(self, src: int = ANY_SOURCE, tag: int = ANY_TAG) -> bool:
+        return self._mailbox.peek(0, src, tag)
+
+    def close(self) -> None:
+        self._closing.set()
+        try:
+            self._listener.close()
+        except OSError:
+            pass
+        with self._out_cache_lock:
+            for sock in self._out.values():
+                try:
+                    sock.close()
+                except OSError:
+                    pass
+            self._out.clear()

@@ -1,0 +1,87 @@
+"""Host-async PS mode tests: the reference's '2 pclient + 1 pserver' MNIST
+shape (BASELINE.json:7) with genuine thread-level asynchrony."""
+
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+from mpit_tpu.data import load_mnist
+from mpit_tpu.models import MLP
+from mpit_tpu.parallel import AsyncPSTrainer
+from mpit_tpu.parallel.pserver import partition_bounds
+
+
+@pytest.fixture(scope="module")
+def mnist():
+    return load_mnist(synthetic_train=2048, synthetic_test=512)
+
+
+def test_partition_bounds_cover_exactly():
+    b = partition_bounds(103, 4)
+    assert b[0][0] == 0 and b[-1][1] == 103
+    assert all(b[i][1] == b[i + 1][0] for i in range(3))
+
+
+def test_easgd_2client_1server_trains(mnist):
+    x_tr, y_tr, x_te, y_te = mnist
+    trainer = AsyncPSTrainer(
+        MLP(compute_dtype=jnp.float32),
+        optax.sgd(0.05, momentum=0.9),
+        num_clients=2,
+        num_servers=1,
+        algo="easgd",
+        alpha=0.5,
+        tau=4,
+    )
+    center, stats = trainer.train(x_tr, y_tr, steps=120, batch_size=64)
+    acc = trainer.evaluate(center, x_te, y_te)
+    assert acc > 0.9, f"async EASGD center failed to learn: acc={acc}, {stats['server_counts']}"
+    counts = stats["server_counts"][0]
+    # each client: one initial fetch + (steps/tau) push+fetch rounds
+    assert counts["push_easgd"] == 2 * (120 // 4)
+    assert counts["fetch"] == 2 * (120 // 4 + 1)
+
+
+def test_downpour_sharded_servers_train(mnist):
+    x_tr, y_tr, x_te, y_te = mnist
+    trainer = AsyncPSTrainer(
+        MLP(compute_dtype=jnp.float32),
+        optax.sgd(0.05),
+        num_clients=3,
+        num_servers=2,
+        algo="downpour",
+        tau=4,
+        server_lr=0.5,
+    )
+    center, stats = trainer.train(x_tr, y_tr, steps=160, batch_size=64)
+    acc = trainer.evaluate(center, x_te, y_te)
+    assert acc > 0.85, f"async Downpour failed: acc={acc}"
+    # both servers saw every client's traffic
+    for counts in stats["server_counts"]:
+        assert counts["push_delta"] == 3 * (160 // 4)
+
+
+def test_server_error_surfaces():
+    """An unknown tag kills the server; train() must raise with the cause
+    instead of burying it in a daemon thread (SURVEY.md §5 failure
+    detection: the reference just hung)."""
+    from mpit_tpu.parallel.pserver import PServer, spawn_server_thread
+    from mpit_tpu.transport import Broker
+
+    broker = Broker(2)
+    tps = broker.transports()
+    server = PServer(tps[0], np.zeros(4, np.float32), num_clients=1)
+    thread = spawn_server_thread(server)
+    tps[1].send(0, tag=999, payload=None)
+    thread.join(timeout=5)
+    assert not thread.is_alive()
+    assert isinstance(server.error, ValueError)
+    assert "unknown tag" in str(server.error)
+
+
+def test_bad_algo_and_counts_raise():
+    with pytest.raises(ValueError, match="unknown algo"):
+        AsyncPSTrainer(MLP(), optax.sgd(0.1), algo="gossip")
+    with pytest.raises(ValueError, match="at least one"):
+        AsyncPSTrainer(MLP(), optax.sgd(0.1), num_clients=0)

@@ -1,0 +1,144 @@
+"""Transport semantics tests: MPI-like matching the reference relied on
+(SURVEY.md §5 race detection: 'the PS protocol's correctness relies on MPI
+message ordering per (src,tag)' — here that guarantee gets the tests the
+reference never had)."""
+
+import multiprocessing
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from mpit_tpu.transport import (
+    ANY_SOURCE,
+    ANY_TAG,
+    Broker,
+    InProcTransport,
+    Message,
+    RecvTimeout,
+    SocketTransport,
+)
+
+
+class TestInProc:
+    def test_send_recv_roundtrip(self):
+        tps = Broker(2).transports()
+        payload = np.arange(5.0)
+        tps[0].send(1, tag=7, payload=payload)
+        msg = tps[1].recv(src=0, tag=7, timeout=1)
+        np.testing.assert_array_equal(msg.payload, payload)
+        assert msg.src == 0 and msg.tag == 7
+
+    def test_per_src_tag_fifo_order(self):
+        tps = Broker(2).transports()
+        for i in range(20):
+            tps[0].send(1, tag=3, payload=i)
+        got = [tps[1].recv(0, 3, timeout=1).payload for _ in range(20)]
+        assert got == list(range(20))
+
+    def test_any_source_any_tag(self):
+        tps = Broker(3).transports()
+        tps[0].send(2, tag=1, payload="from0")
+        tps[1].send(2, tag=9, payload="from1")
+        first = tps[2].recv(ANY_SOURCE, ANY_TAG, timeout=1)
+        second = tps[2].recv(ANY_SOURCE, ANY_TAG, timeout=1)
+        assert {first.payload, second.payload} == {"from0", "from1"}
+
+    def test_tag_selective_recv_leaves_others_queued(self):
+        tps = Broker(2).transports()
+        tps[0].send(1, tag=1, payload="a")
+        tps[0].send(1, tag=2, payload="b")
+        assert tps[1].recv(ANY_SOURCE, 2, timeout=1).payload == "b"
+        assert tps[1].recv(ANY_SOURCE, 1, timeout=1).payload == "a"
+
+    def test_probe(self):
+        tps = Broker(2).transports()
+        assert not tps[1].probe()
+        tps[0].send(1, tag=4, payload=None)
+        assert tps[1].probe(src=0, tag=4)
+        assert not tps[1].probe(src=0, tag=5)
+
+    def test_recv_timeout_raises(self):
+        tps = Broker(2).transports()
+        with pytest.raises(RecvTimeout):
+            tps[1].recv(timeout=0.05)
+
+    def test_blocking_recv_wakes_on_send(self):
+        tps = Broker(2).transports()
+        out = {}
+
+        def receiver():
+            out["msg"] = tps[1].recv(timeout=5)
+
+        t = threading.Thread(target=receiver)
+        t.start()
+        time.sleep(0.05)
+        tps[0].send(1, tag=0, payload="wake")
+        t.join(timeout=5)
+        assert out["msg"].payload == "wake"
+
+    def test_isend_irecv_wait(self):
+        tps = Broker(2).transports()
+        h = tps[0].isend(1, tag=1, payload=123)
+        h.wait(timeout=1)
+        r = tps[1].irecv(src=0, tag=1)
+        assert r.wait(timeout=1).payload == 123
+
+    def test_bad_dst_raises(self):
+        tps = Broker(2).transports()
+        with pytest.raises(ValueError, match="out of range"):
+            tps[0].send(5, tag=0, payload=None)
+
+
+def _socket_child(rank, size, base_port, q):
+    try:
+        tp = SocketTransport(rank, size, base_port=base_port)
+        # rank 1 echoes doubled arrays until it receives the stop tag 13
+        if rank == 1:
+            while True:
+                msg = tp.recv(src=0, timeout=20)
+                if msg.tag == 13:
+                    break
+                tp.send(0, tag=12, payload=np.asarray(msg.payload) * 2)
+        q.put(("ok", rank))
+        tp.close()
+    except BaseException as e:
+        q.put(("err", repr(e)))
+
+
+class TestSocket:
+    def test_cross_process_roundtrip(self):
+        ctx = multiprocessing.get_context("spawn")
+        q = ctx.Queue()
+        base_port = 29_731
+        child = ctx.Process(
+            target=_socket_child, args=(1, 2, base_port, q), daemon=True
+        )
+        child.start()
+        tp = SocketTransport(0, 2, base_port=base_port)
+        payload = np.arange(1000, dtype=np.float32)
+        # child may not be listening yet: retry connect
+        deadline = time.monotonic() + 20
+        while True:
+            try:
+                tp.send(1, tag=11, payload=payload)
+                break
+            except (ConnectionError, OSError):
+                if time.monotonic() > deadline:
+                    raise
+                time.sleep(0.1)
+        msg = tp.recv(src=1, tag=12, timeout=20)
+        np.testing.assert_array_equal(msg.payload, payload * 2)
+
+        # break the cached outbound socket: send() must evict + reconnect
+        tp._out[1].close()
+        tp.send(1, tag=11, payload=payload + 1)
+        msg = tp.recv(src=1, tag=12, timeout=20)
+        np.testing.assert_array_equal(msg.payload, (payload + 1) * 2)
+
+        tp.send(1, tag=13, payload=None)
+        status = q.get(timeout=20)
+        assert status[0] == "ok", status
+        child.join(timeout=10)
+        tp.close()
