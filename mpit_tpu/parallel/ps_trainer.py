@@ -21,6 +21,7 @@ import jax.numpy as jnp
 import numpy as np
 import optax
 
+from mpit_tpu.data.datasets import shard_for_worker
 from mpit_tpu.parallel import common
 from mpit_tpu.parallel.pclient import PClient
 from mpit_tpu.parallel.pserver import PServer, partition_bounds, spawn_server_thread
@@ -120,8 +121,8 @@ class AsyncPSTrainer:
                 tp = transports[self.num_servers + c]
                 client = PClient(tp, server_ranks, flat0.size)
                 rng = np.random.default_rng(seed + 1000 + c)
-                xs = common_shard(x, c, self.num_clients)
-                ys = common_shard(y, c, self.num_clients)
+                xs = shard_for_worker(x, c, self.num_clients)
+                ys = shard_for_worker(y, c, self.num_clients)
                 params = unflatten_params(spec, jnp.asarray(client.fetch()))
                 opt_state = self.optimizer.init(params)
                 last_pull = np.asarray(flatten_params(params)[0])
@@ -189,9 +190,3 @@ class AsyncPSTrainer:
             logits = apply(params, x[i : i + batch])
             correct += int(np.sum(np.argmax(logits, -1) == y[i : i + batch]))
         return correct / n
-
-
-def common_shard(a: np.ndarray, i: int, n: int) -> np.ndarray:
-    from mpit_tpu.data.datasets import shard_for_worker
-
-    return shard_for_worker(a, i, n)

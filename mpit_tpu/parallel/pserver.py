@@ -39,10 +39,12 @@ TAG_STOP = 5
 
 
 def partition_bounds(total: int, num_servers: int) -> list[tuple[int, int]]:
-    """Contiguous chunk [start, end) per server (np.array_split boundaries)."""
-    sizes = [len(a) for a in np.array_split(np.empty(total, np.uint8), num_servers)]
+    """Contiguous chunk [start, end) per server (np.array_split boundaries:
+    the first ``total % num_servers`` chunks get one extra element)."""
+    q, r = divmod(total, num_servers)
     bounds, start = [], 0
-    for s in sizes:
+    for i in range(num_servers):
+        s = q + (1 if i < r else 0)
         bounds.append((start, start + s))
         start += s
     return bounds

@@ -6,3 +6,12 @@ from mpit_tpu.utils.params import (  # noqa: F401
     unflatten_params,
     tree_zeros_like,
 )
+from mpit_tpu.utils.checkpoint import (  # noqa: F401
+    save_checkpoint,
+    restore_checkpoint,
+    latest_checkpoint,
+    list_checkpoints,
+)
+from mpit_tpu.utils.config import TrainConfig, PRESETS  # noqa: F401
+from mpit_tpu.utils.metrics import MetricsLogger, Throughput  # noqa: F401
+from mpit_tpu.utils.profiling import StepTimer, annotate, trace  # noqa: F401

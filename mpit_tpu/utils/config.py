@@ -1,0 +1,134 @@
+"""Run configuration: one dataclass + argparse, nothing heavier.
+
+Reference parity (SURVEY.md §5): the reference's config system was a plain
+Lua ``conf``/``opt`` table in ``ptest.lua`` (lr, τ, α, #servers, batch size).
+Match that simplicity: a flat dataclass whose fields are the union of what
+the five baseline configs need, an argparse bridge generated from the fields,
+and JSON (de)serialization for reproducibility (the config is stamped into
+checkpoints/metrics).
+"""
+
+from __future__ import annotations
+
+import argparse
+import dataclasses
+import json
+from typing import Optional
+
+
+@dataclasses.dataclass
+class TrainConfig:
+    # what to run
+    preset: Optional[str] = None  # one of PRESETS, or None for flag-driven
+    model: str = "lenet"
+    dataset: str = "mnist"
+    algo: str = "easgd"  # easgd | downpour | sync | ps-easgd | ps-downpour
+    # optimization (reference conf table: lr, τ, α — SURVEY.md §5)
+    lr: float = 0.05
+    momentum: float = 0.9
+    tau: int = 4
+    alpha: Optional[float] = None  # None -> 0.9/W (EASGD paper rule)
+    staleness: int = 0
+    # scale
+    global_batch: int = 256
+    epochs: int = 3
+    train_size: int = 8192
+    clients: int = 2  # ps-* algos
+    servers: int = 1
+    steps: int = 200  # ps-* algos: local steps per client
+    # sequence models
+    seq_len: int = 32
+    # plumbing
+    seed: int = 0
+    log_every: int = 0
+    metrics_path: Optional[str] = None
+    ckpt_dir: Optional[str] = None
+    ckpt_every: int = 0  # rounds/steps between checkpoints (0 = off)
+    resume: bool = False
+    profile_dir: Optional[str] = None
+
+    def to_json(self) -> str:
+        return json.dumps(dataclasses.asdict(self))
+
+    @classmethod
+    def from_json(cls, s: str) -> "TrainConfig":
+        return cls(**json.loads(s))
+
+    @classmethod
+    def parser(cls, description: str = "") -> argparse.ArgumentParser:
+        """Argparse bridge: one ``--flag`` per field (underscores → dashes).
+
+        Every flag defaults to ``argparse.SUPPRESS``, so the parsed namespace
+        contains exactly the flags the user typed — "passed the default
+        value" and "not passed" stay distinguishable for preset overlay."""
+        p = argparse.ArgumentParser(description=description)
+        for f in dataclasses.fields(cls):
+            flag = "--" + f.name.replace("_", "-")
+            if f.type == "bool" or isinstance(f.default, bool):
+                p.add_argument(
+                    flag, action="store_true", default=argparse.SUPPRESS
+                )
+            else:
+                typ = {
+                    "int": int, "float": float, "str": str,
+                    "Optional[int]": int, "Optional[float]": float,
+                    "Optional[str]": str,
+                }.get(str(f.type), str)
+                p.add_argument(flag, type=typ, default=argparse.SUPPRESS)
+        return p
+
+    @classmethod
+    def from_args(cls, argv=None, description: str = "") -> "TrainConfig":
+        """defaults < preset < explicitly-typed flags."""
+        supplied = vars(cls.parser(description).parse_args(argv))
+        cfg = cls()
+        if "preset" in supplied:
+            cfg = cfg.apply_preset(supplied["preset"])
+        return dataclasses.replace(cfg, **supplied)
+
+    def apply_preset(self, name: str):
+        """Overlay a named baseline config on this config."""
+        if name not in PRESETS:
+            raise ValueError(
+                f"unknown preset {name!r}; have {sorted(PRESETS)}"
+            )
+        return dataclasses.replace(self, preset=name, **PRESETS[name])
+
+
+# The five driver-defined workload configs (BASELINE.md table; BASELINE.json
+# lines 7-11). Scales are trimmed-down by default so every preset runs on the
+# CPU-simulated mesh; pass bigger --train-size/--epochs on real hardware.
+PRESETS: dict[str, dict] = {
+    # 1: MNIST LeNet async-SGD — the reference's bundled ptest example
+    "mnist-easgd": dict(
+        model="lenet", dataset="mnist", algo="easgd",
+        lr=0.05, momentum=0.9, tau=4, global_batch=256, epochs=3,
+    ),
+    # the literal 2-pclient + 1-pserver shape of the reference example
+    "mnist-ps": dict(
+        model="lenet", dataset="mnist", algo="ps-easgd",
+        clients=2, servers=1, steps=200, tau=4, lr=0.05,
+    ),
+    # 2: CIFAR-10 VGG-small, sync allreduce DP, 8 workers
+    "cifar-vgg-sync": dict(
+        model="vgg", dataset="cifar10", algo="sync",
+        lr=0.02, momentum=0.9, global_batch=256, epochs=3,
+    ),
+    # 3: ImageNet AlexNet, Downpour model-averaging
+    "alexnet-downpour": dict(
+        model="alexnet", dataset="imagenet", algo="downpour",
+        lr=0.01, momentum=0.9, tau=4, staleness=1,
+        global_batch=128, epochs=1, train_size=1024,
+    ),
+    # 4: ImageNet ResNet-50, sync allreduce (large-tensor collective stress)
+    "resnet50-sync": dict(
+        model="resnet50", dataset="imagenet", algo="sync",
+        lr=0.1, momentum=0.9, global_batch=64, epochs=1, train_size=512,
+    ),
+    # 5: PTB LSTM EASGD (small frequent async updates, non-vision)
+    "ptb-lstm-easgd": dict(
+        model="lstm", dataset="ptb", algo="easgd",
+        lr=1.0, momentum=0.0, tau=4, global_batch=128, epochs=1,
+        seq_len=32,
+    ),
+}

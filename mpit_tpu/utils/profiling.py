@@ -1,0 +1,83 @@
+"""Tracing / profiling hooks.
+
+Reference parity (SURVEY.md §5): the reference had only ad-hoc tic/toc timers
+and prints. TPU plan from the survey: ``jax.profiler`` trace hooks plus
+per-step wall-clock counters — a captured trace opens in
+Perfetto/TensorBoard and shows the XLA op timeline, ICI collectives
+included, which is the observability the MPI version never had.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import time
+from typing import Iterator, Optional
+
+import jax
+
+
+@contextlib.contextmanager
+def trace(log_dir: Optional[str]) -> Iterator[None]:
+    """Capture a jax profiler trace into ``log_dir`` (no-op when None), so
+    call sites can unconditionally wrap their hot loop."""
+    if not log_dir:
+        yield
+        return
+    with jax.profiler.trace(log_dir):
+        yield
+
+
+def annotate(name: str):
+    """Named region on the host trace timeline (wrap a step or a phase)."""
+    return jax.profiler.TraceAnnotation(name)
+
+
+class StepTimer:
+    """Wall-clock timer for jitted step loops.
+
+    Measures *completed* work: call ``stop()`` with (or after) a
+    ``block_until_ready`` on the step output, otherwise async dispatch makes
+    steps look free. Keeps a skip-count so compile steps don't pollute the
+    stats."""
+
+    def __init__(self, skip_first: int = 1):
+        self.skip_first = skip_first
+        self._times: list[float] = []
+        self._seen = 0
+        self._t0: Optional[float] = None
+
+    def start(self) -> None:
+        self._t0 = time.perf_counter()
+
+    def stop(self, result=None) -> float:
+        """Blocks on ``result`` (if given), records the elapsed time.
+        Returns the step's wall seconds."""
+        if result is not None:
+            jax.block_until_ready(result)
+        if self._t0 is None:
+            raise RuntimeError("StepTimer.stop() without start()")
+        dt = time.perf_counter() - self._t0
+        self._t0 = None
+        self._seen += 1
+        if self._seen > self.skip_first:
+            self._times.append(dt)
+        return dt
+
+    @property
+    def mean(self) -> float:
+        return sum(self._times) / len(self._times) if self._times else float("nan")
+
+    @property
+    def count(self) -> int:
+        return len(self._times)
+
+    def summary(self) -> dict:
+        if not self._times:
+            return {"steps": 0}
+        ts = sorted(self._times)
+        return {
+            "steps": len(ts),
+            "mean_s": self.mean,
+            "p50_s": ts[len(ts) // 2],
+            "max_s": ts[-1],
+        }
