@@ -4,153 +4,57 @@ Reference parity (SURVEY.md §2 comp. 6, BASELINE.json:7): the reference's
 ``asyncsgd/ptest.lua`` was launched as ``mpirun -n 3 th ptest.lua`` and split
 ranks into 2 pclients + 1 pserver training LeNet on MNIST. Here there is no
 mpirun and no rank split: the worker "processes" are the devices of the TPU
-slice (or a CPU-simulated mesh), and the algorithm is chosen by flag.
+slice (or a CPU-simulated mesh), and the algorithm is chosen by flag. All
+flags come from :class:`mpit_tpu.utils.TrainConfig` (see
+``examples/train.py`` for the preset-driven superset CLI).
 
 Run on the simulated mesh:
   XLA_FLAGS=--xla_force_host_platform_device_count=8 JAX_PLATFORMS=cpu \
     python examples/ptest.py --algo easgd --epochs 3
 
 Run on TPU hardware: python examples/ptest.py --algo easgd
+The reference's literal shape: python examples/ptest.py --algo ps-easgd
 """
 
-import argparse
 import os
 import sys
-import time
 
-# allow running straight from a checkout: examples/.. is the package root
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 
 def main():
-    p = argparse.ArgumentParser(description=__doc__)
-    p.add_argument("--algo",
-                   choices=["easgd", "downpour", "sync",
-                            "ps-easgd", "ps-downpour"],
-                   default="easgd",
-                   help="easgd/downpour/sync = collective trainers (fast "
-                        "path); ps-* = host-async pserver/pclient fidelity "
-                        "mode (the reference's literal 2-pclient+1-pserver "
-                        "shape)")
-    p.add_argument("--clients", type=int, default=2,
-                   help="pclients (ps-* algos; reference default 2)")
-    p.add_argument("--servers", type=int, default=1,
-                   help="pservers (ps-* algos; reference default 1)")
-    p.add_argument("--steps", type=int, default=200,
-                   help="local steps per client (ps-* algos)")
-    p.add_argument("--model", default="lenet")
-    p.add_argument("--lr", type=float, default=0.05)
-    p.add_argument("--momentum", type=float, default=0.9)
-    p.add_argument("--tau", type=int, default=4,
-                   help="communication period (EASGD/Downpour)")
-    p.add_argument("--alpha", type=float, default=None,
-                   help="elastic coupling (default: 0.9/W per the paper)")
-    p.add_argument("--staleness", type=int, default=0)
-    p.add_argument("--global-batch", type=int, default=256)
-    p.add_argument("--epochs", type=int, default=3)
-    p.add_argument("--train-size", type=int, default=8192)
-    p.add_argument("--log-every", type=int, default=0)
-    args = p.parse_args()
+    from mpit_tpu.utils.config import TrainConfig
+
+    cfg = TrainConfig.from_args(description=__doc__)
+    if cfg.preset is None and cfg.dataset != "mnist":
+        raise SystemExit(
+            "ptest is the MNIST example; use examples/train.py for other "
+            "datasets"
+        )
 
     import jax
 
-    # honor an explicit JAX_PLATFORMS even when a sitecustomize pre-registered
-    # a hardware backend at interpreter start (see tests/conftest.py)
     if os.environ.get("JAX_PLATFORMS"):
         jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
 
-    import optax
+    from mpit_tpu.run import run
 
-    import mpit_tpu
-    from mpit_tpu.data import Batches, load_mnist
-    from mpit_tpu.models import get_model
-    from mpit_tpu.parallel import (
-        DataParallelTrainer,
-        DownpourTrainer,
-        EASGDTrainer,
-    )
-
-    topo = mpit_tpu.init()
-    print(
-        f"[ptest] world: {topo.num_workers} workers on {topo.platform} "
-        f"(process {topo.process_index}/{topo.process_count})"
-    )
-    x_tr, y_tr, x_te, y_te = load_mnist(synthetic_train=args.train_size)
-    model = get_model(args.model)
-    opt = optax.sgd(args.lr, momentum=args.momentum)
-
-    if args.algo.startswith("ps-"):
-        from mpit_tpu.parallel import AsyncPSTrainer
-
-        # same default coupling rule as the collective path: alpha = 0.9/W
-        # with W = number of clients
-        ps_alpha = (
-            args.alpha if args.alpha is not None else 0.9 / args.clients
-        )
-        trainer = AsyncPSTrainer(
-            model, opt,
-            num_clients=args.clients, num_servers=args.servers,
-            algo=args.algo.removeprefix("ps-"),
-            alpha=ps_alpha,
-            tau=args.tau,
-        )
-        per_client_batch = max(args.global_batch // args.clients, 1)
-        t0 = time.perf_counter()
-        center, stats = trainer.train(
-            x_tr, y_tr, steps=args.steps, batch_size=per_client_batch
-        )
-        dt = time.perf_counter() - t0
-        acc = trainer.evaluate(center, x_te, y_te)
-        samples = args.steps * per_client_batch * args.clients
+    r = run(cfg)
+    if cfg.algo.startswith("ps-"):
         print(
-            f"[ptest] {args.algo} ({args.clients} pclients + "
-            f"{args.servers} pservers): test acc={acc:.4f} "
-            f"loss={stats['mean_final_loss']:.4f} wall={dt:.1f}s "
-            f"({samples / dt:.0f} samples/sec) "
-            f"server_counts={stats['server_counts']}"
+            f"[ptest] {cfg.algo} ({r['clients']} pclients + {r['servers']} "
+            f"pservers): test acc={r['accuracy']:.4f} "
+            f"loss={r['final_loss']:.4f} wall={r['wall_s']:.1f}s "
+            f"({r['samples_per_sec']:.0f} samples/sec) "
+            f"server_counts={r['server_counts']}"
         )
-        return
-
-    if args.algo == "easgd":
-        trainer = EASGDTrainer(model, opt, topo, alpha=args.alpha,
-                               tau=args.tau)
-    elif args.algo == "downpour":
-        trainer = DownpourTrainer(model, opt, topo, tau=args.tau,
-                                  staleness=args.staleness)
     else:
-        trainer = DataParallelTrainer(model, opt, topo)
-
-    gb = max((args.global_batch // topo.num_workers), 1) * topo.num_workers
-    if gb != args.global_batch:
         print(
-            f"[ptest] global batch {args.global_batch} -> {gb} "
-            f"(must divide across {topo.num_workers} workers)"
+            f"[ptest] {cfg.algo}: test acc={r['accuracy']:.4f} "
+            f"loss={r['final_loss']:.4f} wall={r['wall_s']:.1f}s "
+            f"({r['samples_per_sec']:.0f} samples/sec, "
+            f"{r['samples_per_sec_per_chip']:.0f} per worker)"
         )
-    state = trainer.init_state(jax.random.key(0), x_tr[:2])
-    batches = Batches(x_tr, y_tr, global_batch=gb, seed=0)
-
-    t0 = time.perf_counter()
-    state, metrics = trainer.fit(
-        batches, state, epochs=args.epochs, log_every=args.log_every
-    )
-    dt = time.perf_counter() - t0
-
-    if args.algo == "sync":
-        acc, _ = trainer.evaluate(state, x_te, y_te)
-        trained_steps = args.epochs * batches.steps_per_epoch()
-    else:
-        acc = trainer.evaluate(state, x_te, y_te)
-        # round trainers drop the trailing < tau buffer; count what trained
-        trained_steps = (
-            args.epochs * batches.steps_per_epoch() // args.tau
-        ) * args.tau
-    samples = trained_steps * gb
-    print(
-        f"[ptest] {args.algo}: test acc={acc:.4f} "
-        f"loss={float(metrics['loss']):.4f} wall={dt:.1f}s "
-        f"({samples / dt:.0f} samples/sec, "
-        f"{samples / dt / topo.num_workers:.0f} per worker)"
-    )
 
 
 if __name__ == "__main__":

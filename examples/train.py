@@ -1,0 +1,45 @@
+"""train — unified CLI over every BASELINE workload config.
+
+The reference shipped one example script per workload (SURVEY.md §2 comp. 6);
+here one CLI + presets covers them all (BASELINE.md table):
+
+  python examples/train.py --preset mnist-easgd        # config 1 (collective)
+  python examples/train.py --preset mnist-ps           # config 1 (literal
+                                                       #   2 pclient+1 pserver)
+  python examples/train.py --preset cifar-vgg-sync     # config 2
+  python examples/train.py --preset alexnet-downpour   # config 3
+  python examples/train.py --preset resnet50-sync      # config 4
+  python examples/train.py --preset ptb-lstm-easgd     # config 5
+
+Any flag overrides its preset value (e.g. ``--epochs 10 --lr 0.1``). On the
+CPU-simulated mesh, prefix with:
+  XLA_FLAGS=--xla_force_host_platform_device_count=8 JAX_PLATFORMS=cpu
+"""
+
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main():
+    from mpit_tpu.utils.config import TrainConfig
+
+    cfg = TrainConfig.from_args(description=__doc__)
+
+    import jax
+
+    if os.environ.get("JAX_PLATFORMS"):
+        # honor an explicit platform choice even when a sitecustomize
+        # pre-registered a hardware backend at interpreter start
+        jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
+
+    from mpit_tpu.run import run
+
+    results = run(cfg)
+    print(json.dumps(results, default=repr))
+
+
+if __name__ == "__main__":
+    main()

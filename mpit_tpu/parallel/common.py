@@ -100,36 +100,66 @@ class RoundTrainer:
         xr, yr = self.round_batches(np.asarray(x_round), np.asarray(y_round))
         return self._round(state, xr, yr)
 
-    def fit(self, batches, state, epochs: int = 1, log_every: int = 0):
-        """Epoch loop grouping minibatches into τ-rounds. A trailing group
-        smaller than τ is dropped (SPMD rounds have a fixed shape); raises if
-        that leaves zero full rounds, rather than silently doing nothing."""
-        buf_x, buf_y, metrics = [], [], None
+    def rounds_per_epoch(self, batches) -> int:
+        return batches.steps_per_epoch() // self.tau
+
+    def fit(
+        self,
+        batches,
+        state,
+        epochs: int = 1,
+        log_every: int = 0,
+        start_epoch: int = 0,
+        skip_rounds: int = 0,
+        on_round=None,
+    ):
+        """Epoch loop grouping minibatches into τ-rounds. Per epoch, a
+        trailing group smaller than τ is dropped (SPMD rounds have a fixed
+        shape — and *per-epoch* dropping keeps the round↔epoch arithmetic
+        exact for checkpoint/resume); raises if that leaves zero full rounds.
+
+        Resume: ``start_epoch``/``skip_rounds`` re-enter the deterministic
+        data schedule mid-stream — epoch ``e`` always reuses the same
+        permutation (``Batches`` seeds by epoch index), and the first
+        ``skip_rounds`` round-groups of ``start_epoch`` are consumed without
+        training. ``on_round(rounds_done, state, metrics)`` fires after every
+        trained round."""
+        if self.rounds_per_epoch(batches) == 0:
+            raise ValueError(
+                f"epoch of {batches.steps_per_epoch()} step(s) < "
+                f"tau={self.tau}: no full rounds"
+            )
+        metrics = None
         rounds = 0
-        for e in range(epochs):
+        dropped = 0
+        for e in range(start_epoch, epochs):
+            buf_x, buf_y = [], []
+            to_skip = skip_rounds if e == start_epoch else 0
             for x, y in batches.epoch(e):
                 buf_x.append(x)
                 buf_y.append(y)
-                if len(buf_x) == self.tau:
+                if len(buf_x) < self.tau:
+                    continue
+                if to_skip > 0:
+                    to_skip -= 1
+                else:
                     state, metrics = self.step(
                         state, np.stack(buf_x), np.stack(buf_y)
                     )
-                    buf_x, buf_y = [], []
                     rounds += 1
+                    if on_round is not None:
+                        on_round(rounds, state, metrics)
                     if log_every and rounds % log_every == 0:
                         print(
                             f"[{self._log_tag}] round={rounds} "
                             f"loss={float(metrics['loss']):.4f}"
                         )
-        if rounds == 0:
-            raise ValueError(
-                f"fit() produced no full rounds: {epochs} epoch(s) of "
-                f"{batches.steps_per_epoch()} step(s) < tau={self.tau}"
-            )
-        if buf_x:
+                buf_x, buf_y = [], []
+            dropped += len(buf_x)
+        if dropped:
             print(
-                f"[{self._log_tag}] dropped {len(buf_x)} trailing batch(es) "
-                f"(< tau={self.tau})"
+                f"[{self._log_tag}] dropped {dropped} trailing batch(es) "
+                f"across epochs (< tau={self.tau})"
             )
         return state, metrics
 
@@ -142,10 +172,13 @@ class RoundTrainer:
                 "model=None (loss-only math mode)"
             )
         w = self.topo.num_workers
-        batch = (batch // w) * w or w
+        batch = (min(batch, len(x)) // w) * w or w
         n = (len(x) // batch) * batch
         if n == 0:
-            raise ValueError("eval set smaller than one global batch")
+            raise ValueError(
+                f"eval set of {len(x)} smaller than one per-worker sample "
+                f"each across {w} workers"
+            )
         correct = 0
         center = self.center_params(state)
         for i in range(0, n, batch):

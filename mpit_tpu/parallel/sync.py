@@ -117,7 +117,7 @@ class DataParallelTrainer:
     def evaluate(self, state, x, y, batch: int = 1024):
         """Full-dataset eval; returns (accuracy, mean_loss)."""
         w = self.topo.num_workers
-        batch = (batch // w) * w or w
+        batch = (min(batch, len(x)) // w) * w or w
         n = (len(x) // batch) * batch
         correct = 0
         loss_sum = 0.0
@@ -131,13 +131,33 @@ class DataParallelTrainer:
             raise ValueError("eval set smaller than one global batch")
         return correct / n, loss_sum / n
 
-    def fit(self, batches, state, epochs: int = 1, log_every: int = 0):
+    def fit(
+        self,
+        batches,
+        state,
+        epochs: int = 1,
+        log_every: int = 0,
+        start_epoch: int = 0,
+        skip_steps: int = 0,
+        on_step=None,
+    ):
         """Epoch loop over a :class:`mpit_tpu.data.Batches`. Returns
-        (state, last_metrics)."""
+        (state, last_metrics). ``start_epoch``/``skip_steps`` re-enter the
+        deterministic data schedule for resume (epoch index seeds the
+        permutation); ``on_step(steps_done, state, metrics)`` fires after
+        every trained step."""
         metrics = None
-        for e in range(epochs):
+        steps = 0
+        for e in range(start_epoch, epochs):
+            to_skip = skip_steps if e == start_epoch else 0
             for x, y in batches.epoch(e):
+                if to_skip > 0:
+                    to_skip -= 1
+                    continue
                 state, metrics = self.step(state, x, y)
+                steps += 1
+                if on_step is not None:
+                    on_step(steps, state, metrics)
                 if log_every and int(state.step) % log_every == 0:
                     print(
                         f"[sync-dp] step={int(state.step)} "

@@ -1,0 +1,248 @@
+"""Training driver: one function from :class:`TrainConfig` to results.
+
+This is the framework's equivalent of the reference's example-script layer
+(SURVEY.md §2 comp. 6) factored into the library, so every BASELINE workload
+config is one preset away and the example CLIs stay thin. The loop wires in
+everything the reference lacked (SURVEY.md §5): JSONL metrics, step timing,
+profiler traces, checkpoint/resume.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Optional
+
+import numpy as np
+
+from mpit_tpu.utils.config import TrainConfig
+
+
+def _load_dataset(cfg: TrainConfig):
+    """(x_train, y_train, x_test, y_test, meta) for the config's dataset;
+    ``meta`` carries dataset facts the model needs (e.g. vocab_size)."""
+    from mpit_tpu.data import (
+        load_cifar10,
+        load_imagenet_like,
+        load_mnist,
+    )
+
+    if cfg.dataset == "mnist":
+        return (*load_mnist(synthetic_train=cfg.train_size), {})
+    if cfg.dataset == "cifar10":
+        return (*load_cifar10(synthetic_train=cfg.train_size), {})
+    if cfg.dataset == "imagenet":
+        return (
+            *load_imagenet_like(
+                synthetic_train=cfg.train_size,
+                synthetic_test=max(cfg.train_size // 4, 64),
+                image_size=cfg.image_size,
+            ),
+            {},
+        )
+    if cfg.dataset == "ptb":
+        return _ptb_windows(cfg)
+    raise ValueError(f"unknown dataset {cfg.dataset!r}")
+
+
+def _ptb_windows(cfg: TrainConfig):
+    """Token stream → (N, T) next-token windows: x=tokens[i:i+T],
+    y=tokens[i+1:i+T+1] (the LM objective over fixed-length unrolls)."""
+    from mpit_tpu.data import load_ptb
+
+    t_len = cfg.seq_len
+    need = (cfg.train_size + 1) * t_len + 1
+    train_toks, valid_toks, vocab = load_ptb(
+        synthetic_tokens=max(need + need // 8, 20_000)
+    )
+
+    def windows(toks: np.ndarray):
+        n = (len(toks) - 1) // t_len
+        x = toks[: n * t_len].reshape(n, t_len)
+        y = toks[1 : n * t_len + 1].reshape(n, t_len)
+        return x.astype(np.int32), y.astype(np.int32)
+
+    x_tr, y_tr = windows(train_toks)
+    x_va, y_va = windows(valid_toks)
+    return (
+        x_tr[: cfg.train_size],
+        y_tr[: cfg.train_size],
+        x_va,
+        y_va,
+        {"vocab_size": vocab},
+    )
+
+
+def _build_model(cfg: TrainConfig, meta: dict):
+    from mpit_tpu.models import get_model
+
+    if cfg.model in ("lstm", "lstm_lm", "ptb_lstm"):
+        return get_model(cfg.model, vocab_size=meta.get("vocab_size", 10_000))
+    return get_model(cfg.model)
+
+
+def run(cfg: TrainConfig) -> dict:
+    """Train per ``cfg``; returns a results dict (acc, loss, throughput...).
+
+    ``mpit_tpu.init()`` must not have been pinned to a conflicting world —
+    the driver calls ``init()`` itself (idempotent if already initialized).
+    """
+    import jax
+    import optax
+
+    import mpit_tpu
+    from mpit_tpu.data import Batches
+    from mpit_tpu.utils import (
+        MetricsLogger,
+        latest_checkpoint,
+        restore_checkpoint,
+        save_checkpoint,
+        trace,
+    )
+
+    topo = mpit_tpu.init()
+    x_tr, y_tr, x_te, y_te, meta = _load_dataset(cfg)
+    is_seq = cfg.dataset == "ptb"
+    model = _build_model(cfg, meta)
+    opt = optax.sgd(cfg.lr, momentum=cfg.momentum)
+
+    log = MetricsLogger(path=cfg.metrics_path, tag=cfg.algo, echo=False)
+    results: dict = {"config": cfg.to_json(), "workers": topo.num_workers,
+                     "platform": topo.platform}
+
+    if cfg.algo.startswith("ps-"):
+        return _run_async_ps(cfg, model, opt, x_tr, y_tr, x_te, y_te,
+                             log, results)
+
+    from mpit_tpu.parallel import (
+        DataParallelTrainer,
+        DownpourTrainer,
+        EASGDTrainer,
+    )
+
+    if cfg.algo == "easgd":
+        trainer = EASGDTrainer(model, opt, topo, alpha=cfg.alpha, tau=cfg.tau)
+    elif cfg.algo == "downpour":
+        trainer = DownpourTrainer(model, opt, topo, tau=cfg.tau,
+                                  staleness=cfg.staleness)
+    elif cfg.algo == "sync":
+        trainer = DataParallelTrainer(model, opt, topo)
+    else:
+        raise ValueError(f"unknown algo {cfg.algo!r}")
+
+    gb = max(cfg.global_batch // topo.num_workers, 1) * topo.num_workers
+    state = trainer.init_state(jax.random.key(cfg.seed), x_tr[:2])
+
+    start_unit = 0
+    if cfg.resume and cfg.ckpt_dir:
+        template = state
+        shardings = jax.tree.map(lambda a: a.sharding, template)
+        state, step = restore_checkpoint(cfg.ckpt_dir, template,
+                                         shardings=shardings)
+        if step is not None:
+            start_unit = step
+            results["resumed_from"] = step
+
+    batches = Batches(x_tr, y_tr, global_batch=gb, seed=cfg.seed)
+    is_sync = cfg.algo == "sync"
+    tau = 1 if is_sync else cfg.tau
+    units_per_epoch = batches.steps_per_epoch() // tau
+    if units_per_epoch == 0:
+        raise ValueError(
+            f"epoch of {batches.steps_per_epoch()} step(s) cannot fill one "
+            f"{'step' if is_sync else f'round of tau={tau}'}"
+        )
+    # resume re-enters the SAME deterministic data schedule: unit counters
+    # map back to (epoch, offset); cfg.epochs is total, not additional
+    start_epoch, skip_units = divmod(start_unit, units_per_epoch)
+    unit = start_unit  # steps (sync) or rounds (easgd/downpour)
+    metrics = None
+
+    def on_unit(_done, st, m):
+        nonlocal unit, metrics
+        unit += 1
+        metrics = m
+        if cfg.log_every and unit % cfg.log_every == 0:
+            log.log(unit, loss=m["loss"])
+        if cfg.ckpt_dir and cfg.ckpt_every and unit % cfg.ckpt_every == 0:
+            save_checkpoint(cfg.ckpt_dir, st, step=unit,
+                            metadata={"config": cfg.to_json()})
+
+    t_start = time.perf_counter()
+    with trace(cfg.profile_dir):
+        if is_sync:
+            state, metrics = trainer.fit(
+                batches, state, epochs=cfg.epochs, start_epoch=start_epoch,
+                skip_steps=skip_units, on_step=on_unit,
+            )
+        else:
+            state, metrics = trainer.fit(
+                batches, state, epochs=cfg.epochs, start_epoch=start_epoch,
+                skip_rounds=skip_units, on_round=on_unit,
+            )
+        if metrics is not None:
+            jax.block_until_ready(metrics["loss"])
+    wall = time.perf_counter() - t_start
+    trained = unit - start_unit
+    samples = trained * tau * gb
+    if cfg.ckpt_dir and trained:
+        save_checkpoint(cfg.ckpt_dir, state, step=unit,
+                        metadata={"config": cfg.to_json()})
+
+    if is_sync:
+        acc, eval_loss = trainer.evaluate(state, x_te, y_te)
+        results["eval_loss"] = eval_loss
+    else:
+        acc = trainer.evaluate(state, x_te, y_te)
+    if is_seq:
+        acc = acc / cfg.seq_len  # eval counts correct *tokens* per window
+    results.update(
+        accuracy=acc,
+        final_loss=float(metrics["loss"]) if metrics is not None else None,
+        trained_units=trained,
+        samples=samples,
+        wall_s=wall,
+        samples_per_sec=samples / wall,
+        samples_per_sec_per_chip=samples / wall / topo.num_workers,
+        step_time={"steps": trained,
+                   "mean_s": wall / trained if trained else None},
+        last_checkpoint=(latest_checkpoint(cfg.ckpt_dir)
+                         if cfg.ckpt_dir else None),
+    )
+    log.close()
+    return results
+
+
+def _run_async_ps(cfg, model, opt, x_tr, y_tr, x_te, y_te, log, results):
+    """The reference's literal pclient/pserver shape (BASELINE.json:7)."""
+    from mpit_tpu.parallel import AsyncPSTrainer
+
+    alpha = cfg.alpha if cfg.alpha is not None else 0.9 / cfg.clients
+    trainer = AsyncPSTrainer(
+        model, opt,
+        num_clients=cfg.clients, num_servers=cfg.servers,
+        algo=cfg.algo.removeprefix("ps-"),
+        alpha=alpha, tau=cfg.tau,
+    )
+    per_client = max(cfg.global_batch // cfg.clients, 1)
+    t0 = time.perf_counter()
+    center, stats = trainer.train(
+        x_tr, y_tr, steps=cfg.steps, batch_size=per_client, seed=cfg.seed
+    )
+    wall = time.perf_counter() - t0
+    acc = trainer.evaluate(center, x_te, y_te)
+    if cfg.dataset == "ptb":
+        acc = acc / cfg.seq_len
+    samples = cfg.steps * per_client * cfg.clients
+    log.log(cfg.steps, loss=stats["mean_final_loss"], accuracy=acc)
+    results.update(
+        accuracy=acc,
+        final_loss=stats["mean_final_loss"],
+        server_counts=stats["server_counts"],
+        samples=samples,
+        wall_s=wall,
+        samples_per_sec=samples / wall,
+        clients=cfg.clients,
+        servers=cfg.servers,
+    )
+    log.close()
+    return results

@@ -6,19 +6,22 @@ parallel *gradient* traffic should ride XLA collectives over ICI — this
 transport is for the PS protocol's small, latency-tolerant messages.
 
 Wire format: 8-byte big-endian length + pickle(protocol 5) of
-(src, epoch, tag, payload). Each rank listens on one port; outbound
-connections are cached per destination. A background acceptor/reader thread
-feeds a local :class:`Broker` mailbox, so recv semantics (tags, ANY_SOURCE,
-per-(src,tag) FIFO) are identical to :class:`InProcTransport`.
+(src, tag, payload). Each rank listens on one port; outbound connections are
+cached per destination. A background acceptor/reader thread feeds a local
+:class:`Broker` mailbox, so recv semantics (tags, ANY_SOURCE, per-(src,tag)
+FIFO) are identical to :class:`InProcTransport`.
 
-Reconnect semantics: ``epoch`` counts the sender's reconnects to this
-destination. TCP gives FIFO within one connection; across a reconnect, a
-straggler frame from the old connection could otherwise be enqueued *after*
-frames of the new one and break per-(src,tag) FIFO. The receiver therefore
-tracks the highest epoch seen per src and drops late lower-epoch frames —
-order is preserved at the cost of dropping stragglers, which matches MPI's
-model (a broken connection loses in-flight traffic; a dead rank is fatal,
-SURVEY.md §5 failure-detection row) rather than silently reordering.
+Reconnect semantics: TCP gives FIFO within one connection; across a sender
+reconnect, a straggler frame from the old connection could otherwise be
+enqueued *after* frames of the new one and break per-(src,tag) FIFO. The
+receiver therefore orders connections by accept sequence and, once a frame
+from a src arrives on a newer connection, drops late frames from that src's
+older connections — order is preserved at the cost of dropping stragglers,
+which matches MPI's model (a broken connection loses in-flight traffic; a
+dead rank is fatal, SURVEY.md §5 failure-detection row) rather than silently
+reordering. The fence is entirely receiver-side accept ordering, so a fully
+*restarted* sender (fresh transport object) keeps working — its new
+connection is by construction newer than any it had before.
 
 Rendezvous: ``MPIT_TRANSPORT_HOSTS="host0:port0,host1:port1,..."`` (index =
 rank), or ``addresses=`` in the constructor; defaults to
@@ -80,11 +83,11 @@ class SocketTransport(Transport):
         )
         # local mailbox reuses the broker's matching logic (1 "rank" = me)
         self._mailbox = Broker(1)
-        # highest sender-connection epoch seen per src (reconnect fencing)
-        self._src_epochs: dict[int, int] = {}
-        self._src_epochs_lock = threading.Lock()
+        # reconnect fencing: newest accept-ordered connection seq per src
+        self._accept_seq = 0
+        self._src_seq: dict[int, int] = {}
+        self._src_seq_lock = threading.Lock()
         self._out: dict[int, socket.socket] = {}
-        self._out_epoch: dict[int, int] = {}
         self._out_cache_lock = threading.Lock()  # guards the dict only
         # per-destination lock: a slow connect/send to one rank must not
         # serialize traffic to healthy ranks
@@ -108,22 +111,23 @@ class SocketTransport(Transport):
                 conn, _ = self._listener.accept()
             except OSError:
                 return
+            with self._src_seq_lock:
+                self._accept_seq += 1
+                seq = self._accept_seq
             threading.Thread(
-                target=self._read_loop, args=(conn,), daemon=True
+                target=self._read_loop, args=(conn, seq), daemon=True
             ).start()
 
-    def _read_loop(self, conn: socket.socket):
+    def _read_loop(self, conn: socket.socket, seq: int):
         try:
             while not self._closing.is_set():
                 (length,) = _LEN.unpack(_recv_exact(conn, _LEN.size))
-                src, epoch, tag, payload = pickle.loads(
-                    _recv_exact(conn, length)
-                )
-                with self._src_epochs_lock:
-                    latest = self._src_epochs.get(src, -1)
-                    if epoch < latest:
+                src, tag, payload = pickle.loads(_recv_exact(conn, length))
+                with self._src_seq_lock:
+                    latest = self._src_seq.get(src, 0)
+                    if seq < latest:
                         continue  # straggler from before src's reconnect
-                    self._src_epochs[src] = epoch
+                    self._src_seq[src] = seq
                 self._mailbox.put(
                     Message(src=src, dst=0, tag=tag, payload=payload)
                 )
@@ -137,22 +141,19 @@ class SocketTransport(Transport):
                 lock = self._dst_locks[dst] = threading.Lock()
             return lock
 
-    def _connection(self, dst: int) -> tuple[socket.socket, int]:
-        """Cached outbound (socket, epoch); caller must hold the dst lock."""
+    def _connection(self, dst: int) -> socket.socket:
+        """Cached outbound socket; caller must hold the dst lock."""
         with self._out_cache_lock:
             sock = self._out.get(dst)
-            if sock is not None:
-                return sock, self._out_epoch[dst]
-        sock = socket.create_connection(self._addrs[dst], timeout=30)
-        # back to blocking mode: a mid-frame timeout would desync the
-        # length-prefixed stream for every later frame
-        sock.settimeout(None)
-        sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
-        with self._out_cache_lock:
-            epoch = self._out_epoch.get(dst, -1) + 1
-            self._out[dst] = sock
-            self._out_epoch[dst] = epoch
-        return sock, epoch
+        if sock is None:
+            sock = socket.create_connection(self._addrs[dst], timeout=30)
+            # back to blocking mode: a mid-frame timeout would desync the
+            # length-prefixed stream for every later frame
+            sock.settimeout(None)
+            sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            with self._out_cache_lock:
+                self._out[dst] = sock
+        return sock
 
     def _evict(self, dst: int) -> None:
         with self._out_cache_lock:
@@ -165,23 +166,19 @@ class SocketTransport(Transport):
 
     # -- Transport API ----------------------------------------------------
 
-    def _frame(self, epoch: int, tag: int, payload: Any) -> bytes:
-        blob = pickle.dumps((self.rank, epoch, tag, payload), protocol=5)
-        return _LEN.pack(len(blob)) + blob
-
     def send(self, dst: int, tag: int, payload: Any) -> None:
+        blob = pickle.dumps((self.rank, tag, payload), protocol=5)
+        frame = _LEN.pack(len(blob)) + blob
         with self._dst_lock(dst):
             try:
-                sock, epoch = self._connection(dst)
-                sock.sendall(self._frame(epoch, tag, payload))
+                self._connection(dst).sendall(frame)
             except (ConnectionError, OSError):
-                # stale cached socket (peer restarted): reconnect once, with
-                # a bumped epoch so the receiver fences any stragglers still
-                # in flight on the old connection. Whole-frame retry is safe
-                # — the reader discards a connection on any partial frame.
+                # stale cached socket (peer restarted): reconnect once. The
+                # receiver's accept-order fence drops any stragglers still in
+                # flight on the old connection. Whole-frame retry is safe —
+                # the reader discards a connection on any partial frame.
                 self._evict(dst)
-                sock, epoch = self._connection(dst)
-                sock.sendall(self._frame(epoch, tag, payload))
+                self._connection(dst).sendall(frame)
 
     def recv(
         self,

@@ -38,6 +38,8 @@ class TrainConfig:
     steps: int = 200  # ps-* algos: local steps per client
     # sequence models
     seq_len: int = 32
+    # image models (ImageNet-shaped configs; smaller for CPU-mesh smoke runs)
+    image_size: int = 224
     # plumbing
     seed: int = 0
     log_every: int = 0

@@ -19,15 +19,16 @@ import jax
 
 
 def _to_jsonable(v: Any) -> Any:
-    try:
-        return float(v)  # jax/np scalars
-    except (TypeError, ValueError):
-        pass
-    if hasattr(v, "tolist"):  # arrays (np/jax), any rank
-        return v.tolist()
-    if isinstance(v, (str, int, bool, type(None), list, dict)):
+    # exact python types pass through untouched (a bool/str must not be
+    # float()-coerced: float(True) and float("007") both "work")
+    if isinstance(v, (str, bool, int, float, type(None), list, dict)):
         return v
-    return repr(v)
+    if hasattr(v, "tolist"):  # np/jax scalars and arrays, any rank
+        return v.tolist()
+    try:
+        return float(v)  # other numeric scalar types
+    except (TypeError, ValueError):
+        return repr(v)
 
 
 class MetricsLogger:

@@ -142,3 +142,23 @@ class TestSocket:
         assert status[0] == "ok", status
         child.join(timeout=10)
         tp.close()
+
+    def test_sender_restart_not_fenced_out(self):
+        """A fully-restarted sender (fresh transport object, same rank) must
+        keep getting through — the reconnect fence is receiver-side accept
+        ordering, not sender state (regression: a sender-epoch fence would
+        silently drop a restarted sender's frames forever)."""
+        base_port = 29_741
+        rx = SocketTransport(0, 2, base_port=base_port)
+        tx1 = SocketTransport(1, 2, base_port=base_port)
+        tx1.send(0, tag=1, payload="before")
+        assert rx.recv(src=1, tag=1, timeout=10).payload == "before"
+        tx1.close()
+
+        tx2 = SocketTransport(1, 2, base_port=base_port + 10)
+        # restarted process: new transport, same rank, receiver unchanged
+        tx2._addrs[0] = rx._addrs[0]
+        tx2.send(0, tag=1, payload="after-restart")
+        assert rx.recv(src=1, tag=1, timeout=10).payload == "after-restart"
+        tx2.close()
+        rx.close()
