@@ -2,7 +2,7 @@
 
 On-disk formats supported when present under ``$MPIT_DATA_DIR``:
 - MNIST: the standard idx files (``train-images-idx3-ubyte`` etc.), parsed
-  natively (see ``mpit_tpu.native``) or in numpy.
+  in numpy.
 - CIFAR-10: the python/bin batches are NOT parsed here (keep the surface
   small); synthetic CIFAR-shaped data is used unless ``.npz`` caches exist.
 

@@ -1,0 +1,73 @@
+"""Build helper for the native library (g++ → shared object, cached).
+
+The reference shipped its native binding through luarocks/CMake (SURVEY.md
+§2 comp. 2). Here the native surface is small enough that the build is one
+compiler invocation, done lazily on first import and cached next to the
+source; ``make -C mpit_tpu/native`` (see Makefile) does the same thing
+explicitly. No toolchain → ``NativeUnavailable``, and callers fall back to
+the pure-Python broker.
+"""
+
+from __future__ import annotations
+
+import os
+import shutil
+import subprocess
+import threading
+
+_DIR = os.path.dirname(os.path.abspath(__file__))
+SRC = os.path.join(_DIR, "src", "tagged_broker.cpp")
+LIB = os.path.join(_DIR, "_libmpit_native.so")
+
+_build_lock = threading.Lock()
+
+
+class NativeUnavailable(RuntimeError):
+    """No compiled library and no way to build one."""
+
+
+def ensure_built(force: bool = False) -> str:
+    """Return the path to the compiled library, building it if missing or
+    older than the source. Raises :class:`NativeUnavailable` when neither a
+    library nor a compiler is available."""
+    with _build_lock:
+        have_src = os.path.exists(SRC)
+        have_lib = os.path.exists(LIB)
+        if (
+            not force
+            and have_lib
+            and (not have_src
+                 or os.path.getmtime(LIB) >= os.path.getmtime(SRC))
+        ):
+            return LIB
+        cxx = os.environ.get("CXX") or shutil.which("g++") or shutil.which("c++")
+        if cxx is not None and shutil.which(cxx) is None:
+            cxx = None  # $CXX points at nothing runnable
+        if cxx is None or not have_src:
+            if have_lib:
+                return LIB  # stale but present beats nothing
+            if not have_src:
+                raise NativeUnavailable(
+                    f"missing source {SRC} and no prebuilt library"
+                )
+            raise NativeUnavailable(
+                "no C++ compiler found (set $CXX) and no prebuilt "
+                f"{os.path.basename(LIB)}"
+            )
+        tmp = LIB + ".tmp"
+        cmd = [
+            cxx, "-O3", "-std=c++17", "-shared", "-fPIC", "-pthread",
+            "-o", tmp, SRC,
+        ]
+        try:
+            subprocess.run(
+                cmd, check=True, capture_output=True, text=True, timeout=120
+            )
+        except (subprocess.CalledProcessError, subprocess.TimeoutExpired,
+                OSError) as e:
+            stderr = getattr(e, "stderr", "") or ""
+            raise NativeUnavailable(
+                f"native build failed: {' '.join(cmd)}\n{stderr}"
+            ) from e
+        os.replace(tmp, LIB)
+        return LIB

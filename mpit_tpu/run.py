@@ -222,6 +222,7 @@ def _run_async_ps(cfg, model, opt, x_tr, y_tr, x_te, y_te, log, results):
         num_clients=cfg.clients, num_servers=cfg.servers,
         algo=cfg.algo.removeprefix("ps-"),
         alpha=alpha, tau=cfg.tau,
+        transport=cfg.transport,
     )
     per_client = max(cfg.global_batch // cfg.clients, 1)
     t0 = time.perf_counter()

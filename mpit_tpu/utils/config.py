@@ -36,6 +36,7 @@ class TrainConfig:
     clients: int = 2  # ps-* algos
     servers: int = 1
     steps: int = 200  # ps-* algos: local steps per client
+    transport: str = "auto"  # ps-* message plane: auto | native | inproc
     # sequence models
     seq_len: int = 32
     # image models (ImageNet-shaped configs; smaller for CPU-mesh smoke runs)
