@@ -15,8 +15,10 @@ full duration — concurrent pserver/pclient threads genuinely overlap.
 
 from __future__ import annotations
 
+import contextlib
 import ctypes
 import pickle
+import threading
 from typing import Any, Optional
 
 from mpit_tpu.native.build import LIB, NativeUnavailable, ensure_built
@@ -46,6 +48,7 @@ def _load() -> ctypes.CDLL:
         lib = ctypes.CDLL(ensure_built())
         lib.mpit_broker_create.argtypes = [ctypes.c_int]
         lib.mpit_broker_create.restype = ctypes.c_void_p
+        lib.mpit_broker_shutdown.argtypes = [ctypes.c_void_p]
         lib.mpit_broker_destroy.argtypes = [ctypes.c_void_p]
         lib.mpit_broker_send.argtypes = [
             ctypes.c_void_p, ctypes.c_int, ctypes.c_int, ctypes.c_int,
@@ -100,19 +103,42 @@ class NativeBroker:
         self._h = self._lib.mpit_broker_create(size)
         if not self._h:
             raise RuntimeError("mpit_broker_create failed")
+        # close() protocol: every C call runs inside _op(), counted under
+        # _cv's lock; close() flips _closing (no new entries), wakes parked
+        # receivers via C-side shutdown, waits for the count to drain, and
+        # only then frees the C object — so no thread can ever touch a
+        # dangling handle (the C-side ops counter alone cannot guarantee
+        # that; see tagged_broker.cpp teardown comments).
+        self._cv = threading.Condition()
+        self._active = 0
+        self._closing = False
 
     def transports(self) -> list["NativeTransport"]:
         return [NativeTransport(self, r) for r in range(self.size)]
 
     # internal ops used by NativeTransport ---------------------------------
 
+    @contextlib.contextmanager
+    def _op(self):
+        with self._cv:
+            if self._closing:
+                raise RuntimeError("native broker closed")
+            self._active += 1
+        try:
+            yield
+        finally:
+            with self._cv:
+                self._active -= 1
+                self._cv.notify_all()
+
     def _send(self, src: int, dst: int, tag: int, payload: Any) -> None:
         if not 0 <= dst < self.size:
             raise ValueError(f"dst {dst} out of range [0, {self.size})")
         blob = pickle.dumps(payload, protocol=5)
-        rc = self._lib.mpit_broker_send(
-            self._h, src, dst, tag, blob, len(blob)
-        )
+        with self._op():
+            rc = self._lib.mpit_broker_send(
+                self._h, src, dst, tag, blob, len(blob)
+            )
         if rc != 0:
             raise RuntimeError(f"native send failed (rc={rc})")
 
@@ -120,7 +146,20 @@ class NativeBroker:
         self, rank: int, src: int, tag: int, timeout: Optional[float]
     ) -> Message:
         t = -1.0 if timeout is None else float(timeout)
-        lease = self._lib.mpit_broker_recv(self._h, rank, src, tag, t)
+        with self._op():
+            lease = self._lib.mpit_broker_recv(self._h, rank, src, tag, t)
+            if lease >= 0:
+                m_src = ctypes.c_int()
+                m_tag = ctypes.c_int()
+                m_len = ctypes.c_uint64()
+                if self._lib.mpit_lease_info(
+                    self._h, lease, ctypes.byref(m_src), ctypes.byref(m_tag),
+                    ctypes.byref(m_len),
+                ) != 0:
+                    raise RuntimeError("native lease vanished")
+                buf = ctypes.create_string_buffer(max(m_len.value, 1))
+                if self._lib.mpit_lease_copy_free(self._h, lease, buf) != 0:
+                    raise RuntimeError("native lease copy failed")
         if lease == -1:
             raise RecvTimeout(
                 f"no message from src={src} tag={tag} within {timeout}s"
@@ -129,17 +168,6 @@ class NativeBroker:
             raise RuntimeError("native broker closed during recv")
         if lease < 0:
             raise RuntimeError(f"native recv failed (rc={lease})")
-        m_src = ctypes.c_int()
-        m_tag = ctypes.c_int()
-        m_len = ctypes.c_uint64()
-        if self._lib.mpit_lease_info(
-            self._h, lease, ctypes.byref(m_src), ctypes.byref(m_tag),
-            ctypes.byref(m_len),
-        ) != 0:
-            raise RuntimeError("native lease vanished")
-        buf = ctypes.create_string_buffer(max(m_len.value, 1))
-        if self._lib.mpit_lease_copy_free(self._h, lease, buf) != 0:
-            raise RuntimeError("native lease copy failed")
         payload = (
             pickle.loads(buf.raw[: m_len.value]) if m_len.value else None
         )
@@ -148,14 +176,26 @@ class NativeBroker:
         )
 
     def _probe(self, rank: int, src: int, tag: int) -> bool:
-        rc = self._lib.mpit_broker_probe(self._h, rank, src, tag)
+        with self._op():
+            rc = self._lib.mpit_broker_probe(self._h, rank, src, tag)
         if rc < 0:
             raise RuntimeError(f"native probe failed (rc={rc})")
         return bool(rc)
 
     def close(self) -> None:
-        h, self._h = self._h, None
+        """Idempotent; safe while receivers are parked in recv (they are
+        woken and raise 'broker closed')."""
+        with self._cv:
+            if self._closing:
+                return
+            self._closing = True
+            h = self._h
         if h:
+            self._lib.mpit_broker_shutdown(h)
+            with self._cv:
+                while self._active:
+                    self._cv.wait()
+                self._h = None
             self._lib.mpit_broker_destroy(h)
 
     def __del__(self):

@@ -54,7 +54,9 @@ def ensure_built(force: bool = False) -> str:
                 "no C++ compiler found (set $CXX) and no prebuilt "
                 f"{os.path.basename(LIB)}"
             )
-        tmp = LIB + ".tmp"
+        # per-process tmp: two processes may build concurrently (the lock is
+        # thread-local); each promotes atomically, last writer wins whole
+        tmp = f"{LIB}.{os.getpid()}.tmp"
         cmd = [
             cxx, "-O3", "-std=c++17", "-shared", "-fPIC", "-pthread",
             "-o", tmp, SRC,

@@ -19,7 +19,8 @@
 //   mpit_broker_probe(h, rank, src, tag)      -> 1 / 0 / -1
 //   mpit_lease_info(h, lease, &src, &tag, &len)
 //   mpit_lease_copy_free(h, lease, out)       -> copies payload, ends lease
-//   mpit_broker_destroy(h)
+//   mpit_broker_shutdown(h)                   -> refuse new work, wake waiters
+//   mpit_broker_destroy(h)                    -> shutdown + drain + free
 //
 // A "lease" is a received message parked C-side until the caller has
 // allocated a buffer of the right size; info -> copy_free is the two-phase
@@ -105,7 +106,13 @@ void* mpit_broker_create(int size) {
   return new Broker(size);
 }
 
-void mpit_broker_destroy(void* h) {
+// Phase 1 of teardown: refuse new work and wake every parked receiver
+// (they return -3). Does NOT free — the caller drains its in-flight calls
+// first, then calls destroy. Splitting the phases lets the Python wrapper
+// close the entry/increment race entirely on its side: it gates every API
+// call behind its own counter, flips "closing" (no new entries), calls
+// shutdown, waits for its counter to hit zero, and only then destroys.
+void mpit_broker_shutdown(void* h) {
   auto* b = static_cast<Broker*>(h);
   if (b == nullptr) return;
   b->shutting_down.store(true);
@@ -115,6 +122,16 @@ void mpit_broker_destroy(void* h) {
     std::lock_guard<std::mutex> g(box.mu);
     box.cv.notify_all();
   }
+}
+
+// Phase 2: free. The `ops` drain is defense in depth — the wrapper already
+// guarantees quiescence (see shutdown above); `ops` alone cannot, since a
+// caller holding the raw handle may sit between its null-check and its
+// OpGuard increment when the spin loop reads zero.
+void mpit_broker_destroy(void* h) {
+  auto* b = static_cast<Broker*>(h);
+  if (b == nullptr) return;
+  mpit_broker_shutdown(h);
   while (b->ops.load() != 0) {
     std::this_thread::sleep_for(std::chrono::milliseconds(1));
   }
