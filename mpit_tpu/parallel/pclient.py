@@ -11,12 +11,14 @@ flat numpy chunks cross the transport.
 
 from __future__ import annotations
 
+import threading
 from typing import Optional, Sequence
 
 import numpy as np
 
 from mpit_tpu.parallel.pserver import (
     TAG_FETCH,
+    TAG_HEARTBEAT,
     TAG_PARAM,
     TAG_PUSH_DELTA,
     TAG_PUSH_EASGD,
@@ -30,6 +32,11 @@ class PClient:
     """Client stub: fetch / push against a set of sharded pservers.
 
     ``server_ranks[s]`` owns flat chunk s of a ``param_size`` vector.
+
+    ``heartbeat_interval``: when set, a daemon timer thread sends
+    zero-payload HEARTBEATs to every server so the server watchdog
+    (``PServer(client_timeout=...)``) doesn't declare this client dead
+    during long local compute between exchanges. Stopped by :meth:`stop`.
     """
 
     def __init__(
@@ -38,12 +45,31 @@ class PClient:
         server_ranks: Sequence[int],
         param_size: int,
         timeout: Optional[float] = 60.0,
+        heartbeat_interval: Optional[float] = None,
     ):
         self.transport = transport
         self.server_ranks = list(server_ranks)
         self.param_size = int(param_size)
         self.bounds = partition_bounds(self.param_size, len(self.server_ranks))
         self.timeout = timeout
+        self._hb_stop = threading.Event()
+        self._hb_thread: Optional[threading.Thread] = None
+        if heartbeat_interval is not None:
+            self._hb_thread = threading.Thread(
+                target=self._heartbeat_loop,
+                args=(float(heartbeat_interval),),
+                daemon=True,
+                name="mpit-pclient-heartbeat",
+            )
+            self._hb_thread.start()
+
+    def _heartbeat_loop(self, interval: float) -> None:
+        while not self._hb_stop.wait(interval):
+            try:
+                for rank in self.server_ranks:
+                    self.transport.send(rank, TAG_HEARTBEAT, None)
+            except Exception:
+                return  # transport torn down; liveness is moot
 
     def fetch(self) -> np.ndarray:
         """Gather the full flat center from all servers (async fan-out:
@@ -67,6 +93,9 @@ class PClient:
 
     def stop(self) -> None:
         """Detach from every server (teardown protocol, SURVEY.md §3(e))."""
+        self._hb_stop.set()
+        if self._hb_thread is not None:
+            self._hb_thread.join(timeout=5)
         for rank in self.server_ranks:
             self.transport.send(rank, TAG_STOP, None)
 

@@ -61,12 +61,20 @@ class AsyncPSTrainer:
         server_lr: float = 1.0,
         loss_fn: Optional[Callable] = None,
         transport: str = "auto",
+        client_timeout: Optional[float] = None,
     ):
         if algo not in ("easgd", "downpour"):
             raise ValueError(f"unknown algo {algo!r}")
         if transport not in ("auto", "native", "inproc"):
             raise ValueError(f"unknown transport {transport!r}")
         self.transport_kind = transport
+        # failure detection (SURVEY.md §5 do-better): silence beyond this →
+        # the client is declared dead instead of hanging the job forever
+        if client_timeout is not None and client_timeout <= 0:
+            raise ValueError(
+                "client_timeout must be positive (use None to disable)"
+            )
+        self.client_timeout = client_timeout
         if num_clients < 1 or num_servers < 1:
             raise ValueError("need at least one client and one server")
         self.model = model
@@ -124,6 +132,9 @@ class AsyncPSTrainer:
         broker = self._make_broker(self.num_servers + self.num_clients)
         transports = broker.transports()
         server_ranks = list(range(self.num_servers))
+        client_ranks = list(
+            range(self.num_servers, self.num_servers + self.num_clients)
+        )
         bounds = partition_bounds(flat0.size, self.num_servers)
 
         servers = [
@@ -133,6 +144,8 @@ class AsyncPSTrainer:
                 num_clients=self.num_clients,
                 alpha=self.alpha,
                 server_lr=self.server_lr,
+                client_ranks=client_ranks,
+                client_timeout=self.client_timeout,
             )
             for r, (start, end) in zip(server_ranks, bounds)
         ]
@@ -142,9 +155,17 @@ class AsyncPSTrainer:
         errors: list[BaseException] = []
 
         def client_main(c: int):
+            client = None
             try:
                 tp = transports[self.num_servers + c]
-                client = PClient(tp, server_ranks, flat0.size)
+                hb = (
+                    self.client_timeout / 3
+                    if self.client_timeout is not None
+                    else None
+                )
+                client = PClient(
+                    tp, server_ranks, flat0.size, heartbeat_interval=hb
+                )
                 rng = np.random.default_rng(seed + 1000 + c)
                 xs = shard_for_worker(x, c, self.num_clients)
                 ys = shard_for_worker(y, c, self.num_clients)
@@ -172,11 +193,16 @@ class AsyncPSTrainer:
             except BaseException as e:  # surface thread failures to caller
                 errors.append(e)
                 try:
-                    PClient(
-                        transports[self.num_servers + c],
-                        server_ranks,
-                        flat0.size,
-                    ).stop()
+                    if client is not None:
+                        # stops the heartbeat thread AND detaches — a leaked
+                        # heartbeat would flood the brokers forever
+                        client.stop()
+                    else:
+                        PClient(
+                            transports[self.num_servers + c],
+                            server_ranks,
+                            flat0.size,
+                        ).stop()
                 except Exception:
                     pass
 
@@ -200,6 +226,12 @@ class AsyncPSTrainer:
         center_params = unflatten_params(spec, jnp.asarray(center_flat))
         stats = {
             "server_counts": [dict(s.counts) for s in servers],
+            # reported as client INDICES (0..num_clients), consistent with
+            # "losses" and data sharding — not raw transport ranks
+            "dead_clients": sorted(
+                r - self.num_servers
+                for r in set().union(*(s.dead_clients for s in servers))
+            ),
             "mean_final_loss": float(
                 np.mean([l[-1] for l in losses if l]) if any(losses) else np.nan
             ),

@@ -20,22 +20,33 @@ Protocol tags (client → server unless noted):
   PUSH_DELTA  (delta_chunk)     center += server_lr * delta_chunk
   PARAM       (chunk)           server → client fetch reply
   STOP        ()                client detaches; server exits when all did
+  HEARTBEAT   ()                liveness only (refreshes the watchdog)
+
+Failure detection (a do-better over the reference — SURVEY.md §5: 'a dead
+rank hangs the job'): with ``client_timeout`` set, the server runs a
+watchdog over per-client last-activity times; a client silent for longer
+than the timeout is declared dead and no longer blocks teardown. Any
+message — including the zero-cost HEARTBEAT a PClient can emit from a timer
+thread during long local compute — refreshes liveness, and a late message
+from a declared-dead client revives it.
 """
 
 from __future__ import annotations
 
 import threading
-from typing import Optional
+import time
+from typing import Optional, Sequence
 
 import numpy as np
 
-from mpit_tpu.transport import ANY_SOURCE, ANY_TAG, Transport
+from mpit_tpu.transport import ANY_SOURCE, ANY_TAG, RecvTimeout, Transport
 
 TAG_FETCH = 1
 TAG_PUSH_EASGD = 2
 TAG_PUSH_DELTA = 3
 TAG_PARAM = 4
 TAG_STOP = 5
+TAG_HEARTBEAT = 6
 
 
 def partition_bounds(total: int, num_servers: int) -> list[tuple[int, int]]:
@@ -65,13 +76,32 @@ class PServer:
         num_clients: int,
         alpha: float = 0.5,
         server_lr: float = 1.0,
+        client_ranks: Optional[Sequence[int]] = None,
+        client_timeout: Optional[float] = None,
     ):
+        """``client_timeout``: seconds of per-client silence before the
+        watchdog declares it dead (requires ``client_ranks``); None keeps
+        the reference's wait-forever semantics."""
         self.transport = transport
         self.center = np.array(center_chunk, dtype=np.float32, copy=True)
         self.num_clients = num_clients
         self.alpha = float(alpha)
         self.server_lr = float(server_lr)
-        self.counts = {"fetch": 0, "push_easgd": 0, "push_delta": 0}
+        self.client_ranks = (
+            list(client_ranks) if client_ranks is not None else None
+        )
+        if client_timeout is not None:
+            if self.client_ranks is None:
+                raise ValueError("client_timeout requires client_ranks")
+            if client_timeout <= 0:
+                raise ValueError(
+                    "client_timeout must be positive (use None to disable)"
+                )
+        self.client_timeout = client_timeout
+        self.counts = {"fetch": 0, "push_easgd": 0, "push_delta": 0,
+                       "heartbeat": 0}
+        self.dead_clients: set[int] = set()
+        self._stopped: set[int] = set()
         self.error: Optional[BaseException] = None
         self._lock = threading.Lock()
 
@@ -86,9 +116,23 @@ class PServer:
             raise
 
     def _serve(self) -> None:
-        stopped = 0
-        while stopped < self.num_clients:
-            msg = self.transport.recv(ANY_SOURCE, ANY_TAG)
+        watchdog = self.client_timeout is not None
+        last_seen: dict[int, float] = {}
+        if watchdog:
+            now = time.monotonic()
+            last_seen = {r: now for r in self.client_ranks}
+        poll = self.client_timeout / 4 if watchdog else None
+
+        while len(self._stopped | self.dead_clients) < self.num_clients:
+            try:
+                msg = self.transport.recv(ANY_SOURCE, ANY_TAG, timeout=poll)
+            except RecvTimeout:
+                self._expire(last_seen)
+                continue
+            if watchdog and msg.src in last_seen:
+                last_seen[msg.src] = time.monotonic()
+                # a late message from a declared-dead client revives it
+                self.dead_clients.discard(msg.src)
             if msg.tag == TAG_FETCH:
                 with self._lock:
                     snapshot = self.center.copy()
@@ -105,10 +149,25 @@ class PServer:
                 with self._lock:
                     self.center += self.server_lr * np.asarray(msg.payload)
                     self.counts["push_delta"] += 1
+            elif msg.tag == TAG_HEARTBEAT:
+                with self._lock:
+                    self.counts["heartbeat"] += 1
             elif msg.tag == TAG_STOP:
-                stopped += 1
+                self._stopped.add(msg.src)
             else:
                 raise ValueError(f"pserver: unknown tag {msg.tag}")
+            if watchdog:
+                self._expire(last_seen)
+
+    def _expire(self, last_seen: dict) -> None:
+        now = time.monotonic()
+        for r, seen in last_seen.items():
+            if (
+                r not in self._stopped
+                and r not in self.dead_clients
+                and now - seen > self.client_timeout
+            ):
+                self.dead_clients.add(r)
 
     def snapshot(self) -> np.ndarray:
         with self._lock:

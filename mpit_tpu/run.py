@@ -223,6 +223,7 @@ def _run_async_ps(cfg, model, opt, x_tr, y_tr, x_te, y_te, log, results):
         algo=cfg.algo.removeprefix("ps-"),
         alpha=alpha, tau=cfg.tau,
         transport=cfg.transport,
+        client_timeout=cfg.client_timeout,
     )
     per_client = max(cfg.global_batch // cfg.clients, 1)
     t0 = time.perf_counter()
@@ -239,6 +240,7 @@ def _run_async_ps(cfg, model, opt, x_tr, y_tr, x_te, y_te, log, results):
         accuracy=acc,
         final_loss=stats["mean_final_loss"],
         server_counts=stats["server_counts"],
+        dead_clients=stats["dead_clients"],
         samples=samples,
         wall_s=wall,
         samples_per_sec=samples / wall,

@@ -37,6 +37,8 @@ class TrainConfig:
     servers: int = 1
     steps: int = 200  # ps-* algos: local steps per client
     transport: str = "auto"  # ps-* message plane: auto | native | inproc
+    client_timeout: Optional[float] = None  # ps-* watchdog (None = hang,
+    # matching the reference's dead-rank semantics)
     # sequence models
     seq_len: int = 32
     # image models (ImageNet-shaped configs; smaller for CPU-mesh smoke runs)

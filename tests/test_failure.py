@@ -1,0 +1,141 @@
+"""Failure-detection tests: heartbeat + watchdog for the async PS mode.
+
+The reference had NO failure handling — 'a dead rank hangs the job'
+(SURVEY.md §5). These tests pin the do-better semantics: a silent client is
+declared dead within the timeout instead of blocking teardown forever;
+heartbeats keep a compute-bound client alive; a late message revives a
+declared-dead client."""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from mpit_tpu.parallel.pclient import PClient
+from mpit_tpu.parallel.pserver import (
+    TAG_PUSH_EASGD,
+    TAG_STOP,
+    PServer,
+    spawn_server_thread,
+)
+from mpit_tpu.transport import Broker
+
+DIM = 16
+
+
+def _world(num_clients: int, client_timeout: float):
+    broker = Broker(1 + num_clients)
+    tps = broker.transports()
+    server = PServer(
+        tps[0],
+        np.zeros(DIM, np.float32),
+        num_clients=num_clients,
+        alpha=0.5,
+        client_ranks=list(range(1, 1 + num_clients)),
+        client_timeout=client_timeout,
+    )
+    thread = spawn_server_thread(server)
+    return tps, server, thread
+
+
+class TestWatchdog:
+    def test_silent_client_declared_dead_server_exits(self):
+        """One client stops cleanly, the other goes silent: the server must
+        exit within ~timeout, not hang forever (the reference's behavior)."""
+        tps, server, thread = _world(2, client_timeout=0.4)
+        tps[1].send(0, TAG_PUSH_EASGD, np.ones(DIM, np.float32))
+        tps[1].send(0, TAG_STOP, None)
+        # client rank 2 never says anything at all
+        thread.join(timeout=5)
+        assert not thread.is_alive(), "server hung on a dead client"
+        assert server.dead_clients == {2}
+        assert server.error is None
+
+    def test_heartbeat_keeps_slow_client_alive(self):
+        """A client computing for longer than the timeout but heartbeating
+        must NOT be declared dead."""
+        tps, server, thread = _world(1, client_timeout=0.5)
+        client = PClient(
+            tps[1], [0], DIM, heartbeat_interval=0.1
+        )
+        time.sleep(1.5)  # 3x the timeout: silence would be fatal
+        assert thread.is_alive()  # still serving — not declared dead
+        client.push_easgd(np.ones(DIM, np.float32))
+        client.stop()
+        thread.join(timeout=5)
+        assert not thread.is_alive()
+        assert server.dead_clients == set()
+        assert server.counts["heartbeat"] >= 3
+        assert server.counts["push_easgd"] == 1
+
+    def test_late_message_revives_dead_client(self):
+        """Declared-dead then heard-from again: the client is revived and
+        its eventual STOP (not the death record) ends the job. Client 2
+        heartbeats throughout so the server deterministically outlives
+        client 1's dead period."""
+        tps, server, thread = _world(2, client_timeout=0.3)
+        keeper = PClient(tps[2], [0], DIM, heartbeat_interval=0.05)
+        deadline = time.monotonic() + 5
+        while 1 not in server.dead_clients and time.monotonic() < deadline:
+            time.sleep(0.02)
+        assert 1 in server.dead_clients  # client 1 silent past the timeout
+        assert thread.is_alive()  # client 2's heartbeats keep serving alive
+        tps[1].send(0, TAG_PUSH_EASGD, np.ones(DIM, np.float32))  # revival
+        tps[1].send(0, TAG_STOP, None)
+        keeper.stop()
+        thread.join(timeout=5)
+        assert not thread.is_alive()
+        assert server.dead_clients == set()
+        assert server.counts["push_easgd"] == 1
+
+    def test_timeout_requires_client_ranks(self):
+        with pytest.raises(ValueError, match="client_ranks"):
+            PServer(
+                Broker(2).transports()[0],
+                np.zeros(DIM, np.float32),
+                num_clients=1,
+                client_timeout=1.0,
+            )
+
+    def test_nonpositive_timeout_rejected(self):
+        with pytest.raises(ValueError, match="positive"):
+            PServer(
+                Broker(2).transports()[0],
+                np.zeros(DIM, np.float32),
+                num_clients=1,
+                client_ranks=[1],
+                client_timeout=0.0,
+            )
+        import optax
+
+        from mpit_tpu.models import MLP
+        from mpit_tpu.parallel import AsyncPSTrainer
+
+        with pytest.raises(ValueError, match="positive"):
+            AsyncPSTrainer(
+                MLP(), optax.sgd(0.1), client_timeout=0, transport="inproc"
+            )
+
+
+class TestTrainerIntegration:
+    def test_training_with_watchdog_completes_cleanly(self):
+        import jax.numpy as jnp
+        import optax
+
+        from mpit_tpu.data.synthetic import synthetic_image_classification
+        from mpit_tpu.models import MLP
+        from mpit_tpu.parallel import AsyncPSTrainer
+
+        x, y, xt, yt = synthetic_image_classification(
+            256, 64, (8, 8, 1), 10, seed=0
+        )
+        tr = AsyncPSTrainer(
+            MLP(hidden=(16,), compute_dtype=jnp.float32),
+            optax.sgd(0.1),
+            num_clients=2, num_servers=1, tau=4,
+            client_timeout=10.0, transport="inproc",
+        )
+        center, stats = tr.train(x, y, steps=8, batch_size=32)
+        assert stats["dead_clients"] == []
+        assert stats["server_counts"][0]["push_easgd"] == 4
