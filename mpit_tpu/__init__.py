@@ -47,6 +47,7 @@ from mpit_tpu.comm import (  # noqa: F401
     pmean,
     pmax,
     pmin,
+    reduce_scatter,
     SUM,
     PROD,
     MAX,

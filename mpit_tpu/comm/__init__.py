@@ -35,4 +35,5 @@ from mpit_tpu.comm.collectives import (  # noqa: F401
     pmax,
     pmin,
     ppermute_ring,
+    reduce_scatter,
 )

@@ -140,6 +140,26 @@ def barrier(name: str = "mpit_barrier") -> None:
         multihost_utils.sync_global_devices(name)
 
 
+def reduce_scatter(
+    tree: Any,
+    axis_name: Optional[str] = None,
+    scatter_dimension: int = 0,
+    tiled: bool = True,
+) -> Any:
+    """Reduce-scatter: sum across workers, each worker keeps its 1/W shard
+    (``lax.psum_scatter``). The building block of bandwidth-optimal
+    allreduce (reduce_scatter + all_gather) and of sharded-optimizer
+    (ZeRO-style) updates; leaves must be divisible by W along
+    ``scatter_dimension``."""
+    axis = _axis(axis_name)
+    return jax.tree.map(
+        lambda x: lax.psum_scatter(
+            x, axis, scatter_dimension=scatter_dimension, tiled=tiled
+        ),
+        tree,
+    )
+
+
 def ppermute_ring(
     tree: Any, shift: int = 1, axis_name: Optional[str] = None
 ) -> Any:

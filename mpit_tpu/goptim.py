@@ -36,6 +36,14 @@ def elastic_client_move(params: Any, center: Any, alpha: float) -> Any:
     return jax.tree.map(lambda p, c: p - alpha * (p - c), params, center)
 
 
+def summed_client_diffs(params: Any, center: Any, axis_name: str) -> Any:
+    """Σ_i (x_i − x̃) across the worker axis — the one collective of the
+    EASGD exchange (shared by the plain and pallas paths)."""
+    return lax.psum(
+        jax.tree.map(lambda p, c: p - c, params, center), axis_name
+    )
+
+
 def elastic_center_move(
     center: Any, params: Any, alpha: float, axis_name: str
 ) -> Any:
@@ -45,20 +53,42 @@ def elastic_center_move(
     ``psum`` (this is exactly where the reference's pserver applied its
     per-message elastic update, SURVEY.md §3(c) — the collective form is the
     mathematically identical symmetric-round version, §5 item (i))."""
-    total_diff = lax.psum(
-        jax.tree.map(lambda p, c: p - c, params, center), axis_name
-    )
+    total_diff = summed_client_diffs(params, center, axis_name)
     return jax.tree.map(lambda c, d: c + alpha * d, center, total_diff)
 
 
 def easgd_round(
-    params: Any, center: Any, alpha: float, axis_name: str
+    params: Any,
+    center: Any,
+    alpha: float,
+    axis_name: str,
+    use_pallas: bool = False,
 ) -> tuple[Any, Any]:
     """One synchronous elastic-averaging exchange; returns (params, center).
 
-    Both moves use the *old* center, per the paper's update order."""
-    new_params = elastic_client_move(params, center, alpha)
-    new_center = elastic_center_move(center, params, alpha, axis_name)
+    Both moves use the *old* center, per the paper's update order.
+    ``use_pallas`` routes the post-psum elementwise math through the fused
+    kernel in :mod:`mpit_tpu.ops` (numerically identical; see its scope
+    note)."""
+    if not use_pallas:
+        new_params = elastic_client_move(params, center, alpha)
+        new_center = elastic_center_move(center, params, alpha, axis_name)
+        return new_params, new_center
+
+    from mpit_tpu import ops
+
+    total_diff = summed_client_diffs(params, center, axis_name)
+    # flatten/unflatten by the params treedef (an is_leaf=tuple unzip would
+    # misfire on pytrees whose CONTAINERS are tuples)
+    leaves_p, treedef = jax.tree.flatten(params)
+    leaves_c = jax.tree.leaves(center)
+    leaves_d = jax.tree.leaves(total_diff)
+    pairs = [
+        ops.elastic_update(p, c, d, alpha, use_pallas=True)
+        for p, c, d in zip(leaves_p, leaves_c, leaves_d)
+    ]
+    new_params = jax.tree.unflatten(treedef, [x for x, _ in pairs])
+    new_center = jax.tree.unflatten(treedef, [c for _, c in pairs])
     return new_params, new_center
 
 

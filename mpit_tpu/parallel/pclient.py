@@ -65,11 +65,16 @@ class PClient:
 
     def _heartbeat_loop(self, interval: float) -> None:
         while not self._hb_stop.wait(interval):
-            try:
-                for rank in self.server_ranks:
+            for rank in self.server_ranks:
+                try:
                     self.transport.send(rank, TAG_HEARTBEAT, None)
-            except Exception:
-                return  # transport torn down; liveness is moot
+                except Exception:
+                    # transient (e.g. a TCP blip mid-reconnect): liveness
+                    # resumes next tick — one bad send must NOT silently
+                    # kill the heartbeat and get a healthy client declared
+                    # dead later. The interval bounds the retry rate; the
+                    # thread exits only via stop().
+                    pass
 
     def fetch(self) -> np.ndarray:
         """Gather the full flat center from all servers (async fan-out:

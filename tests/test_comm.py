@@ -194,6 +194,23 @@ class TestCollectives:
         # worker i sends to i+1: worker 0 now holds worker 7's value
         np.testing.assert_allclose(out, np.roll(np.arange(8.0), 1))
 
+    def test_reduce_scatter(self, topo8):
+        from jax.sharding import PartitionSpec as P
+
+        w = 8
+        # per-worker input: each worker contributes a full 16-vector; each
+        # ends up with its 2-element shard of the cross-worker sum
+        x = np.stack(
+            [np.arange(16, dtype=np.float32) + 100 * i for i in range(w)]
+        )
+
+        def f(s):
+            return coll.reduce_scatter(s[0])[None]
+
+        out = shard_map_over(topo8, f, P("dp", None), P("dp", None))(x)
+        expected = x.sum(axis=0)  # full reduction, then shard i gets [2i:2i+2]
+        np.testing.assert_allclose(np.asarray(out).ravel(), expected)
+
     def test_rank_inside_spmd(self, topo8):
         from jax.sharding import PartitionSpec as P
 

@@ -43,11 +43,11 @@ class TestWatchdog:
     def test_silent_client_declared_dead_server_exits(self):
         """One client stops cleanly, the other goes silent: the server must
         exit within ~timeout, not hang forever (the reference's behavior)."""
-        tps, server, thread = _world(2, client_timeout=0.4)
+        tps, server, thread = _world(2, client_timeout=0.8)
         tps[1].send(0, TAG_PUSH_EASGD, np.ones(DIM, np.float32))
         tps[1].send(0, TAG_STOP, None)
         # client rank 2 never says anything at all
-        thread.join(timeout=5)
+        thread.join(timeout=10)
         assert not thread.is_alive(), "server hung on a dead client"
         assert server.dead_clients == {2}
         assert server.error is None
@@ -55,11 +55,13 @@ class TestWatchdog:
     def test_heartbeat_keeps_slow_client_alive(self):
         """A client computing for longer than the timeout but heartbeating
         must NOT be declared dead."""
-        tps, server, thread = _world(1, client_timeout=0.5)
+        # 12x margin between heartbeat and timeout: the test pins ordering
+        # semantics, not tight wall-clock — loaded CI schedulers stall
+        tps, server, thread = _world(1, client_timeout=1.2)
         client = PClient(
             tps[1], [0], DIM, heartbeat_interval=0.1
         )
-        time.sleep(1.5)  # 3x the timeout: silence would be fatal
+        time.sleep(3.6)  # 3x the timeout: silence would be fatal
         assert thread.is_alive()  # still serving — not declared dead
         client.push_easgd(np.ones(DIM, np.float32))
         client.stop()
@@ -74,9 +76,9 @@ class TestWatchdog:
         its eventual STOP (not the death record) ends the job. Client 2
         heartbeats throughout so the server deterministically outlives
         client 1's dead period."""
-        tps, server, thread = _world(2, client_timeout=0.3)
+        tps, server, thread = _world(2, client_timeout=1.0)
         keeper = PClient(tps[2], [0], DIM, heartbeat_interval=0.05)
-        deadline = time.monotonic() + 5
+        deadline = time.monotonic() + 10
         while 1 not in server.dead_clients and time.monotonic() < deadline:
             time.sleep(0.02)
         assert 1 in server.dead_clients  # client 1 silent past the timeout
