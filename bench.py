@@ -8,6 +8,10 @@ torch (CPU) built here — the closest live stand-in for the reference stack.
 Prints ONE JSON line:
   {"metric": ..., "value": N, "unit": "samples/sec/chip", "vs_baseline": N}
 Extra fields are informative; the driver keys on the four required ones.
+
+Flags (SURVEY.md §7 step 7 — the harness covers every BASELINE config):
+  --preset NAME   time one workload config instead (same JSON-line shape)
+  --all           headline metric + a "configs" map over all five workloads
 """
 
 import json
@@ -28,6 +32,64 @@ def _honor_platform_env():
         jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
 
 
+def _stage_and_time(trainer, is_sync, topo, x_tr, y_tr, pwb, tau, rounds):
+    """The one timing harness (both the headline and the preset benches).
+
+    Dataset lives on device, loaded once outside the timed region: the
+    reference's Torch example equally held it in host RAM, and a production
+    input pipeline overlaps transfers; timing a per-step host->device copy
+    would benchmark this harness's PCIe/tunnel link, not the training
+    system. Several distinct pre-staged rounds are cycled so no single batch
+    is hot in any cache-like path, staged with the step's own input sharding
+    (leading worker axis) — a default device_put would commit to device 0
+    and sneak a redistribute-to-mesh back INTO every timed step.
+    """
+    import jax
+
+    w = topo.num_workers
+    gb = pwb * w
+    rng = np.random.default_rng(0)
+    sharding = topo.worker_sharding()
+    step = trainer._step if is_sync else trainer._round
+    staged = []
+    for _ in range(8):
+        idx = rng.integers(0, len(x_tr), tau * gb)
+        if is_sync:
+            xb, yb = x_tr[idx], y_tr[idx]
+        else:
+            xb, yb = trainer.round_batches(
+                x_tr[idx].reshape(tau, gb, *x_tr.shape[1:]),
+                y_tr[idx].reshape(tau, gb, *y_tr.shape[1:]),
+            )
+        staged.append(
+            (jax.device_put(xb, sharding), jax.device_put(yb, sharding))
+        )
+
+    state = trainer.init_state(jax.random.key(0), x_tr[:2])
+    # warmup (compile)
+    for _ in range(3):
+        state, m = step(state, *staged[0])
+    jax.block_until_ready(m["loss"])
+
+    t0 = time.perf_counter()
+    for r in range(rounds):
+        state, m = step(state, *staged[r % len(staged)])
+    jax.block_until_ready(m["loss"])
+    dt = time.perf_counter() - t0
+
+    samples = rounds * tau * gb
+    return {
+        "samples_per_sec": samples / dt,
+        "samples_per_sec_per_chip": samples / dt / w,
+        "chips": w,
+        "platform": topo.platform,
+        "tau": tau,
+        "per_worker_batch": pwb,
+        "timed_samples": samples,
+        "timed_seconds": round(dt, 3),
+    }
+
+
 def bench_jax(
     per_worker_batch: int = 256,
     tau: int = 4,
@@ -44,57 +106,66 @@ def bench_jax(
 
     mpit_tpu.finalize()  # allow re-init at a different world size
     topo = mpit_tpu.init(num_workers=num_workers)
-    w = topo.num_workers
     x_tr, y_tr, *_ = load_mnist(synthetic_train=4096)
     trainer = EASGDTrainer(
         LeNet(), optax.sgd(0.05, momentum=0.9), topo, tau=tau
     )
-    state = trainer.init_state(jax.random.key(0), x_tr[:2])
+    return _stage_and_time(
+        trainer, False, topo, x_tr, y_tr, per_worker_batch, tau, rounds
+    )
 
-    # Dataset lives on device, loaded once outside the timed region: MNIST is
-    # 25 MB — the reference's Torch example equally held it in host RAM, and
-    # a production input pipeline overlaps transfers; timing a per-step
-    # host->device copy would benchmark this harness's PCIe/tunnel link, not
-    # the training system. Several distinct pre-staged rounds are cycled so
-    # no single batch is hot in any cache-like path.
-    gb = per_worker_batch * w
-    rng = np.random.default_rng(0)
-    n_staged = 8
-    # stage with the step's own input sharding (leading worker axis) — a
-    # default device_put would commit to device 0 and sneak a
-    # redistribute-to-mesh back INTO every timed step
-    sharding = topo.worker_sharding()
-    staged = []
-    for r in range(n_staged):
-        idx = rng.integers(0, len(x_tr), tau * gb)
-        xr, yr = trainer.round_batches(
-            x_tr[idx].reshape(tau, gb, 28, 28, 1),
-            y_tr[idx].reshape(tau, gb),
+
+# throughput-leg sizing per workload preset: (per-worker batch, timed
+# rounds), tuned so every leg times >= ~1 s of steady state at the rates
+# measured on one v5e chip — long enough that dispatch hiccups and clock
+# jitter are sub-percent.
+_PRESET_BENCH = {
+    "mnist-easgd": (256, 1500),
+    "cifar-vgg-sync": (256, 10_000),
+    "alexnet-downpour": (64, 6000),
+    "resnet50-sync": (32, 1000),
+    "ptb-lstm-easgd": (128, 6000),
+}
+
+
+def bench_preset(name: str, num_workers=None, cpu_smoke: bool = False) -> dict:
+    """Steady-state training samples/sec/chip for one BASELINE workload
+    config (same staging/timing harness as the headline metric)."""
+    import dataclasses
+
+    import optax
+
+    import mpit_tpu
+    from mpit_tpu.run import _build_model, _load_dataset, build_trainer
+    from mpit_tpu.utils.config import TrainConfig
+
+    if name not in _PRESET_BENCH:
+        raise ValueError(
+            f"unknown bench preset {name!r}; have {sorted(_PRESET_BENCH)}"
         )
-        staged.append(
-            (jax.device_put(xr, sharding), jax.device_put(yr, sharding))
-        )
+    pwb, rounds = _PRESET_BENCH[name]
+    image_cap = 128
+    if cpu_smoke:
+        # tiny wiring run: the XLA-CPU backend's conv compile time explodes
+        # with batch AND image size (see main()); shrink both
+        pwb, rounds, image_cap = 8, 3, 64
+    cfg = TrainConfig().apply_preset(name)
 
-    # warmup (compile)
-    for _ in range(3):
-        state, m = trainer._round(state, *staged[0])
-    jax.block_until_ready(m["loss"])
-
-    t0 = time.perf_counter()
-    for r in range(rounds):
-        state, m = trainer._round(state, *staged[r % n_staged])
-    jax.block_until_ready(m["loss"])
-    dt = time.perf_counter() - t0
-
-    samples = rounds * tau * gb
-    return {
-        "samples_per_sec": samples / dt,
-        "samples_per_sec_per_chip": samples / dt / w,
-        "chips": w,
-        "platform": topo.platform,
-        "tau": tau,
-        "per_worker_batch": per_worker_batch,
-    }
+    mpit_tpu.finalize()
+    topo = mpit_tpu.init(num_workers=num_workers)
+    gb = pwb * topo.num_workers
+    tau = 1 if cfg.algo == "sync" else cfg.tau
+    cfg = dataclasses.replace(
+        cfg, train_size=tau * gb * 2, image_size=min(cfg.image_size, image_cap)
+    )
+    x_tr, y_tr, *_rest, _meta = _load_dataset(cfg)
+    model = _build_model(cfg, _meta)
+    opt = optax.sgd(cfg.lr, momentum=cfg.momentum)
+    trainer = build_trainer(cfg, model, opt, topo)
+    res = _stage_and_time(
+        trainer, cfg.algo == "sync", topo, x_tr, y_tr, pwb, tau, rounds
+    )
+    return {**res, "algo": cfg.algo, "model": cfg.model}
 
 
 def measure_scaling_efficiency(full: dict) -> dict:
@@ -157,7 +228,25 @@ def main():
     _honor_platform_env()
     import jax
 
-    if jax.devices()[0].platform == "cpu":
+    cpu = jax.devices()[0].platform == "cpu"
+
+    if "--preset" in sys.argv:
+        name = sys.argv[sys.argv.index("--preset") + 1]
+        try:
+            res = bench_preset(name, cpu_smoke=cpu)
+        except ValueError as e:
+            print(str(e), file=sys.stderr)
+            return 2
+        print(json.dumps({
+            "metric": f"{name}_throughput",
+            "value": round(res["samples_per_sec_per_chip"], 1),
+            "unit": "samples/sec/chip",
+            "vs_baseline": None,  # only the headline config has a baseline
+            **{k: res[k] for k in ("chips", "algo", "model")},
+        }))
+        return
+
+    if cpu:
         # smoke-run sizing: a CPU mesh shares one host's cores AND the CPU
         # backend's conv compile time grows steeply with batch size (>200s
         # at 64/worker); keep the smoke run tiny — the number it prints is
@@ -184,6 +273,15 @@ def main():
         "platform": jax_res["platform"],
         **scaling,
     }
+    if "--all" in sys.argv:
+        out["configs"] = {
+            name: round(
+                bench_preset(name, cpu_smoke=cpu)["samples_per_sec_per_chip"],
+                1,
+            )
+            for name in _PRESET_BENCH
+            if name != "mnist-easgd"  # the headline metric above
+        }
     print(json.dumps(out))
 
 

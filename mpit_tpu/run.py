@@ -80,6 +80,25 @@ def _build_model(cfg: TrainConfig, meta: dict):
     return get_model(cfg.model)
 
 
+def build_trainer(cfg: TrainConfig, model, opt, topo):
+    """Collective trainer for ``cfg.algo`` (the single algo→trainer mapping;
+    the bench harness reuses it so both measure the exact same construction)."""
+    from mpit_tpu.parallel import (
+        DataParallelTrainer,
+        DownpourTrainer,
+        EASGDTrainer,
+    )
+
+    if cfg.algo == "easgd":
+        return EASGDTrainer(model, opt, topo, alpha=cfg.alpha, tau=cfg.tau)
+    if cfg.algo == "downpour":
+        return DownpourTrainer(model, opt, topo, tau=cfg.tau,
+                               staleness=cfg.staleness)
+    if cfg.algo == "sync":
+        return DataParallelTrainer(model, opt, topo)
+    raise ValueError(f"unknown algo {cfg.algo!r}")
+
+
 def run(cfg: TrainConfig) -> dict:
     """Train per ``cfg``; returns a results dict (acc, loss, throughput...).
 
@@ -113,21 +132,7 @@ def run(cfg: TrainConfig) -> dict:
         return _run_async_ps(cfg, model, opt, x_tr, y_tr, x_te, y_te,
                              log, results)
 
-    from mpit_tpu.parallel import (
-        DataParallelTrainer,
-        DownpourTrainer,
-        EASGDTrainer,
-    )
-
-    if cfg.algo == "easgd":
-        trainer = EASGDTrainer(model, opt, topo, alpha=cfg.alpha, tau=cfg.tau)
-    elif cfg.algo == "downpour":
-        trainer = DownpourTrainer(model, opt, topo, tau=cfg.tau,
-                                  staleness=cfg.staleness)
-    elif cfg.algo == "sync":
-        trainer = DataParallelTrainer(model, opt, topo)
-    else:
-        raise ValueError(f"unknown algo {cfg.algo!r}")
+    trainer = build_trainer(cfg, model, opt, topo)
 
     gb = max(cfg.global_batch // topo.num_workers, 1) * topo.num_workers
     state = trainer.init_state(jax.random.key(cfg.seed), x_tr[:2])
