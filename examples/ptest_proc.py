@@ -1,0 +1,131 @@
+"""ptest_proc — the MNIST PS example in the reference's literal shape:
+one OS process per rank, launched like mpirun (SURVEY.md §3(a)):
+
+    python -m mpit_tpu.launch -n 3 examples/ptest_proc.py --steps 100
+
+Rank→role split happens here, exactly as the reference's ptest.lua did it
+from its MPI rank: ranks [0, servers) are pservers, the rest pclients.
+Messages ride :class:`mpit_tpu.transport.SocketTransport` (TCP), addresses
+from ``MPIT_TRANSPORT_HOSTS`` (exported by the launcher; set it yourself
+across real hosts). Initial model state: every rank builds identical
+params from the shared seed — the deterministic-init equivalent of the
+reference's rank-0-construct + bcast.
+
+The protocol body is `mpit_tpu.parallel.ps_roles.client_train_loop` — the
+same code the thread-mode AsyncPSTrainer runs, so both modes are
+protocol-identical by construction.
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main():
+    from mpit_tpu.utils.config import TrainConfig
+
+    cfg = TrainConfig.from_args(description=__doc__)
+
+    import jax
+
+    if os.environ.get("JAX_PLATFORMS"):
+        jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
+
+    import jax.numpy as jnp
+    import numpy as np
+    import optax
+
+    from mpit_tpu.data import load_mnist
+    from mpit_tpu.data.datasets import shard_for_worker
+    from mpit_tpu.models import get_model
+    from mpit_tpu.parallel import ps_roles
+    from mpit_tpu.parallel.pclient import PClient
+    from mpit_tpu.parallel.pserver import PServer, partition_bounds
+    from mpit_tpu.transport import SocketTransport
+    from mpit_tpu.utils.params import flatten_params, unflatten_params
+
+    try:
+        rank = int(os.environ["MPIT_RANK"])
+        world = int(os.environ["MPIT_WORLD_SIZE"])
+    except KeyError:
+        raise SystemExit(
+            "MPIT_RANK/MPIT_WORLD_SIZE not set — run under "
+            "`python -m mpit_tpu.launch -n N examples/ptest_proc.py ...`"
+        )
+    num_servers = cfg.servers
+    num_clients = world - num_servers
+    if num_clients < 1:
+        raise SystemExit(
+            f"world of {world} with {num_servers} servers leaves no clients"
+        )
+    alpha = cfg.alpha if cfg.alpha is not None else 0.9 / num_clients
+
+    x_tr, y_tr, x_te, y_te = load_mnist(synthetic_train=cfg.train_size)
+    model = get_model(cfg.model)
+    opt = optax.sgd(cfg.lr, momentum=cfg.momentum)
+    # identical init on every rank from the shared seed (≡ rank-0 + bcast)
+    params0 = model.init(jax.random.key(cfg.seed), jnp.asarray(x_tr[:2]))[
+        "params"
+    ]
+    flat0, spec = flatten_params(params0)
+    flat0 = np.asarray(flat0, np.float32)
+
+    tp = SocketTransport(rank, world)
+    server_ranks = list(range(num_servers))
+    client_ranks = list(range(num_servers, world))
+    bounds = partition_bounds(flat0.size, num_servers)
+
+    if rank < num_servers:
+        start, end = bounds[rank]
+        server = PServer(
+            tp, flat0[start:end],
+            num_clients=num_clients, alpha=alpha,
+            client_ranks=client_ranks,
+            client_timeout=cfg.client_timeout,
+        )
+        server.start()  # blocks until every client stopped (or died)
+        print(
+            f"pserver rank {rank}: counts={server.counts} "
+            f"dead_clients={sorted(server.dead_clients)}"
+        )
+    else:
+        c = rank - num_servers
+        hb = cfg.client_timeout / 3 if cfg.client_timeout else None
+        client = PClient(
+            tp, server_ranks, flat0.size, heartbeat_interval=hb
+        )
+        xs = shard_for_worker(x_tr, c, num_clients)
+        ys = shard_for_worker(y_tr, c, num_clients)
+        local_step = ps_roles.make_local_step(model, opt)
+        per_client = max(cfg.global_batch // num_clients, 1)
+        losses = ps_roles.client_train_loop(
+            client, local_step, opt, spec, xs, ys,
+            steps=cfg.steps, batch_size=per_client, tau=cfg.tau,
+            algo=cfg.algo.removeprefix("ps-") if cfg.algo.startswith("ps-")
+            else "easgd",
+            alpha=alpha, seed=cfg.seed + 1000 + c,
+        )
+        if c == 0:
+            # final center fetch BEFORE stop (servers still serving)
+            center = unflatten_params(spec, jnp.asarray(client.fetch()))
+            apply = jax.jit(
+                lambda p, xb: model.apply({"params": p}, xb)
+            )
+            correct = 0
+            n = (len(x_te) // 512) * 512 or len(x_te)
+            for i in range(0, n, 512):
+                logits = apply(center, x_te[i : i + 512])
+                correct += int(
+                    np.sum(np.argmax(logits, -1) == y_te[i : i + 512])
+                )
+            print(
+                f"pclient 0: test acc={correct / n:.4f} "
+                f"final loss={losses[-1]:.4f}"
+            )
+        client.stop()
+    tp.close()
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,125 @@
+"""mpirun-equivalent local process launcher.
+
+Reference parity (SURVEY.md §1 launcher row, §3(a)): the reference was
+started as ``mpirun -n N th asyncsgd/ptest.lua`` — N OS processes, ranks
+discovered via MPI, rank→role split inside the script. This launcher is that
+layer for the host-async PS mode:
+
+    python -m mpit_tpu.launch -n 3 examples/ptest_proc.py [script args...]
+
+It allocates one TCP port per rank, exports the world to each child
+(``MPIT_RANK``, ``MPIT_WORLD_SIZE``, ``MPIT_TRANSPORT_HOSTS``), and
+supervises: first non-zero exit terminates the rest (the do-better over
+MPI's hang-on-dead-rank, SURVEY.md §5). Output is line-prefixed with the
+rank, mpirun-style. Single-host by design — across hosts you run one
+process per host yourself and set ``MPIT_TRANSPORT_HOSTS`` to the real
+addresses (same env contract).
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import signal
+import socket
+import subprocess
+import sys
+import threading
+
+
+def _free_ports(n: int) -> list[int]:
+    """Reserve n distinct free TCP ports (bind(0), read, close)."""
+    socks, ports = [], []
+    try:
+        for _ in range(n):
+            s = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+            s.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+            s.bind(("127.0.0.1", 0))
+            socks.append(s)
+            ports.append(s.getsockname()[1])
+    finally:
+        for s in socks:
+            s.close()
+    return ports
+
+
+def _stream(rank: int, pipe, out):
+    for line in iter(pipe.readline, b""):
+        out.write(f"[{rank}] ".encode() + line)
+        out.flush()
+    pipe.close()
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(
+        prog="python -m mpit_tpu.launch", description=__doc__
+    )
+    p.add_argument("-n", "--np", type=int, required=True, dest="n",
+                   help="number of processes (ranks)")
+    p.add_argument("script", help="python script to run in every rank")
+    p.add_argument("args", nargs=argparse.REMAINDER,
+                   help="arguments passed through to the script")
+    ns = p.parse_args(argv)
+    if ns.n < 1:
+        p.error("-n must be >= 1")
+
+    ports = _free_ports(ns.n)
+    hosts = ",".join(f"127.0.0.1:{port}" for port in ports)
+
+    procs: list[subprocess.Popen] = []
+    streams: list[threading.Thread] = []
+    for rank in range(ns.n):
+        env = dict(os.environ)
+        env["MPIT_RANK"] = str(rank)
+        env["MPIT_WORLD_SIZE"] = str(ns.n)
+        env["MPIT_TRANSPORT_HOSTS"] = hosts
+        proc = subprocess.Popen(
+            [sys.executable, ns.script, *ns.args],
+            env=env,
+            stdout=subprocess.PIPE,
+            stderr=subprocess.STDOUT,
+        )
+        procs.append(proc)
+        t = threading.Thread(
+            target=_stream, args=(rank, proc.stdout, sys.stdout.buffer),
+            daemon=True,
+        )
+        t.start()
+        streams.append(t)
+
+    rc = 0
+    try:
+        remaining = set(range(ns.n))
+        while remaining:
+            for r in sorted(remaining):
+                code = procs[r].poll()
+                if code is None:
+                    continue
+                remaining.discard(r)
+                if code != 0 and rc == 0:
+                    rc = code
+                    print(
+                        f"[launch] rank {r} exited with {code}; "
+                        "terminating the world",
+                        file=sys.stderr,
+                    )
+                    for other in sorted(remaining):
+                        procs[other].terminate()
+            if remaining:
+                try:
+                    procs[min(remaining)].wait(timeout=0.2)
+                except subprocess.TimeoutExpired:
+                    pass
+    except KeyboardInterrupt:
+        for proc in procs:
+            proc.send_signal(signal.SIGINT)
+        rc = 130
+    for proc in procs:
+        proc.wait()
+    for t in streams:
+        t.join(timeout=2)
+    return rc
+
+
+if __name__ == "__main__":
+    sys.exit(main())

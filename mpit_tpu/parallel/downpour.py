@@ -14,7 +14,7 @@ staleness is controlled, so its effect on convergence is testable
 (SURVEY.md §5 "race detection": property tests replace nondeterminism).
 
 True host-async Downpour (unbounded staleness, per-message ordering) lives in
-the host-async PS mode (``mpit_tpu.parallel.pserver``, in progress).
+the host-async PS mode (``mpit_tpu.parallel.pserver`` / ``ps_trainer``).
 """
 
 from __future__ import annotations

@@ -1,0 +1,89 @@
+"""Shared role bodies for the host-async PS protocol.
+
+One implementation of the client training loop, used by BOTH runtimes that
+the reference's single Lua codebase served (SURVEY.md §2 comps. 3-6):
+
+- thread mode — :class:`mpit_tpu.parallel.AsyncPSTrainer` (brokered
+  in-process transports, the default examples), and
+- process mode — ``examples/ptest_proc.py`` under ``python -m
+  mpit_tpu.launch -n N`` (one OS process per rank over TCP, the literal
+  ``mpirun`` shape).
+
+Keeping the protocol body in one place is what guarantees the two modes
+stay protocol-identical.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Optional
+
+import jax
+import numpy as np
+import optax
+
+from mpit_tpu.parallel import common
+from mpit_tpu.parallel.pclient import PClient
+from mpit_tpu.utils.params import FlatParamSpec, unflatten_params
+
+
+def make_local_step(
+    model, optimizer: optax.GradientTransformation,
+    loss_fn: Optional[Callable] = None,
+):
+    """Jitted ``(params, opt_state, x, y) -> (params, opt_state, loss)`` —
+    the client's on-device compute between exchanges."""
+    loss_fn = (
+        loss_fn if loss_fn is not None else common.default_loss_fn(model.apply)
+    )
+
+    def local_step(params, opt_state, x, y):
+        loss, g = jax.value_and_grad(loss_fn)(params, x, y)
+        updates, opt_state = optimizer.update(g, opt_state, params)
+        return optax.apply_updates(params, updates), opt_state, loss
+
+    return jax.jit(local_step)
+
+
+def client_train_loop(
+    client: PClient,
+    local_step,
+    optimizer: optax.GradientTransformation,
+    spec: FlatParamSpec,
+    x: np.ndarray,
+    y: np.ndarray,
+    steps: int,
+    batch_size: int,
+    tau: int,
+    algo: str,
+    alpha: float,
+    seed: int,
+) -> list[float]:
+    """The pclient side of SURVEY.md §3(b): τ jit-compiled local steps, then
+    push/pull per ``algo`` ("easgd" or "downpour"). Returns per-step losses.
+    Does NOT send stop — the caller owns teardown (it may want a final
+    ``client.fetch()`` for evaluation first)."""
+    import jax.numpy as jnp
+
+    from mpit_tpu.utils.params import flatten_params
+
+    rng = np.random.default_rng(seed)
+    params = unflatten_params(spec, jnp.asarray(client.fetch()))
+    opt_state = optimizer.init(params)
+    last_pull = np.asarray(flatten_params(params)[0])
+    losses: list[float] = []
+    for step in range(steps):
+        idx = rng.integers(0, len(x), batch_size)
+        params, opt_state, loss = local_step(params, opt_state, x[idx], y[idx])
+        losses.append(float(loss))
+        if (step + 1) % tau == 0:
+            flat = np.asarray(flatten_params(params)[0])
+            if algo == "easgd":
+                client.push_easgd(flat)
+                center = client.fetch()
+                flat = flat - alpha * (flat - center)
+            else:
+                client.push_delta(flat - last_pull)
+                flat = client.fetch()
+                last_pull = flat
+            params = unflatten_params(spec, jnp.asarray(flat))
+    return losses

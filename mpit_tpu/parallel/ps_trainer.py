@@ -22,7 +22,7 @@ import numpy as np
 import optax
 
 from mpit_tpu.data.datasets import shard_for_worker
-from mpit_tpu.parallel import common
+from mpit_tpu.parallel import common, ps_roles
 from mpit_tpu.parallel.pclient import PClient
 from mpit_tpu.parallel.pserver import PServer, partition_bounds, spawn_server_thread
 from mpit_tpu.transport import Broker
@@ -88,13 +88,11 @@ class AsyncPSTrainer:
         self.loss_fn = (
             loss_fn if loss_fn is not None else common.default_loss_fn(model.apply)
         )
-
-        def local_step(params, opt_state, x, y):
-            loss, g = jax.value_and_grad(self.loss_fn)(params, x, y)
-            updates, opt_state = self.optimizer.update(g, opt_state, params)
-            return optax.apply_updates(params, updates), opt_state, loss
-
-        self._local_step = jax.jit(local_step)
+        # one compiled local step shared by all client threads (same shapes,
+        # one compile; XLA releases the GIL so clients genuinely overlap)
+        self._local_step = ps_roles.make_local_step(
+            model, optimizer, self.loss_fn
+        )
 
     def _make_broker(self, size: int):
         if self.transport_kind in ("auto", "native"):
@@ -166,29 +164,13 @@ class AsyncPSTrainer:
                 client = PClient(
                     tp, server_ranks, flat0.size, heartbeat_interval=hb
                 )
-                rng = np.random.default_rng(seed + 1000 + c)
                 xs = shard_for_worker(x, c, self.num_clients)
                 ys = shard_for_worker(y, c, self.num_clients)
-                params = unflatten_params(spec, jnp.asarray(client.fetch()))
-                opt_state = self.optimizer.init(params)
-                last_pull = np.asarray(flatten_params(params)[0])
-                for step in range(steps):
-                    idx = rng.integers(0, len(xs), batch_size)
-                    params, opt_state, loss = self._local_step(
-                        params, opt_state, xs[idx], ys[idx]
-                    )
-                    losses[c].append(float(loss))
-                    if (step + 1) % self.tau == 0:
-                        flat = np.asarray(flatten_params(params)[0])
-                        if self.algo == "easgd":
-                            client.push_easgd(flat)
-                            center = client.fetch()
-                            flat = flat - self.alpha * (flat - center)
-                        else:
-                            client.push_delta(flat - last_pull)
-                            flat = client.fetch()
-                            last_pull = flat
-                        params = unflatten_params(spec, jnp.asarray(flat))
+                losses[c] = ps_roles.client_train_loop(
+                    client, self._local_step, self.optimizer, spec,
+                    xs, ys, steps, batch_size, self.tau, self.algo,
+                    self.alpha, seed=seed + 1000 + c,
+                )
                 client.stop()
             except BaseException as e:  # surface thread failures to caller
                 errors.append(e)

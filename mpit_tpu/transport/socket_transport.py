@@ -75,9 +75,14 @@ class SocketTransport(Transport):
         size: int,
         base_port: int = 29_500,
         addresses: Optional[Sequence[tuple[str, int]]] = None,
+        connect_retry_s: float = 30.0,
     ):
+        """``connect_retry_s``: window during which a refused outbound
+        connection is retried — under a process launcher the peers come up
+        at different times (mpirun gave the reference this for free)."""
         self.rank = rank
         self.size = size
+        self.connect_retry_s = float(connect_retry_s)
         self._addrs = (
             list(addresses) if addresses is not None else _addresses(size, base_port)
         )
@@ -146,7 +151,7 @@ class SocketTransport(Transport):
         with self._out_cache_lock:
             sock = self._out.get(dst)
         if sock is None:
-            sock = socket.create_connection(self._addrs[dst], timeout=30)
+            sock = self._connect_with_retry(dst)
             # back to blocking mode: a mid-frame timeout would desync the
             # length-prefixed stream for every later frame
             sock.settimeout(None)
@@ -154,6 +159,18 @@ class SocketTransport(Transport):
             with self._out_cache_lock:
                 self._out[dst] = sock
         return sock
+
+    def _connect_with_retry(self, dst: int) -> socket.socket:
+        import time as _time
+
+        deadline = _time.monotonic() + self.connect_retry_s
+        while True:
+            try:
+                return socket.create_connection(self._addrs[dst], timeout=30)
+            except ConnectionRefusedError:
+                if _time.monotonic() >= deadline or self._closing.is_set():
+                    raise
+                _time.sleep(0.1)  # peer not listening yet (startup skew)
 
     def _evict(self, dst: int) -> None:
         with self._out_cache_lock:
