@@ -24,12 +24,12 @@ import numpy as np
 
 def _honor_platform_env():
     """A sitecustomize-registered hardware backend wins over JAX_PLATFORMS
-    set after interpreter start; re-pin through the config API (same dance
-    as tests/conftest.py) so CPU-mesh runs of this harness work."""
+    set after interpreter start; re-pin through the config API (the shared
+    recipe in utils/vmesh.py) so CPU-mesh runs of this harness work."""
     if os.environ.get("JAX_PLATFORMS"):
-        import jax
+        from mpit_tpu.utils.vmesh import repin_platform
 
-        jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
+        repin_platform(os.environ["JAX_PLATFORMS"])
 
 
 def _stage_and_time(trainer, is_sync, topo, x_tr, y_tr, pwb, tau, rounds):

@@ -1,0 +1,39 @@
+"""Virtual host-mesh provisioning — the ONE copy of the platform re-pin recipe.
+
+A sitecustomize-registered hardware backend (axon) claims jax's platform at
+interpreter start, so ``JAX_PLATFORMS``/``XLA_FLAGS`` set afterwards do not
+stick on their own: the platform must be re-pinned through the config API
+before the first computation, and the device-count flag must be in
+``XLA_FLAGS`` before backend init.  This recipe was previously hand-rolled in
+three places (tests/conftest.py, bench.py, __graft_entry__.py); any future
+change to it belongs here only.
+
+Importing this module is safe pre-backend-init: the package ``__init__`` pulls
+in jax but runs no computation.
+"""
+
+import os
+import re
+
+_COUNT_FLAG = "--xla_force_host_platform_device_count"
+
+
+def repin_platform(platform: str) -> None:
+    """Re-pin jax's platform via the config API (env alone loses to a
+    sitecustomize-registered backend).  Call before any jax computation —
+    backend choice is sticky once initialized."""
+    import jax
+
+    os.environ["JAX_PLATFORMS"] = platform
+    jax.config.update("jax_platforms", platform)
+
+
+def force_virtual_devices(n: int, platform: str = "cpu") -> None:
+    """Expose an ``n``-device virtual host mesh on ``platform``.
+
+    Replaces any pre-existing device-count flag (CI images sometimes set
+    one).  Call before backend init.
+    """
+    flags = re.sub(_COUNT_FLAG + r"=\d+", "", os.environ.get("XLA_FLAGS", ""))
+    os.environ["XLA_FLAGS"] = (flags + f" {_COUNT_FLAG}={n}").strip()
+    repin_platform(platform)

@@ -9,25 +9,15 @@ env mutation at module import time.
 
 import os
 
-import re
-
-_FLAG = "--xla_force_host_platform_device_count=8"
-_existing = os.environ.get("XLA_FLAGS", "")
-# Replace any pre-existing device-count flag (CI images sometimes set one);
-# the tests hard-assume 8 workers.
-_cleaned = re.sub(r"--xla_force_host_platform_device_count=\d+", "", _existing)
-os.environ["XLA_FLAGS"] = (_cleaned + " " + _FLAG).strip()
-os.environ["JAX_PLATFORMS"] = "cpu"
 os.environ.setdefault("JAX_ENABLE_X64", "0")
 
-import pytest  # noqa: E402
-import jax  # noqa: E402
+# The tests hard-assume 8 workers; the re-pin recipe (flag scrub + config-API
+# platform update before backend init) lives in one place: utils/vmesh.py.
+from mpit_tpu.utils.vmesh import force_virtual_devices  # noqa: E402
 
-# Some images register a hardware backend from sitecustomize at interpreter
-# startup (before this conftest runs), which pins jax's platform despite the
-# env var above. Re-pin to CPU through the config API — effective as long as
-# no computation has run yet.
-jax.config.update("jax_platforms", "cpu")
+force_virtual_devices(8)
+
+import pytest  # noqa: E402
 
 import mpit_tpu  # noqa: E402
 
@@ -46,4 +36,6 @@ def topo8():
 
 
 def pytest_report_header(config):
+    import jax
+
     return f"mpit_tpu test mesh: {jax.device_count()} virtual CPU devices"
