@@ -3,8 +3,9 @@
 On-disk formats supported when present under ``$MPIT_DATA_DIR``:
 - MNIST: the standard idx files (``train-images-idx3-ubyte`` etc.), parsed
   in numpy.
-- CIFAR-10: the python/bin batches are NOT parsed here (keep the surface
-  small); synthetic CIFAR-shaped data is used unless ``.npz`` caches exist.
+- CIFAR-10: the standard binary batches (``data_batch_1..5.bin`` +
+  ``test_batch.bin``), or an ``.npz`` cache; synthetic CIFAR-shaped data
+  otherwise.
 
 Everything returns plain numpy; device placement and sharding are the
 trainers' job (data loading stays on host, off the TPU hot path).
@@ -72,11 +73,51 @@ def load_mnist(synthetic_train: int = 8192, synthetic_test: int = 2048):
     )
 
 
+def _read_cifar10_bin(paths: list[str]) -> tuple[np.ndarray, np.ndarray]:
+    """Parse standard CIFAR-10 binary batches (``data_batch_*.bin`` /
+    ``test_batch.bin``): records of 1 label byte + 3072 pixel bytes laid
+    out channel-planar (3, 32, 32). Returns (x in NHWC [0,1], y int32)."""
+    record = 1 + 3 * 32 * 32
+    xs, ys = [], []
+    for p in paths:
+        opener = gzip.open if p.endswith(".gz") else open
+        with opener(p, "rb") as f:
+            raw = np.frombuffer(f.read(), dtype=np.uint8)
+        if raw.size == 0 or raw.size % record != 0:
+            raise ValueError(
+                f"{p}: size {raw.size} is not a multiple of the "
+                f"{record}-byte CIFAR-10 record"
+            )
+        rows = raw.reshape(-1, record)
+        ys.append(rows[:, 0].astype(np.int32))
+        xs.append(
+            rows[:, 1:]
+            .reshape(-1, 3, 32, 32)
+            .transpose(0, 2, 3, 1)
+            .astype(np.float32)
+            / 255.0
+        )
+    return np.concatenate(xs), np.concatenate(ys)
+
+
 def load_cifar10(synthetic_train: int = 8192, synthetic_test: int = 2048):
-    """CIFAR-10-shaped data (N,32,32,3); synthetic unless an .npz cache
-    (``cifar10.npz`` with x_train/y_train/x_test/y_test) is present."""
+    """CIFAR-10 as (x_train, y_train, x_test, y_test), images (N,32,32,3)
+    in [0,1]. Prefers the standard binary batches (``data_batch_1..5.bin``
+    + ``test_batch.bin``, optionally gzipped, under ``$MPIT_DATA_DIR``
+    directly or in a ``cifar-10-batches-bin/`` subdir), then an ``.npz``
+    cache, then learnable synthetic data."""
     d = _data_dir()
     if d:
+        for sub in ("", "cifar-10-batches-bin"):
+            base = os.path.join(d, sub) if sub else d
+            train = [
+                _find(base, f"data_batch_{i}.bin") for i in range(1, 6)
+            ]
+            test = _find(base, "test_batch.bin")
+            if all(train) and test:
+                x_tr, y_tr = _read_cifar10_bin(train)
+                x_te, y_te = _read_cifar10_bin([test])
+                return x_tr, y_tr, x_te, y_te
         p = os.path.join(d, "cifar10.npz")
         if os.path.exists(p):
             z = np.load(p)

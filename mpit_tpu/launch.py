@@ -27,20 +27,22 @@ import sys
 import threading
 
 
-def _free_ports(n: int) -> list[int]:
-    """Reserve n distinct free TCP ports (bind(0), read, close)."""
+def _reserve_ports(n: int) -> tuple[list[socket.socket], list[int]]:
+    """Reserve n distinct free TCP ports; the RESERVING SOCKETS STAY OPEN.
+
+    The caller closes each one immediately before spawning the rank that
+    will bind it — shrinking the steal window (another process grabbing the
+    port between reservation and child bind) from the whole launch sequence
+    to one process spawn. The child surfaces a clear error if it loses even
+    that race (SocketTransport's bind diagnostic)."""
     socks, ports = [], []
-    try:
-        for _ in range(n):
-            s = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
-            s.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
-            s.bind(("127.0.0.1", 0))
-            socks.append(s)
-            ports.append(s.getsockname()[1])
-    finally:
-        for s in socks:
-            s.close()
-    return ports
+    for _ in range(n):
+        s = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        s.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        s.bind(("127.0.0.1", 0))
+        socks.append(s)
+        ports.append(s.getsockname()[1])
+    return socks, ports
 
 
 def _stream(rank: int, pipe, out):
@@ -63,7 +65,7 @@ def main(argv=None) -> int:
     if ns.n < 1:
         p.error("-n must be >= 1")
 
-    ports = _free_ports(ns.n)
+    reserving, ports = _reserve_ports(ns.n)
     hosts = ",".join(f"127.0.0.1:{port}" for port in ports)
 
     procs: list[subprocess.Popen] = []
@@ -73,6 +75,8 @@ def main(argv=None) -> int:
         env["MPIT_RANK"] = str(rank)
         env["MPIT_WORLD_SIZE"] = str(ns.n)
         env["MPIT_TRANSPORT_HOSTS"] = hosts
+        # release this rank's port only now, right before its process exists
+        reserving[rank].close()
         proc = subprocess.Popen(
             [sys.executable, ns.script, *ns.args],
             env=env,

@@ -64,6 +64,13 @@ def _load() -> ctypes.CDLL:
             ctypes.c_void_p, ctypes.c_int, ctypes.c_int, ctypes.c_int,
         ]
         lib.mpit_broker_probe.restype = ctypes.c_int
+        lib.mpit_broker_probe_wait.argtypes = [
+            ctypes.c_void_p, ctypes.c_int, ctypes.c_int, ctypes.c_int,
+            ctypes.c_double,
+        ]
+        lib.mpit_broker_probe_wait.restype = ctypes.c_int
+        lib.mpit_lease_free.argtypes = [ctypes.c_void_p, ctypes.c_int64]
+        lib.mpit_lease_free.restype = ctypes.c_int
         lib.mpit_lease_info.argtypes = [
             ctypes.c_void_p, ctypes.c_int64,
             ctypes.POINTER(ctypes.c_int), ctypes.POINTER(ctypes.c_int),
@@ -149,17 +156,26 @@ class NativeBroker:
         with self._op():
             lease = self._lib.mpit_broker_recv(self._h, rank, src, tag, t)
             if lease >= 0:
-                m_src = ctypes.c_int()
-                m_tag = ctypes.c_int()
-                m_len = ctypes.c_uint64()
-                if self._lib.mpit_lease_info(
-                    self._h, lease, ctypes.byref(m_src), ctypes.byref(m_tag),
-                    ctypes.byref(m_len),
-                ) != 0:
-                    raise RuntimeError("native lease vanished")
-                buf = ctypes.create_string_buffer(max(m_len.value, 1))
-                if self._lib.mpit_lease_copy_free(self._h, lease, buf) != 0:
-                    raise RuntimeError("native lease copy failed")
+                # any failure between acquiring the lease and copy_free must
+                # drop the lease C-side, or the parked message leaks for the
+                # broker's lifetime (copy_free is the only other release)
+                try:
+                    m_src = ctypes.c_int()
+                    m_tag = ctypes.c_int()
+                    m_len = ctypes.c_uint64()
+                    if self._lib.mpit_lease_info(
+                        self._h, lease, ctypes.byref(m_src),
+                        ctypes.byref(m_tag), ctypes.byref(m_len),
+                    ) != 0:
+                        raise RuntimeError("native lease vanished")
+                    buf = ctypes.create_string_buffer(max(m_len.value, 1))
+                    if self._lib.mpit_lease_copy_free(
+                        self._h, lease, buf
+                    ) != 0:
+                        raise RuntimeError("native lease copy failed")
+                except BaseException:
+                    self._lib.mpit_lease_free(self._h, lease)
+                    raise
         if lease == -1:
             raise RecvTimeout(
                 f"no message from src={src} tag={tag} within {timeout}s"
@@ -175,11 +191,22 @@ class NativeBroker:
             src=m_src.value, dst=rank, tag=m_tag.value, payload=payload
         )
 
-    def _probe(self, rank: int, src: int, tag: int) -> bool:
+    def _probe(
+        self, rank: int, src: int, tag: int, timeout: Optional[float] = 0
+    ) -> bool:
+        if timeout == 0:
+            with self._op():
+                rc = self._lib.mpit_broker_probe(self._h, rank, src, tag)
+            if rc < 0:
+                raise RuntimeError(f"native probe failed (rc={rc})")
+            return bool(rc)
+        t = -1.0 if timeout is None else float(timeout)
         with self._op():
-            rc = self._lib.mpit_broker_probe(self._h, rank, src, tag)
+            rc = self._lib.mpit_broker_probe_wait(self._h, rank, src, tag, t)
+        if rc == -3:
+            raise RuntimeError("native broker closed during probe")
         if rc < 0:
-            raise RuntimeError(f"native probe failed (rc={rc})")
+            raise RuntimeError(f"native probe_wait failed (rc={rc})")
         return bool(rc)
 
     def close(self) -> None:
@@ -225,5 +252,10 @@ class NativeTransport(Transport):
     ) -> Message:
         return self._broker._recv(self.rank, src, tag, timeout)
 
-    def probe(self, src: int = ANY_SOURCE, tag: int = ANY_TAG) -> bool:
-        return self._broker._probe(self.rank, src, tag)
+    def probe(
+        self,
+        src: int = ANY_SOURCE,
+        tag: int = ANY_TAG,
+        timeout: Optional[float] = 0,
+    ) -> bool:
+        return self._broker._probe(self.rank, src, tag, timeout)

@@ -17,8 +17,12 @@
 //   mpit_broker_recv(h, rank, src, tag, t_s)  -> lease id >= 0 | -1 timeout
 //                                                | -2 bad args | -3 closed
 //   mpit_broker_probe(h, rank, src, tag)      -> 1 / 0 / -1
+//   mpit_broker_probe_wait(h, rank, src, tag, t_s)
+//                                             -> 1 found | 0 timeout
+//                                                | -2 bad args | -3 closed
 //   mpit_lease_info(h, lease, &src, &tag, &len)
 //   mpit_lease_copy_free(h, lease, out)       -> copies payload, ends lease
+//   mpit_lease_free(h, lease)                 -> drops payload, ends lease
 //   mpit_broker_shutdown(h)                   -> refuse new work, wake waiters
 //   mpit_broker_destroy(h)                    -> shutdown + drain + free
 //
@@ -195,6 +199,49 @@ int mpit_broker_probe(void* h, int rank, int src, int tag) {
     if (Matches(m, src, tag)) return 1;
   }
   return 0;
+}
+
+// Blocking probe (MPI_Probe parity): park until a matching message is
+// available WITHOUT consuming it. timeout_s < 0 blocks indefinitely.
+// Returns 1 found, 0 timeout, -2 bad args, -3 woken by shutdown.
+int mpit_broker_probe_wait(void* h, int rank, int src, int tag,
+                           double timeout_s) {
+  auto* b = static_cast<Broker*>(h);
+  if (b == nullptr || rank < 0 || rank >= b->size) return -2;
+  OpGuard op(b);
+  Mailbox& box = b->boxes[rank];
+  bool found = false;
+  {
+    std::unique_lock<std::mutex> lk(box.mu);
+    auto ready = [&] {
+      if (b->shutting_down.load()) return true;
+      for (const Msg& m : box.q) {
+        if (Matches(m, src, tag)) {
+          found = true;
+          return true;
+        }
+      }
+      return false;
+    };
+    if (timeout_s < 0) {
+      box.cv.wait(lk, ready);
+    } else {
+      auto dur = std::chrono::duration<double>(timeout_s);
+      if (!box.cv.wait_for(lk, dur, ready)) return 0;
+    }
+  }
+  return found ? 1 : -3;
+}
+
+// Drop a parked lease without copying its payload — the error-path cleanup
+// for a receiver that failed between recv and copy_free (otherwise the
+// message would sit in the lease map for the broker's lifetime).
+int mpit_lease_free(void* h, int64_t lease) {
+  auto* b = static_cast<Broker*>(h);
+  if (b == nullptr) return -1;
+  OpGuard op(b);
+  std::lock_guard<std::mutex> g(b->lease_mu);
+  return b->leases.erase(lease) != 0 ? 0 : -1;
 }
 
 int mpit_lease_info(void* h, int64_t lease, int* src, int* tag,

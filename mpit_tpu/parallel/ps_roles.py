@@ -78,8 +78,14 @@ def client_train_loop(
         if (step + 1) % tau == 0:
             flat = np.asarray(flatten_params(params)[0])
             if algo == "easgd":
-                client.push_easgd(flat)
+                # fetch BEFORE push so the client's elastic move uses the
+                # pre-push center — the paper's update order (both moves on
+                # the old center), and the same order goptim.easgd_round
+                # implements for the collective path. Push-then-fetch would
+                # couple against a center already moved by this client's own
+                # push (an alpha*(1-alpha) effective move).
                 center = client.fetch()
+                client.push_easgd(flat)
                 flat = flat - alpha * (flat - center)
             else:
                 client.push_delta(flat - last_pull)

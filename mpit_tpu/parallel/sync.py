@@ -148,6 +148,9 @@ class DataParallelTrainer:
         every trained step."""
         metrics = None
         steps = 0
+        # one host fetch up front so log lines can number steps across
+        # resume without a per-step device round-trip
+        base_step = int(state.step) if log_every else 0
         for e in range(start_epoch, epochs):
             to_skip = skip_steps if e == start_epoch else 0
             for x, y in batches.epoch(e):
@@ -158,9 +161,13 @@ class DataParallelTrainer:
                 steps += 1
                 if on_step is not None:
                     on_step(steps, state, metrics)
-                if log_every and int(state.step) % log_every == 0:
+                # gate on the HOST step counter: `int(state.step)` every
+                # step would force a device round-trip per step (a real
+                # throughput tax on the tunnel); only the logged steps may
+                # fetch device values
+                if log_every and steps % log_every == 0:
                     print(
-                        f"[sync-dp] step={int(state.step)} "
+                        f"[sync-dp] step={base_step + steps} "
                         f"loss={float(metrics['loss']):.4f}"
                     )
         return state, metrics

@@ -218,9 +218,27 @@ def run(cfg: TrainConfig) -> dict:
 
 
 def _run_async_ps(cfg, model, opt, x_tr, y_tr, x_te, y_te, log, results):
-    """The reference's literal pclient/pserver shape (BASELINE.json:7)."""
-    from mpit_tpu.parallel import AsyncPSTrainer
+    """The reference's literal pclient/pserver shape (BASELINE.json:7).
 
+    Aux-flag support in this mode (round-1 advisor: these used to be silent
+    no-ops): ``profile_dir`` traces the whole async run; ``ckpt_dir`` writes
+    a final center checkpoint; ``log_every`` logs the per-step client losses
+    post-hoc (there is no global step during the run — clients are
+    asynchronous by design). ``resume``/``ckpt_every`` have no meaningful
+    mid-stream semantics here and WARN instead of silently ignoring."""
+    import warnings
+
+    from mpit_tpu.parallel import AsyncPSTrainer
+    from mpit_tpu.utils import save_checkpoint, trace
+
+    for flag in ("resume", "ckpt_every"):
+        if getattr(cfg, flag):
+            warnings.warn(
+                f"{flag!r} is not supported with algo={cfg.algo!r} "
+                "(async PS has no deterministic mid-stream schedule to "
+                "re-enter); ignoring",
+                stacklevel=3,
+            )
     alpha = cfg.alpha if cfg.alpha is not None else 0.9 / cfg.clients
     trainer = AsyncPSTrainer(
         model, opt,
@@ -232,15 +250,28 @@ def _run_async_ps(cfg, model, opt, x_tr, y_tr, x_te, y_te, log, results):
     )
     per_client = max(cfg.global_batch // cfg.clients, 1)
     t0 = time.perf_counter()
-    center, stats = trainer.train(
-        x_tr, y_tr, steps=cfg.steps, batch_size=per_client, seed=cfg.seed
-    )
+    with trace(cfg.profile_dir):
+        center, stats = trainer.train(
+            x_tr, y_tr, steps=cfg.steps, batch_size=per_client, seed=cfg.seed
+        )
     wall = time.perf_counter() - t0
     acc = trainer.evaluate(center, x_te, y_te)
     if cfg.dataset == "ptb":
         acc = acc / cfg.seq_len
     samples = cfg.steps * per_client * cfg.clients
+    if cfg.log_every:
+        # stop before the final step — the summary line below logs it
+        for s in range(cfg.log_every - 1, cfg.steps - 1, cfg.log_every):
+            step_losses = [l[s] for l in stats["losses"] if len(l) > s]
+            if step_losses:
+                log.log(s + 1, loss=float(np.mean(step_losses)))
     log.log(cfg.steps, loss=stats["mean_final_loss"], accuracy=acc)
+    if cfg.ckpt_dir:
+        save_checkpoint(
+            cfg.ckpt_dir, center, step=cfg.steps,
+            metadata={"config": cfg.to_json(), "kind": "ps_center"},
+        )
+        results["last_checkpoint"] = cfg.steps
     results.update(
         accuracy=acc,
         final_loss=stats["mean_final_loss"],

@@ -29,19 +29,34 @@ class Message:
 
 
 class SendHandle:
-    """Handle returned by isend (completes immediately for queued local
-    delivery; socket sends complete when written)."""
+    """Handle returned by isend (mpiT's ``Isend``/``Wait`` pair).
+
+    Completes immediately for queued local delivery; socket isends complete
+    when the frame is written by the background sender. A failed async send
+    parks its exception here and re-raises it from :meth:`wait` — errors
+    must reach the caller, not die in a worker thread."""
 
     def __init__(self):
         self._done = threading.Event()
+        self._error: Optional[BaseException] = None
 
     def set_done(self):
         self._done.set()
+
+    def set_error(self, exc: BaseException):
+        self._error = exc
+        self._done.set()
+
+    def done(self) -> bool:
+        """Non-blocking completion check (MPI_Test parity)."""
+        return self._done.is_set()
 
     def wait(self, timeout: Optional[float] = None) -> bool:
         ok = self._done.wait(timeout)
         if not ok:
             raise RecvTimeout("isend not complete before timeout")
+        if self._error is not None:
+            raise self._error
         return True
 
 
@@ -91,9 +106,17 @@ class Transport:
         return RecvHandle(lambda timeout: self.recv(src, tag, timeout))
 
     def probe(
-        self, src: int = ANY_SOURCE, tag: int = ANY_TAG
+        self,
+        src: int = ANY_SOURCE,
+        tag: int = ANY_TAG,
+        timeout: Optional[float] = 0,
     ) -> bool:
-        """Non-blocking: is a matching message waiting?"""
+        """Is a matching message waiting (without consuming it)?
+
+        ``timeout=0`` polls (MPI_Iprobe), ``timeout=None`` blocks until a
+        match arrives (MPI_Probe), ``timeout>0`` waits at most that long.
+        Returns False on expiry rather than raising — probing for absence
+        is a legitimate outcome, unlike an expired recv."""
         raise NotImplementedError
 
     def close(self) -> None:

@@ -72,6 +72,28 @@ class Broker:
         with self._conds[dst]:
             return any(m.matches(src, tag) for m in self._queues[dst])
 
+    def peek_wait(
+        self,
+        dst: int,
+        src: int = ANY_SOURCE,
+        tag: int = ANY_TAG,
+        timeout: Optional[float] = None,
+    ) -> bool:
+        """Blocking peek: wait (up to ``timeout``; None = forever) for a
+        matching message WITHOUT consuming it. False on expiry."""
+        cond = self._conds[dst]
+        deadline = None if timeout is None else time.monotonic() + timeout
+        with cond:
+            while True:
+                if any(m.matches(src, tag) for m in self._queues[dst]):
+                    return True
+                if deadline is None:
+                    cond.wait()
+                else:
+                    remaining = deadline - time.monotonic()
+                    if remaining <= 0 or not cond.wait(remaining):
+                        return False
+
     def transports(self) -> list["InProcTransport"]:
         return [InProcTransport(self, r) for r in range(self.size)]
 
@@ -93,5 +115,12 @@ class InProcTransport(Transport):
     ) -> Message:
         return self.broker.get(self.rank, src, tag, timeout)
 
-    def probe(self, src: int = ANY_SOURCE, tag: int = ANY_TAG) -> bool:
-        return self.broker.peek(self.rank, src, tag)
+    def probe(
+        self,
+        src: int = ANY_SOURCE,
+        tag: int = ANY_TAG,
+        timeout: Optional[float] = 0,
+    ) -> bool:
+        if timeout == 0:
+            return self.broker.peek(self.rank, src, tag)
+        return self.broker.peek_wait(self.rank, src, tag, timeout)

@@ -37,7 +37,13 @@ import struct
 import threading
 from typing import Any, Optional, Sequence
 
-from mpit_tpu.transport.base import ANY_SOURCE, ANY_TAG, Message, Transport
+from mpit_tpu.transport.base import (
+    ANY_SOURCE,
+    ANY_TAG,
+    Message,
+    SendHandle,
+    Transport,
+)
 from mpit_tpu.transport.inproc import Broker
 
 _LEN = struct.Struct(">Q")
@@ -97,11 +103,22 @@ class SocketTransport(Transport):
         # per-destination lock: a slow connect/send to one rank must not
         # serialize traffic to healthy ranks
         self._dst_locks: dict[int, threading.Lock] = {}
+        # per-destination outbound queues drained by lazily-created sender
+        # threads: isend returns immediately, and because send() rides the
+        # same queue, send/isend to one dst stay FIFO (the MPI order rule)
+        self._send_queues: dict[int, "_SendQueue"] = {}
         self._closing = threading.Event()
 
         self._listener = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
         self._listener.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
-        self._listener.bind(self._addrs[rank])
+        try:
+            self._listener.bind(self._addrs[rank])
+        except OSError as e:
+            raise OSError(
+                f"rank {rank}: cannot bind {self._addrs[rank]} ({e}). "
+                "If launched via mpit_tpu.launch, another process likely "
+                "took the port between reservation and startup — relaunch."
+            ) from e
         self._listener.listen(size)
         self._accept_thread = threading.Thread(
             target=self._accept_loop, daemon=True
@@ -181,11 +198,7 @@ class SocketTransport(Transport):
             except OSError:
                 pass
 
-    # -- Transport API ----------------------------------------------------
-
-    def send(self, dst: int, tag: int, payload: Any) -> None:
-        blob = pickle.dumps((self.rank, tag, payload), protocol=5)
-        frame = _LEN.pack(len(blob)) + blob
+    def _write_frame(self, dst: int, frame: bytes) -> None:
         with self._dst_lock(dst):
             try:
                 self._connection(dst).sendall(frame)
@@ -197,6 +210,26 @@ class SocketTransport(Transport):
                 self._evict(dst)
                 self._connection(dst).sendall(frame)
 
+    def _send_queue(self, dst: int) -> "_SendQueue":
+        with self._out_cache_lock:
+            q = self._send_queues.get(dst)
+            if q is None:
+                q = self._send_queues[dst] = _SendQueue(self, dst)
+            return q
+
+    # -- Transport API ----------------------------------------------------
+
+    def send(self, dst: int, tag: int, payload: Any) -> None:
+        self.isend(dst, tag, payload).wait()
+
+    def isend(self, dst: int, tag: int, payload: Any) -> SendHandle:
+        """Genuinely asynchronous: the frame (serialized NOW — the payload
+        is captured at call time, per MPI buffer semantics) is handed to the
+        dst's sender thread; the handle completes when it is written."""
+        blob = pickle.dumps((self.rank, tag, payload), protocol=5)
+        frame = _LEN.pack(len(blob)) + blob
+        return self._send_queue(dst).enqueue(frame)
+
     def recv(
         self,
         src: int = ANY_SOURCE,
@@ -206,11 +239,22 @@ class SocketTransport(Transport):
         msg = self._mailbox.get(0, src, tag, timeout)
         return Message(src=msg.src, dst=self.rank, tag=msg.tag, payload=msg.payload)
 
-    def probe(self, src: int = ANY_SOURCE, tag: int = ANY_TAG) -> bool:
-        return self._mailbox.peek(0, src, tag)
+    def probe(
+        self,
+        src: int = ANY_SOURCE,
+        tag: int = ANY_TAG,
+        timeout: Optional[float] = 0,
+    ) -> bool:
+        if timeout == 0:
+            return self._mailbox.peek(0, src, tag)
+        return self._mailbox.peek_wait(0, src, tag, timeout)
 
     def close(self) -> None:
         self._closing.set()
+        with self._out_cache_lock:
+            queues = list(self._send_queues.values())
+        for q in queues:
+            q.shutdown()
         try:
             self._listener.close()
         except OSError:
@@ -222,3 +266,60 @@ class SocketTransport(Transport):
                 except OSError:
                     pass
             self._out.clear()
+
+
+class _SendQueue:
+    """One destination's outbound frame queue + its sender thread.
+
+    FIFO by construction (single drainer), which is what lets send() and
+    isend() interleave without breaking MPI's per-(src, dst, tag) order
+    guarantee. Write errors are parked on the frame's SendHandle — a sync
+    send() re-raises them from wait(); a fire-and-forget isend keeps them
+    inspectable instead of crashing a daemon thread."""
+
+    def __init__(self, transport: "SocketTransport", dst: int):
+        self._transport = transport
+        self._dst = dst
+        self._cond = threading.Condition()
+        self._items: list[tuple[bytes, SendHandle]] = []
+        self._stopped = False
+        self._thread = threading.Thread(
+            target=self._drain,
+            name=f"mpit-send-r{transport.rank}-d{dst}",
+            daemon=True,
+        )
+        self._thread.start()
+
+    def enqueue(self, frame: bytes) -> SendHandle:
+        h = SendHandle()
+        with self._cond:
+            if self._stopped:
+                h.set_error(ConnectionError("transport closed"))
+                return h
+            self._items.append((frame, h))
+            self._cond.notify()
+        return h
+
+    def shutdown(self) -> None:
+        with self._cond:
+            self._stopped = True
+            pending = self._items
+            self._items = []
+            self._cond.notify()
+        for _frame, h in pending:
+            h.set_error(ConnectionError("transport closed with send pending"))
+
+    def _drain(self) -> None:
+        while True:
+            with self._cond:
+                while not self._items and not self._stopped:
+                    self._cond.wait()
+                if self._stopped and not self._items:
+                    return
+                frame, h = self._items.pop(0)
+            try:
+                self._transport._write_frame(self._dst, frame)
+            except BaseException as e:
+                h.set_error(e)
+            else:
+                h.set_done()

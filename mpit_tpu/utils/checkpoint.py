@@ -8,9 +8,12 @@ optimizer state + step/round counters + the EASGD center variable — resume
 serialized with flax's msgpack codec, written atomically (tmp + rename), with
 retention of the last ``keep`` checkpoints.
 
-Multi-host: only process 0 writes (every process holds identical replicated
-state for the center/replicated leaves; per-worker-sharded leaves are
-all-gathered implicitly by ``jax.device_get``). Every process restores.
+Multi-host: only process 0 writes. Replicated leaves are fetched directly;
+per-worker-sharded leaves are NOT fully addressable on a multi-host mesh
+(``jax.device_get`` would raise), so they are explicitly all-gathered to every
+process first — a collective, which is why ``save_checkpoint`` materializes
+the host state on ALL processes before its process-0 gate. Every process
+restores.
 """
 
 from __future__ import annotations
@@ -29,6 +32,29 @@ _CKPT_RE = re.compile(r"^ckpt_(\d{8,})\.msgpack$")
 
 def _ckpt_path(directory: str, step: int) -> str:
     return os.path.join(directory, f"ckpt_{step:08d}.msgpack")
+
+
+def _leaf_to_host(leaf):
+    """Fetch one leaf to host memory.
+
+    A worker-sharded leaf on a multi-host mesh spans devices this process
+    cannot address, and ``jax.device_get`` raises on it; all-gather it to
+    every process instead. The allgather is a COLLECTIVE — every process
+    must reach it, so callers must map this over the full state on all
+    processes before any process-0-only gating. On a single host
+    (``is_fully_addressable``) it degrades to a plain ``device_get``.
+    """
+    if isinstance(leaf, jax.Array) and not leaf.is_fully_addressable:
+        from jax.experimental import multihost_utils
+
+        return multihost_utils.process_allgather(leaf, tiled=True)
+    return jax.device_get(leaf)
+
+
+def state_to_host(state: Any) -> Any:
+    """Materialize a (possibly sharded) state pytree as host numpy arrays.
+    Collective on multi-host meshes — call from every process."""
+    return jax.tree.map(_leaf_to_host, state)
 
 
 def list_checkpoints(directory: str) -> list[int]:
@@ -60,10 +86,12 @@ def save_checkpoint(
     Returns the written path, or None on non-zero processes (which don't
     write — their state is a replica).
     """
+    # collective (multi-host allgather of sharded leaves) — must precede the
+    # process-0 gate or non-zero processes deadlock the gather
+    host_state = state_to_host(state)
     if jax.process_index() != 0:
         return None
     os.makedirs(directory, exist_ok=True)
-    host_state = jax.device_get(state)
     payload = serialization.to_bytes(host_state)
     path = _ckpt_path(directory, step)
     fd, tmp = tempfile.mkstemp(dir=directory, suffix=".tmp")
@@ -110,7 +138,7 @@ def restore_checkpoint(
     path = _ckpt_path(directory, step)
     with open(path, "rb") as f:
         payload = f.read()
-    state = serialization.from_bytes(jax.device_get(template), payload)
+    state = serialization.from_bytes(state_to_host(template), payload)
     if shardings is not None:
         state = jax.device_put(state, shardings)
     return state, step

@@ -205,3 +205,23 @@ class TestNativePSTrainer:
         assert stats["server_counts"][0]["push_easgd"] == 2 * (16 // 4)
         acc = tr.evaluate(center, xt, yt)
         assert 0.0 <= acc <= 1.0
+
+
+def test_native_blocking_probe(b3):
+    """C-side probe_wait: parks off-GIL until a match arrives, without
+    consuming it; times out to False."""
+    import threading
+    import time
+
+    tps = b3.transports()
+    assert tps[1].probe(timeout=0.05) is False
+
+    def later():
+        time.sleep(0.15)
+        tps[0].send(1, tag=5, payload=b"x")
+
+    threading.Thread(target=later, daemon=True).start()
+    t0 = time.monotonic()
+    assert tps[1].probe(src=0, tag=5, timeout=5) is True
+    assert time.monotonic() - t0 < 4
+    assert tps[1].recv(0, 5, timeout=1).payload == b"x"

@@ -162,3 +162,57 @@ class TestSocket:
         assert rx.recv(src=1, tag=1, timeout=10).payload == "after-restart"
         tx2.close()
         rx.close()
+
+
+class TestProbeAndIsend:
+    """mpiT L2 parity items from round-1 verdict #9: MPI_Probe blocks;
+    Isend genuinely overlaps."""
+
+    def test_blocking_probe_inproc(self):
+        tps = Broker(2).transports()
+        assert tps[1].probe(timeout=0.05) is False  # expiry -> False
+        def later():
+            time.sleep(0.15)
+            tps[0].send(1, tag=5, payload="x")
+        threading.Thread(target=later, daemon=True).start()
+        t0 = time.monotonic()
+        assert tps[1].probe(src=0, tag=5, timeout=5) is True
+        assert time.monotonic() - t0 < 4
+        # probe must not consume
+        assert tps[1].recv(0, 5, timeout=1).payload == "x"
+
+    def test_socket_blocking_probe_and_overlapping_isend(self):
+        base = 29_841
+        a = SocketTransport(0, 2, base_port=base)
+        b = SocketTransport(1, 2, base_port=base)
+        try:
+            handles = [
+                a.isend(1, tag=i, payload=np.arange(64) + i)
+                for i in range(10)
+            ]
+            for h in handles:
+                assert h.wait(10) and h.done()
+            assert b.probe(src=0, tag=3, timeout=5) is True
+            for i in range(10):
+                msg = b.recv(0, i, timeout=5)
+                np.testing.assert_array_equal(msg.payload, np.arange(64) + i)
+            # interleaved send/isend to one dst keep FIFO (same queue)
+            a.isend(1, 50, "i0")
+            a.send(1, 50, "s1")
+            a.isend(1, 50, "i2")
+            got = [b.recv(0, 50, timeout=5).payload for _ in range(3)]
+            assert got == ["i0", "s1", "i2"]
+        finally:
+            a.close()
+            b.close()
+
+    def test_isend_error_parked_on_handle(self):
+        """A failed async send surfaces from wait(), not a dead thread."""
+        a = SocketTransport(0, 2, base_port=29_861, connect_retry_s=0.2)
+        try:
+            h = a.isend(1, tag=1, payload="x")  # rank 1 never exists
+            with pytest.raises((ConnectionError, OSError)):
+                h.wait(20)
+            assert h.done()
+        finally:
+            a.close()

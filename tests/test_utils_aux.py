@@ -214,3 +214,18 @@ class TestProfiling:
         with trace(str(tmp_path)):
             jax.block_until_ready(jnp.ones(8) * 2)
         assert os.listdir(tmp_path)  # trace artifacts exist
+
+
+def test_state_to_host_sharded_leaf(topo8):
+    """state_to_host is the collective-safe gather save_checkpoint routes
+    every leaf through; on a fully-addressable mesh it must be a plain
+    value-preserving fetch for sharded and replicated leaves alike."""
+    from mpit_tpu.utils.checkpoint import state_to_host
+
+    val = np.arange(16, dtype=np.float32).reshape(8, 2)
+    sharded = jax.device_put(val, topo8.worker_sharding())
+    replicated = jax.device_put(val, topo8.replicated_sharding())
+    host = state_to_host({"s": sharded, "r": replicated, "n": 3})
+    np.testing.assert_array_equal(host["s"], val)
+    np.testing.assert_array_equal(host["r"], val)
+    assert host["n"] == 3
