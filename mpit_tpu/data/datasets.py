@@ -132,15 +132,118 @@ def load_cifar10(synthetic_train: int = 8192, synthetic_test: int = 2048):
     )
 
 
+_IMAGE_EXTS = (".jpg", ".jpeg", ".png", ".bmp")
+
+
+def _read_image_folder(
+    root: str,
+    image_size: int,
+    limit: Optional[int] = None,
+    classes: Optional[list[str]] = None,
+) -> tuple[np.ndarray, np.ndarray, list[str]]:
+    """Decode a class-per-subdirectory image tree (the standard ImageNet
+    train/val layout) into (x NHWC [0,1], y int32, class_names). Images are
+    resized so the short side is ``image_size`` then center-cropped — the
+    standard eval transform. ``limit`` caps total images (the loader holds
+    everything in host RAM, like every loader in this module), spread as an
+    even per-class cap so every class stays represented. ``classes`` pins
+    the label mapping (pass the train split's list when loading val so
+    labels agree across splits; unknown subdirs are an error)."""
+    from PIL import Image
+
+    subdirs = sorted(
+        e for e in os.listdir(root)
+        if os.path.isdir(os.path.join(root, e))
+    )
+    if not subdirs:
+        raise ValueError(f"{root}: no class subdirectories")
+    if classes is None:
+        classes = subdirs
+    else:
+        unknown = sorted(set(subdirs) - set(classes))
+        if unknown:
+            raise ValueError(
+                f"{root}: subdirectories {unknown} not in the training "
+                f"class list — splits must share one label mapping"
+            )
+    label_of = {c: i for i, c in enumerate(classes)}
+    per_class = (
+        None if limit is None else max(1, limit // len(subdirs))
+    )
+    xs, ys = [], []
+    for cls in subdirs:
+        cdir = os.path.join(root, cls)
+        taken = 0
+        if limit is not None and len(xs) >= limit:
+            break  # the total cap is a hard RAM bound and wins over coverage
+        for fname in sorted(os.listdir(cdir)):
+            if not fname.lower().endswith(_IMAGE_EXTS):
+                continue
+            if per_class is not None and taken >= per_class:
+                break
+            if limit is not None and len(xs) >= limit:
+                break
+            with Image.open(os.path.join(cdir, fname)) as im:
+                im = im.convert("RGB")
+                w, h = im.size
+                scale = image_size / min(w, h)
+                im = im.resize(
+                    (max(image_size, round(w * scale)),
+                     max(image_size, round(h * scale)))
+                )
+                left = (im.size[0] - image_size) // 2
+                top = (im.size[1] - image_size) // 2
+                im = im.crop(
+                    (left, top, left + image_size, top + image_size)
+                )
+                xs.append(np.asarray(im, dtype=np.float32) / 255.0)
+                ys.append(label_of[cls])
+            taken += 1
+    if not xs:
+        raise ValueError(
+            f"{root}: class subdirectories contain no decodable images "
+            f"(supported extensions: {', '.join(_IMAGE_EXTS)})"
+        )
+    return np.stack(xs), np.array(ys, dtype=np.int32), classes
+
+
 def load_imagenet_like(
     synthetic_train: int = 2048,
     synthetic_test: int = 512,
     image_size: int = 224,
     num_classes: int = 1000,
 ):
-    """ImageNet-shaped synthetic data for the AlexNet/ResNet-50 configs
-    (BASELINE.json:9-10). Real ImageNet is out of scope in this image; the
-    benchmark measures throughput, for which shape is what matters."""
+    """ImageNet-shaped data for the AlexNet/ResNet-50 configs
+    (BASELINE.json:9-10). When ``$MPIT_DATA_DIR/imagenet/train`` (+
+    ``val``) holds the standard class-per-subdir image tree it is decoded
+    for real (PIL; resize-short-side + center-crop; in-RAM). Per-split
+    image counts are capped at what the caller asked for
+    (``synthetic_train``/``synthetic_test``, i.e. the config's
+    ``train_size``) unless ``$MPIT_IMAGENET_LIMIT`` overrides both caps;
+    the cap is spread evenly across classes. Otherwise synthetic data of
+    the right shape — the throughput benchmark only needs shape."""
+    d = _data_dir()
+    if d:
+        train_dir = os.path.join(d, "imagenet", "train")
+        val_dir = os.path.join(d, "imagenet", "val")
+        if os.path.isdir(train_dir):
+            env_limit = os.environ.get("MPIT_IMAGENET_LIMIT")
+            tr_limit = int(env_limit) if env_limit else synthetic_train
+            te_limit = int(env_limit) if env_limit else synthetic_test
+            x_tr, y_tr, classes = _read_image_folder(
+                train_dir, image_size, tr_limit
+            )
+            if os.path.isdir(val_dir):
+                x_te, y_te, _ = _read_image_folder(
+                    val_dir, image_size, te_limit, classes=classes
+                )
+            else:  # no val split: hold out a shuffled slice of train
+                perm = np.random.default_rng(0).permutation(len(x_tr))
+                x_tr, y_tr = x_tr[perm], y_tr[perm]
+                cut = max(1, len(x_tr) // 10)
+                x_te, y_te = x_tr[-cut:], y_tr[-cut:]
+                x_tr, y_tr = x_tr[:-cut], y_tr[:-cut]
+            return x_tr, y_tr, x_te, y_te
     return synthetic_image_classification(
         synthetic_train,
         synthetic_test,

@@ -36,12 +36,17 @@ def _reserve_ports(n: int) -> tuple[list[socket.socket], list[int]]:
     to one process spawn. The child surfaces a clear error if it loses even
     that race (SocketTransport's bind diagnostic)."""
     socks, ports = [], []
-    for _ in range(n):
-        s = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
-        s.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
-        s.bind(("127.0.0.1", 0))
-        socks.append(s)
-        ports.append(s.getsockname()[1])
+    try:
+        for _ in range(n):
+            s = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+            s.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+            s.bind(("127.0.0.1", 0))
+            socks.append(s)
+            ports.append(s.getsockname()[1])
+    except BaseException:
+        for s in socks:
+            s.close()
+        raise
     return socks, ports
 
 
@@ -70,26 +75,37 @@ def main(argv=None) -> int:
 
     procs: list[subprocess.Popen] = []
     streams: list[threading.Thread] = []
-    for rank in range(ns.n):
-        env = dict(os.environ)
-        env["MPIT_RANK"] = str(rank)
-        env["MPIT_WORLD_SIZE"] = str(ns.n)
-        env["MPIT_TRANSPORT_HOSTS"] = hosts
-        # release this rank's port only now, right before its process exists
-        reserving[rank].close()
-        proc = subprocess.Popen(
-            [sys.executable, ns.script, *ns.args],
-            env=env,
-            stdout=subprocess.PIPE,
-            stderr=subprocess.STDOUT,
-        )
-        procs.append(proc)
-        t = threading.Thread(
-            target=_stream, args=(rank, proc.stdout, sys.stdout.buffer),
-            daemon=True,
-        )
-        t.start()
-        streams.append(t)
+    try:
+        for rank in range(ns.n):
+            env = dict(os.environ)
+            env["MPIT_RANK"] = str(rank)
+            env["MPIT_WORLD_SIZE"] = str(ns.n)
+            env["MPIT_TRANSPORT_HOSTS"] = hosts
+            # release this rank's port only now, right before its process
+            # exists
+            reserving[rank].close()
+            proc = subprocess.Popen(
+                [sys.executable, ns.script, *ns.args],
+                env=env,
+                stdout=subprocess.PIPE,
+                stderr=subprocess.STDOUT,
+            )
+            procs.append(proc)
+            t = threading.Thread(
+                target=_stream, args=(rank, proc.stdout, sys.stdout.buffer),
+                daemon=True,
+            )
+            t.start()
+            streams.append(t)
+    except BaseException:
+        # a failed spawn mid-loop must not strand reservations (they'd stay
+        # bound for the launcher's lifetime) or leave earlier ranks spinning
+        # in connect-retry against ports that will never get a listener
+        for s in reserving:
+            s.close()
+        for proc in procs:
+            proc.terminate()
+        raise
 
     rc = 0
     try:

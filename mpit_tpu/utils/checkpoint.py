@@ -138,7 +138,20 @@ def restore_checkpoint(
     path = _ckpt_path(directory, step)
     with open(path, "rb") as f:
         payload = f.read()
-    state = serialization.from_bytes(state_to_host(template), payload)
+    # from_bytes only needs a host pytree of the right SHAPES — the
+    # template's values are discarded — so build it from leaf metadata
+    # instead of state_to_host(template): that would run a whole-model
+    # cross-host allgather per restore just to throw the result away.
+    import numpy as np
+
+    def _host_shaped(leaf):
+        if isinstance(leaf, jax.Array) and not leaf.is_fully_addressable:
+            return np.zeros(leaf.shape, leaf.dtype)
+        return jax.device_get(leaf)
+
+    state = serialization.from_bytes(
+        jax.tree.map(_host_shaped, template), payload
+    )
     if shardings is not None:
         state = jax.device_put(state, shardings)
     return state, step

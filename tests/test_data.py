@@ -95,3 +95,102 @@ def test_mnist_idx_roundtrip(tmp_path, monkeypatch):
     assert x_tr.shape == (6, 28, 28, 1)
     np.testing.assert_array_equal(y_tr, lab_tr.astype(np.int32))
     np.testing.assert_allclose(x_te[1, 3, 4, 0], imgs_te[1, 3, 4] / 255.0)
+
+
+class TestImageFolder:
+    """ImageNet-layout folder loader on generated JPEG/PNG fixtures."""
+
+    def _write_tree(self, root, classes, per_class, size=(40, 32)):
+        from PIL import Image
+
+        rng = np.random.default_rng(7)
+        for cls in classes:
+            os.makedirs(os.path.join(root, cls), exist_ok=True)
+            for i in range(per_class):
+                arr = rng.integers(0, 256, (*size, 3), dtype=np.uint8)
+                ext = "png" if i % 2 else "jpg"
+                Image.fromarray(arr).save(
+                    os.path.join(root, cls, f"img_{i}.{ext}")
+                )
+
+    def test_decode_resize_crop_and_labels(self, tmp_path):
+        from mpit_tpu.data.datasets import _read_image_folder
+
+        self._write_tree(str(tmp_path), ["n01", "n02", "n03"], 2)
+        x, y, classes = _read_image_folder(str(tmp_path), image_size=24)
+        assert x.shape == (6, 24, 24, 3) and x.dtype == np.float32
+        assert classes == ["n01", "n02", "n03"]
+        np.testing.assert_array_equal(y, [0, 0, 1, 1, 2, 2])
+        assert 0.0 <= x.min() and x.max() <= 1.0
+        # random uint8 pixels: a decoded crop can't be constant
+        assert x.std() > 0.1
+
+    def test_load_imagenet_like_uses_folder(self, tmp_path, monkeypatch):
+        from mpit_tpu.data import load_imagenet_like
+
+        self._write_tree(
+            str(tmp_path / "imagenet" / "train"), ["a", "b"], 3
+        )
+        self._write_tree(str(tmp_path / "imagenet" / "val"), ["a", "b"], 1)
+        monkeypatch.setenv("MPIT_DATA_DIR", str(tmp_path))
+        x_tr, y_tr, x_te, y_te = load_imagenet_like(image_size=16)
+        assert x_tr.shape == (6, 16, 16, 3)
+        assert x_te.shape == (2, 16, 16, 3)
+        np.testing.assert_array_equal(y_te, [0, 1])
+
+    def test_holdout_when_no_val_split(self, tmp_path, monkeypatch):
+        from mpit_tpu.data import load_imagenet_like
+
+        self._write_tree(
+            str(tmp_path / "imagenet" / "train"), ["a", "b"], 5
+        )
+        monkeypatch.setenv("MPIT_DATA_DIR", str(tmp_path))
+        x_tr, y_tr, x_te, y_te = load_imagenet_like(image_size=16)
+        assert len(x_tr) == 9 and len(x_te) == 1
+
+    def test_limit_caps_ram_and_keeps_class_coverage(
+        self, tmp_path, monkeypatch
+    ):
+        from mpit_tpu.data import load_imagenet_like
+
+        self._write_tree(
+            str(tmp_path / "imagenet" / "train"), ["a", "b"], 4
+        )
+        self._write_tree(str(tmp_path / "imagenet" / "val"), ["a", "b"], 1)
+        monkeypatch.setenv("MPIT_DATA_DIR", str(tmp_path))
+        monkeypatch.setenv("MPIT_IMAGENET_LIMIT", "3")
+        x_tr, y_tr, *_ = load_imagenet_like(image_size=16)
+        assert len(x_tr) <= 3
+        # the cap is spread per class, not first-classes-win
+        assert set(y_tr.tolist()) == {0, 1}
+
+    def test_limit_is_hard_even_below_class_count(self, tmp_path):
+        """limit < number of classes: the RAM bound wins over coverage."""
+        from mpit_tpu.data.datasets import _read_image_folder
+
+        self._write_tree(str(tmp_path), ["a", "b", "c", "d"], 2)
+        x, y, _ = _read_image_folder(str(tmp_path), image_size=16, limit=2)
+        assert len(x) == 2
+
+    def test_val_labels_use_train_mapping(self, tmp_path, monkeypatch):
+        """A val split whose class set differs from train must error, not
+        silently relabel (labels across splits share one mapping)."""
+        from mpit_tpu.data import load_imagenet_like
+
+        self._write_tree(
+            str(tmp_path / "imagenet" / "train"), ["a", "b"], 2
+        )
+        self._write_tree(str(tmp_path / "imagenet" / "val"), ["zz"], 1)
+        monkeypatch.setenv("MPIT_DATA_DIR", str(tmp_path))
+        with pytest.raises(ValueError, match="label mapping"):
+            load_imagenet_like(image_size=16)
+
+    def test_unsupported_extensions_give_clear_error(
+        self, tmp_path, monkeypatch
+    ):
+        from mpit_tpu.data.datasets import _read_image_folder
+
+        os.makedirs(str(tmp_path / "a"))
+        (tmp_path / "a" / "x.webp").write_bytes(b"notanimage")
+        with pytest.raises(ValueError, match="decodable"):
+            _read_image_folder(str(tmp_path), image_size=16)
