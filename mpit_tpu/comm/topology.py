@@ -99,6 +99,29 @@ def _should_init_distributed() -> bool:
     return bool(os.environ.get("JAX_COORDINATOR_ADDRESS"))
 
 
+def _init_distributed() -> None:
+    """Bootstrap ``jax.distributed`` from the launch environment.
+
+    On managed clusters (TPU pods, SLURM) the no-arg form auto-detects.
+    Under this repo's own launcher — ``python -m mpit_tpu.launch -n N
+    --jax-distributed`` — the world is described by the same env contract
+    the PS transport uses (``MPIT_RANK``/``MPIT_WORLD_SIZE``) plus
+    ``JAX_COORDINATOR_ADDRESS``, and this jax build does not read
+    process-count/id from env, so pass them explicitly.
+    """
+    coord = os.environ.get("JAX_COORDINATOR_ADDRESS")
+    nproc = os.environ.get("MPIT_WORLD_SIZE")
+    pid = os.environ.get("MPIT_RANK")
+    if coord and nproc is not None and pid is not None:
+        jax.distributed.initialize(
+            coordinator_address=coord,
+            num_processes=int(nproc),
+            process_id=int(pid),
+        )
+    else:
+        jax.distributed.initialize()
+
+
 def init(
     axis_names: Sequence[str] = (WORKER_AXIS,),
     mesh_shape: Optional[Sequence[int]] = None,
@@ -135,7 +158,7 @@ def init(
             return _topology
 
         if _should_init_distributed() and not _distributed_initialized:
-            jax.distributed.initialize()
+            _init_distributed()
             _distributed_initialized = True
 
         devs = list(devices if devices is not None else jax.devices())

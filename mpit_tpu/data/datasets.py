@@ -233,6 +233,12 @@ def load_imagenet_like(
             x_tr, y_tr, classes = _read_image_folder(
                 train_dir, image_size, tr_limit
             )
+            if len(classes) > num_classes:
+                raise ValueError(
+                    f"{train_dir}: {len(classes)} class subdirectories "
+                    f"exceed the model head's num_classes={num_classes}; "
+                    "labels would be out of range for the logits"
+                )
             if os.path.isdir(val_dir):
                 x_te, y_te, _ = _read_image_folder(
                     val_dir, image_size, te_limit, classes=classes

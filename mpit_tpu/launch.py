@@ -63,6 +63,12 @@ def main(argv=None) -> int:
     )
     p.add_argument("-n", "--np", type=int, required=True, dest="n",
                    help="number of processes (ranks)")
+    p.add_argument(
+        "--jax-distributed", action="store_true",
+        help="also bootstrap a jax.distributed world across the ranks "
+             "(global device mesh + cross-process XLA collectives), the "
+             "multi-host analogue of a CUDA-aware MPI launch",
+    )
     p.add_argument("script", help="python script to run in every rank")
     p.add_argument("args", nargs=argparse.REMAINDER,
                    help="arguments passed through to the script")
@@ -70,7 +76,13 @@ def main(argv=None) -> int:
     if ns.n < 1:
         p.error("-n must be >= 1")
 
-    reserving, ports = _reserve_ports(ns.n)
+    # one extra port for the jax.distributed coordinator (rank 0 binds it)
+    reserving, ports = _reserve_ports(ns.n + (1 if ns.jax_distributed else 0))
+    coord_sock, coord_port = None, None
+    if ns.jax_distributed:
+        # released right before rank 0 spawns, same as the rank ports —
+        # closing it here would open a steal window of the whole launch
+        coord_sock, coord_port = reserving.pop(), ports.pop()
     hosts = ",".join(f"127.0.0.1:{port}" for port in ports)
 
     procs: list[subprocess.Popen] = []
@@ -81,8 +93,13 @@ def main(argv=None) -> int:
             env["MPIT_RANK"] = str(rank)
             env["MPIT_WORLD_SIZE"] = str(ns.n)
             env["MPIT_TRANSPORT_HOSTS"] = hosts
+            if coord_port is not None:
+                env["MPIT_DISTRIBUTED"] = "1"
+                env["JAX_COORDINATOR_ADDRESS"] = f"127.0.0.1:{coord_port}"
             # release this rank's port only now, right before its process
-            # exists
+            # exists (and the coordinator port with rank 0, which binds it)
+            if rank == 0 and coord_sock is not None:
+                coord_sock.close()
             reserving[rank].close()
             proc = subprocess.Popen(
                 [sys.executable, ns.script, *ns.args],
@@ -103,6 +120,8 @@ def main(argv=None) -> int:
         # in connect-retry against ports that will never get a listener
         for s in reserving:
             s.close()
+        if coord_sock is not None:
+            coord_sock.close()
         for proc in procs:
             proc.terminate()
         raise

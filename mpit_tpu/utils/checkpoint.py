@@ -145,9 +145,9 @@ def restore_checkpoint(
     import numpy as np
 
     def _host_shaped(leaf):
-        if isinstance(leaf, jax.Array) and not leaf.is_fully_addressable:
+        if isinstance(leaf, jax.Array):
             return np.zeros(leaf.shape, leaf.dtype)
-        return jax.device_get(leaf)
+        return leaf  # already host-side (np array / python scalar)
 
     state = serialization.from_bytes(
         jax.tree.map(_host_shaped, template), payload

@@ -6,9 +6,12 @@ Used to pick the headline bench operating point and to produce the README
 """
 
 import json
+import os
 import sys
 
-sys.path.insert(0, ".")
+sys.path.insert(
+    0, os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+)
 
 from bench import bench_jax  # noqa: E402
 

@@ -41,3 +41,58 @@ def test_failed_rank_terminates_world():
     # 2 ranks, 2 servers -> no clients: every rank exits with SystemExit
     assert r.returncode != 0
     assert "leaves no clients" in r.stdout + r.stderr
+
+
+def test_jax_distributed_global_mesh(tmp_path):
+    """--jax-distributed: 2 OS processes x 2 local CPU devices form ONE
+    global 4-worker mesh; the step's pmean crosses process boundaries and
+    both ranks see identical, decreasing loss (the multi-host bootstrap,
+    SURVEY.md §5 backend row, driven for real)."""
+    import json
+
+    out = str(tmp_path / "mh")
+    env = dict(os.environ)
+    env.pop("MPIT_RANK", None)
+    env.pop("MPIT_WORLD_SIZE", None)
+    r = subprocess.run(
+        [sys.executable, "-m", "mpit_tpu.launch", "-n", "2",
+         "--jax-distributed",
+         os.path.join(REPO, "examples", "multihost_sync.py"),
+         "--local-devices", "2", "--steps", "25", "--out", out],
+        cwd=REPO, env=env, capture_output=True, text=True, timeout=240,
+    )
+    assert r.returncode == 0, r.stdout + r.stderr
+    metrics = [
+        json.load(open(f"{out}.rank{i}.json")) for i in range(2)
+    ]
+    for m in metrics:
+        assert m["process_count"] == 2
+        assert m["num_workers"] == 4
+        assert m["last_loss"] < m["first_loss"] * 0.5
+    # the mesh is ONE world: the replicated state must agree bit-for-bit
+    assert metrics[0]["last_loss"] == metrics[1]["last_loss"]
+
+
+def test_jax_distributed_easgd_round(tmp_path):
+    """EASGD's whole tau-round (worker-sharded state, replicated center,
+    elastic psum) runs over the cross-process mesh too."""
+    import json
+
+    out = str(tmp_path / "mh_easgd")
+    env = dict(os.environ)
+    env.pop("MPIT_RANK", None)
+    env.pop("MPIT_WORLD_SIZE", None)
+    r = subprocess.run(
+        [sys.executable, "-m", "mpit_tpu.launch", "-n", "2",
+         "--jax-distributed",
+         os.path.join(REPO, "examples", "multihost_sync.py"),
+         "--algo", "easgd", "--local-devices", "2", "--steps", "20",
+         "--out", out],
+        cwd=REPO, env=env, capture_output=True, text=True, timeout=240,
+    )
+    assert r.returncode == 0, r.stdout + r.stderr
+    metrics = [json.load(open(f"{out}.rank{i}.json")) for i in range(2)]
+    for m in metrics:
+        assert m["num_workers"] == 4
+        assert m["last_loss"] < m["first_loss"]
+    assert metrics[0]["last_loss"] == metrics[1]["last_loss"]
