@@ -102,8 +102,8 @@ def main():
         losses = ps_roles.client_train_loop(
             client, local_step, opt, spec, xs, ys,
             steps=cfg.steps, batch_size=per_client, tau=cfg.tau,
-            algo=cfg.algo.removeprefix("ps-") if cfg.algo.startswith("ps-")
-            else "easgd",
+            algo=cfg.resolved_algo().removeprefix("ps-")
+            if cfg.algo.startswith("ps-") else "easgd",
             alpha=alpha, seed=cfg.seed + 1000 + c,
         )
         if c == 0:

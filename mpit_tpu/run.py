@@ -89,12 +89,13 @@ def build_trainer(cfg: TrainConfig, model, opt, topo):
         EASGDTrainer,
     )
 
-    if cfg.algo == "easgd":
+    algo = cfg.resolved_algo()
+    if algo == "easgd":
         return EASGDTrainer(model, opt, topo, alpha=cfg.alpha, tau=cfg.tau)
-    if cfg.algo == "downpour":
+    if algo == "downpour":
         return DownpourTrainer(model, opt, topo, tau=cfg.tau,
                                staleness=cfg.staleness)
-    if cfg.algo == "sync":
+    if algo == "sync":
         return DataParallelTrainer(model, opt, topo)
     raise ValueError(f"unknown algo {cfg.algo!r}")
 
@@ -239,11 +240,12 @@ def _run_async_ps(cfg, model, opt, x_tr, y_tr, x_te, y_te, log, results):
                 "re-enter); ignoring",
                 stacklevel=3,
             )
+    ps_algo = cfg.resolved_algo().removeprefix("ps-")
     alpha = cfg.alpha if cfg.alpha is not None else 0.9 / cfg.clients
     trainer = AsyncPSTrainer(
         model, opt,
         num_clients=cfg.clients, num_servers=cfg.servers,
-        algo=cfg.algo.removeprefix("ps-"),
+        algo=ps_algo,
         alpha=alpha, tau=cfg.tau,
         transport=cfg.transport,
         client_timeout=cfg.client_timeout,

@@ -22,7 +22,10 @@ class TrainConfig:
     preset: Optional[str] = None  # one of PRESETS, or None for flag-driven
     model: str = "lenet"
     dataset: str = "mnist"
-    algo: str = "easgd"  # easgd | downpour | sync | ps-easgd | ps-downpour
+    # easgd | eamsgd | downpour | sync | ps-easgd | ps-eamsgd | ps-downpour
+    # (eamsgd = EASGD with momentum in the local optimizer, the paper's
+    # momentum variant; the alias asserts momentum > 0)
+    algo: str = "easgd"
     # optimization (reference conf table: lr, τ, α — SURVEY.md §5)
     lr: float = 0.05
     momentum: float = 0.9
@@ -51,6 +54,27 @@ class TrainConfig:
     ckpt_every: int = 0  # rounds/steps between checkpoints (0 = off)
     resume: bool = False
     profile_dir: Optional[str] = None
+
+    def resolved_algo(self) -> str:
+        """``algo`` with the eamsgd alias resolved to its protocol.
+
+        EAMSGD is EASGD with momentum in the local optimizer (the paper's
+        momentum variant; goptim.py module docstring) — same exchange
+        protocol, so everything downstream dispatches on the resolved
+        name. The alias's one job is asserting the momentum is actually
+        on. The ONE place this rule lives; every algo consumer (run(),
+        the PS path, the process examples) resolves through here.
+        """
+        if self.algo in ("eamsgd", "ps-eamsgd"):
+            if self.momentum <= 0:
+                raise ValueError(
+                    f"algo={self.algo!r} requires momentum > 0 (EAMSGD is "
+                    "EASGD with a momentum local optimizer); set "
+                    "--momentum or use "
+                    f"algo={self.algo.replace('eamsgd', 'easgd')!r}"
+                )
+            return self.algo.replace("eamsgd", "easgd")
+        return self.algo
 
     def to_json(self) -> str:
         return json.dumps(dataclasses.asdict(self))

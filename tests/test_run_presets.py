@@ -113,3 +113,39 @@ class TestDriverPlumbing:
     def test_unknown_algo_raises(self):
         with pytest.raises(ValueError, match="unknown algo"):
             run(TrainConfig(algo="gossip", train_size=256))
+
+
+class TestEAMSGDAlias:
+    """The paper's momentum variant as a named algo (reference goptim had
+    an explicit EAMSGD optimizer; here it is EASGD + momentum local
+    optimizer, and the alias asserts the momentum is actually on)."""
+
+    def test_eamsgd_trains(self):
+        r = run(_cfg("mnist-easgd", train_size=256, global_batch=64,
+                     epochs=1, algo="eamsgd"))
+        assert r["trained_units"] == 1
+
+    def test_eamsgd_requires_momentum(self):
+        with pytest.raises(ValueError, match="momentum"):
+            run(_cfg("mnist-easgd", train_size=256, global_batch=64,
+                     epochs=1, algo="eamsgd", momentum=0.0))
+
+    def test_ps_eamsgd_maps_to_easgd_protocol(self):
+        r = run(_cfg("mnist-ps", train_size=256, steps=8, global_batch=32,
+                     algo="ps-eamsgd"))
+        assert r["server_counts"][0]["push_easgd"] == 2 * (8 // 4)
+
+    def test_ps_eamsgd_requires_momentum(self):
+        with pytest.raises(ValueError, match="momentum"):
+            run(_cfg("mnist-ps", train_size=256, steps=8, global_batch=32,
+                     algo="ps-eamsgd", momentum=0.0))
+
+    def test_resolved_algo_is_the_single_rule(self):
+        """All entry points (run(), PS path, process examples) resolve
+        through TrainConfig.resolved_algo."""
+        assert _cfg("mnist-easgd", algo="eamsgd").resolved_algo() == "easgd"
+        assert (_cfg("mnist-ps", algo="ps-eamsgd").resolved_algo()
+                == "ps-easgd")
+        assert _cfg("mnist-easgd").resolved_algo() == "easgd"
+        with pytest.raises(ValueError, match="momentum"):
+            _cfg("mnist-easgd", algo="eamsgd", momentum=0.0).resolved_algo()
