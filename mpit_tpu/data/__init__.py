@@ -25,3 +25,7 @@ from mpit_tpu.data.datasets import (  # noqa: F401
     shard_for_worker,
     Batches,
 )
+from mpit_tpu.data.prefetch import (  # noqa: F401
+    DeviceBatches,
+    prefetch_to_device,
+)

@@ -10,6 +10,8 @@ import jax.numpy as jnp
 import numpy as np
 import optax
 
+from mpit_tpu.data.prefetch import prefetch_to_device
+
 
 @flax.struct.dataclass
 class TrainState:
@@ -112,6 +114,7 @@ class RoundTrainer:
         start_epoch: int = 0,
         skip_rounds: int = 0,
         on_round=None,
+        prefetch: int = 2,
     ):
         """Epoch loop grouping minibatches into τ-rounds. Per epoch, a
         trailing group smaller than τ is dropped (SPMD rounds have a fixed
@@ -123,7 +126,13 @@ class RoundTrainer:
         permutation (``Batches`` seeds by epoch index), and the first
         ``skip_rounds`` round-groups of ``start_epoch`` are consumed without
         training. ``on_round(rounds_done, state, metrics)`` fires after every
-        trained round."""
+        trained round.
+
+        ``prefetch``: round-groups staged onto the mesh ahead of the running
+        step (``device_put`` is async, so transfer overlaps compute); 0 =
+        stage synchronously (each staged group holds its full HBM footprint,
+        so large-input configs may need 0). Skipped resume rounds are never
+        staged."""
         if self.rounds_per_epoch(batches) == 0:
             raise ValueError(
                 f"epoch of {batches.steps_per_epoch()} step(s) < "
@@ -132,9 +141,10 @@ class RoundTrainer:
         metrics = None
         rounds = 0
         dropped = 0
-        for e in range(start_epoch, epochs):
+
+        def round_groups(e, to_skip):
+            nonlocal dropped
             buf_x, buf_y = [], []
-            to_skip = skip_rounds if e == start_epoch else 0
             for x, y in batches.epoch(e):
                 buf_x.append(x)
                 buf_y.append(y)
@@ -143,19 +153,27 @@ class RoundTrainer:
                 if to_skip > 0:
                     to_skip -= 1
                 else:
-                    state, metrics = self.step(
-                        state, np.stack(buf_x), np.stack(buf_y)
+                    yield self.round_batches(
+                        np.stack(buf_x), np.stack(buf_y)
                     )
-                    rounds += 1
-                    if on_round is not None:
-                        on_round(rounds, state, metrics)
-                    if log_every and rounds % log_every == 0:
-                        print(
-                            f"[{self._log_tag}] round={rounds} "
-                            f"loss={float(metrics['loss']):.4f}"
-                        )
                 buf_x, buf_y = [], []
             dropped += len(buf_x)
+
+        sharding = self.topo.worker_sharding()
+        for e in range(start_epoch, epochs):
+            to_skip = skip_rounds if e == start_epoch else 0
+            for xr, yr in prefetch_to_device(
+                round_groups(e, to_skip), sharding, depth=prefetch
+            ):
+                state, metrics = self._round(state, xr, yr)
+                rounds += 1
+                if on_round is not None:
+                    on_round(rounds, state, metrics)
+                if log_every and rounds % log_every == 0:
+                    print(
+                        f"[{self._log_tag}] round={rounds} "
+                        f"loss={float(metrics['loss']):.4f}"
+                    )
         if dropped:
             print(
                 f"[{self._log_tag}] dropped {dropped} trailing batch(es) "

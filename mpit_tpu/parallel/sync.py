@@ -22,6 +22,7 @@ from jax.sharding import PartitionSpec as P
 
 import mpit_tpu.comm.topology as _topo_mod
 from mpit_tpu.comm.topology import Topology
+from mpit_tpu.data.prefetch import prefetch_to_device
 from mpit_tpu.parallel import common
 
 
@@ -140,24 +141,37 @@ class DataParallelTrainer:
         start_epoch: int = 0,
         skip_steps: int = 0,
         on_step=None,
+        prefetch: int = 2,
     ):
         """Epoch loop over a :class:`mpit_tpu.data.Batches`. Returns
         (state, last_metrics). ``start_epoch``/``skip_steps`` re-enter the
         deterministic data schedule for resume (epoch index seeds the
         permutation); ``on_step(steps_done, state, metrics)`` fires after
-        every trained step."""
+        every trained step. ``prefetch``: batches staged onto the mesh ahead
+        of the running step (async device_put overlaps transfer with
+        compute); 0 = stage synchronously."""
         metrics = None
         steps = 0
         # one host fetch up front so log lines can number steps across
         # resume without a per-step device round-trip
         base_step = int(state.step) if log_every else 0
-        for e in range(start_epoch, epochs):
-            to_skip = skip_steps if e == start_epoch else 0
+        w = self.topo.num_workers
+
+        def step_batches(e, to_skip):
             for x, y in batches.epoch(e):
                 if to_skip > 0:
                     to_skip -= 1
                     continue
-                state, metrics = self.step(state, x, y)
+                common.check_global_batch(len(x), w)
+                yield x, y
+
+        sharding = self.topo.worker_sharding()
+        for e in range(start_epoch, epochs):
+            to_skip = skip_steps if e == start_epoch else 0
+            for x, y in prefetch_to_device(
+                step_batches(e, to_skip), sharding, depth=prefetch
+            ):
+                state, metrics = self._step(state, x, y)
                 steps += 1
                 if on_step is not None:
                     on_step(steps, state, metrics)

@@ -179,11 +179,13 @@ def run(cfg: TrainConfig) -> dict:
             state, metrics = trainer.fit(
                 batches, state, epochs=cfg.epochs, start_epoch=start_epoch,
                 skip_steps=skip_units, on_step=on_unit,
+                prefetch=cfg.prefetch,
             )
         else:
             state, metrics = trainer.fit(
                 batches, state, epochs=cfg.epochs, start_epoch=start_epoch,
                 skip_rounds=skip_units, on_round=on_unit,
+                prefetch=cfg.prefetch,
             )
         if metrics is not None:
             jax.block_until_ready(metrics["loss"])

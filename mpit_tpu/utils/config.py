@@ -50,6 +50,11 @@ class TrainConfig:
     seed: int = 0
     log_every: int = 0
     metrics_path: Optional[str] = None
+    # input-pipeline depth: batches staged on device ahead of the running
+    # step (async device_put overlaps transfer with compute); 0 = stage
+    # synchronously — large-input configs (high tau x batch x resolution)
+    # may need 0, since each staged group holds its full HBM footprint
+    prefetch: int = 2
     ckpt_dir: Optional[str] = None
     ckpt_every: int = 0  # rounds/steps between checkpoints (0 = off)
     resume: bool = False

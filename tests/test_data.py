@@ -194,3 +194,61 @@ class TestImageFolder:
         (tmp_path / "a" / "x.webp").write_bytes(b"notanimage")
         with pytest.raises(ValueError, match="decodable"):
             _read_image_folder(str(tmp_path), image_size=16)
+
+
+class TestPrefetch:
+    """Device prefetch pipeline: staged batches arrive with the worker
+    sharding, in order, exactly once — at every depth."""
+
+    def _topo(self):
+        import mpit_tpu
+
+        mpit_tpu.finalize()
+        return mpit_tpu.init()
+
+    def test_order_count_and_sharding(self):
+        import jax
+
+        from mpit_tpu.data import prefetch_to_device
+
+        topo = self._topo()
+        items = [
+            (np.full((8, 2), i, np.float32), np.full((8,), i, np.int32))
+            for i in range(7)
+        ]
+        for depth in (0, 1, 3, 10):
+            out = list(
+                prefetch_to_device(iter(items), topo.worker_sharding(),
+                                   depth=depth)
+            )
+            assert len(out) == 7
+            for i, (x, y) in enumerate(out):
+                assert isinstance(x, jax.Array)
+                assert x.sharding.spec == topo.worker_sharding().spec
+                np.testing.assert_array_equal(np.asarray(y), items[i][1])
+
+    def test_negative_depth_rejected(self):
+        import pytest as _pytest
+
+        from mpit_tpu.data import prefetch_to_device
+
+        topo = self._topo()
+        with _pytest.raises(ValueError, match="depth"):
+            list(prefetch_to_device([], topo.worker_sharding(), depth=-1))
+
+    def test_device_batches_wraps_epochs(self):
+        from mpit_tpu.data import Batches, DeviceBatches
+
+        topo = self._topo()
+        x = np.arange(64 * 4, dtype=np.float32).reshape(64, 4)
+        y = np.arange(64, dtype=np.int32)
+        db = DeviceBatches(
+            Batches(x, y, global_batch=16), topo, depth=2,
+            transform=lambda xb, yb: (xb * 2.0, yb),
+        )
+        assert db.steps_per_epoch() == 4
+        got = list(db.epoch(0))
+        assert len(got) == 4
+        # the transform ran before staging
+        first_x = np.asarray(got[0][0])
+        assert (first_x % 2 == 0).all()
