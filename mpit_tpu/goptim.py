@@ -36,16 +36,38 @@ def elastic_client_move(params: Any, center: Any, alpha: float) -> Any:
     return jax.tree.map(lambda p, c: p - alpha * (p - c), params, center)
 
 
-def summed_client_diffs(params: Any, center: Any, axis_name: str) -> Any:
+def summed_client_diffs(
+    params: Any,
+    center: Any,
+    axis_name: str,
+    compress_dtype: Any = None,
+) -> Any:
     """Σ_i (x_i − x̃) across the worker axis — the one collective of the
-    EASGD exchange (shared by the plain and pallas paths)."""
-    return lax.psum(
-        jax.tree.map(lambda p, c: p - c, params, center), axis_name
+    EASGD exchange (shared by the plain and pallas paths).
+
+    ``compress_dtype`` (e.g. ``jnp.bfloat16``) casts the diffs before the
+    psum and back to the param dtype after — halving the bytes the
+    collective moves over ICI/DCN (the quantized-allreduce idea of EQuARX,
+    arXiv:2506.17615, in its simplest robust form). Sound for EASGD
+    because the exchange transmits *differences* from the center, which
+    are small and α-damped: quantization error enters as a bounded
+    perturbation of an already-stochastic elastic move, not as
+    accumulating drift of the master weights (which stay full precision).
+    """
+    diffs = jax.tree.map(lambda p, c: p - c, params, center)
+    if compress_dtype is None:
+        return lax.psum(diffs, axis_name)
+    total = lax.psum(
+        jax.tree.map(lambda d: d.astype(compress_dtype), diffs), axis_name
+    )
+    return jax.tree.map(
+        lambda t, p: t.astype(p.dtype), total, params
     )
 
 
 def elastic_center_move(
-    center: Any, params: Any, alpha: float, axis_name: str
+    center: Any, params: Any, alpha: float, axis_name: str,
+    compress_dtype: Any = None,
 ) -> Any:
     """x̃ ← x̃ + α Σ_i (x_i − x̃): pull the center toward the clients.
 
@@ -53,7 +75,9 @@ def elastic_center_move(
     ``psum`` (this is exactly where the reference's pserver applied its
     per-message elastic update, SURVEY.md §3(c) — the collective form is the
     mathematically identical symmetric-round version, §5 item (i))."""
-    total_diff = summed_client_diffs(params, center, axis_name)
+    total_diff = summed_client_diffs(
+        params, center, axis_name, compress_dtype
+    )
     return jax.tree.map(lambda c, d: c + alpha * d, center, total_diff)
 
 
@@ -63,21 +87,27 @@ def easgd_round(
     alpha: float,
     axis_name: str,
     use_pallas: bool = False,
+    compress_dtype: Any = None,
 ) -> tuple[Any, Any]:
     """One synchronous elastic-averaging exchange; returns (params, center).
 
     Both moves use the *old* center, per the paper's update order.
     ``use_pallas`` routes the post-psum elementwise math through the fused
     kernel in :mod:`mpit_tpu.ops` (numerically identical; see its scope
-    note)."""
+    note). ``compress_dtype`` compresses the exchange collective (see
+    :func:`summed_client_diffs`)."""
     if not use_pallas:
         new_params = elastic_client_move(params, center, alpha)
-        new_center = elastic_center_move(center, params, alpha, axis_name)
+        new_center = elastic_center_move(
+            center, params, alpha, axis_name, compress_dtype
+        )
         return new_params, new_center
 
     from mpit_tpu import ops
 
-    total_diff = summed_client_diffs(params, center, axis_name)
+    total_diff = summed_client_diffs(
+        params, center, axis_name, compress_dtype
+    )
     # flatten/unflatten by the params treedef (an is_leaf=tuple unzip would
     # misfire on pytrees whose CONTAINERS are tuples)
     leaves_p, treedef = jax.tree.flatten(params)

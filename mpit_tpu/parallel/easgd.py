@@ -69,6 +69,9 @@ class EASGDTrainer(common.RoundTrainer):
         symmetric round is 0 < α < 1/W for the center move; default follows
         the paper's β/W rule.
       tau: communication period (local steps per exchange round).
+      exchange_dtype: compress the exchange collective to this dtype (e.g.
+        ``jnp.bfloat16`` halves the bytes the psum moves over ICI/DCN; see
+        ``goptim.summed_client_diffs``). None = exact full-precision.
     """
 
     def __init__(
@@ -81,10 +84,12 @@ class EASGDTrainer(common.RoundTrainer):
         tau: int = 4,
         donate_state: bool = True,
         use_pallas: bool = False,
+        exchange_dtype: Any = None,
     ):
         self.model = model
         self.optimizer = optimizer
         self.use_pallas = bool(use_pallas)
+        self.exchange_dtype = exchange_dtype
         self.topo = topo if topo is not None else _topo_mod.topology()
         self.tau = int(tau)
         w = self.topo.num_workers
@@ -118,6 +123,7 @@ class EASGDTrainer(common.RoundTrainer):
             params, center = goptim.easgd_round(
                 params, state.center, self.alpha, axis,
                 use_pallas=self.use_pallas,
+                compress_dtype=self.exchange_dtype,
             )
             return (
                 EASGDState(

@@ -89,9 +89,26 @@ def build_trainer(cfg: TrainConfig, model, opt, topo):
         EASGDTrainer,
     )
 
+    if cfg.exchange_dtype not in ("none", "bf16"):
+        raise ValueError(
+            f"unknown exchange_dtype {cfg.exchange_dtype!r}; have: none, bf16"
+        )
     algo = cfg.resolved_algo()
+    if cfg.exchange_dtype != "none" and algo != "easgd":
+        import warnings
+
+        warnings.warn(
+            f"exchange_dtype={cfg.exchange_dtype!r} only applies to the "
+            f"easgd/eamsgd exchange collective; algo={cfg.algo!r} runs "
+            "full-precision (flag ignored)",
+            stacklevel=2,
+        )
     if algo == "easgd":
-        return EASGDTrainer(model, opt, topo, alpha=cfg.alpha, tau=cfg.tau)
+        import jax.numpy as jnp
+
+        xdtype = jnp.bfloat16 if cfg.exchange_dtype == "bf16" else None
+        return EASGDTrainer(model, opt, topo, alpha=cfg.alpha, tau=cfg.tau,
+                            exchange_dtype=xdtype)
     if algo == "downpour":
         return DownpourTrainer(model, opt, topo, tau=cfg.tau,
                                staleness=cfg.staleness)
@@ -242,6 +259,17 @@ def _run_async_ps(cfg, model, opt, x_tr, y_tr, x_te, y_te, log, results):
                 "re-enter); ignoring",
                 stacklevel=3,
             )
+    if cfg.exchange_dtype not in ("none", "bf16"):
+        raise ValueError(
+            f"unknown exchange_dtype {cfg.exchange_dtype!r}; have: none, bf16"
+        )
+    if cfg.exchange_dtype != "none":
+        warnings.warn(
+            "exchange_dtype compresses the collective easgd exchange; the "
+            "host-async PS protocol serializes parameters on its own path "
+            "and ignores it",
+            stacklevel=3,
+        )
     ps_algo = cfg.resolved_algo().removeprefix("ps-")
     alpha = cfg.alpha if cfg.alpha is not None else 0.9 / cfg.clients
     trainer = AsyncPSTrainer(

@@ -32,6 +32,9 @@ class TrainConfig:
     tau: int = 4
     alpha: Optional[float] = None  # None -> 0.9/W (EASGD paper rule)
     staleness: int = 0
+    # exchange-collective compression for easgd/eamsgd: "none" (exact) or
+    # "bf16" (halves ICI/DCN bytes per round; goptim.summed_client_diffs)
+    exchange_dtype: str = "none"
     # scale
     global_batch: int = 256
     epochs: int = 3

@@ -201,3 +201,54 @@ class TestTrainersEndToEnd:
         y = np.zeros((2, 64), np.int32)
         with pytest.raises(ValueError, match="need 3 stacked batches"):
             trainer.step(trainer.init_state(jax.random.key(0), x[0, :2]), x, y)
+
+
+class TestCompressedExchange:
+    """bf16-compressed exchange collective (goptim.summed_client_diffs):
+    halves the psum's bytes; the perturbation must stay a bounded rounding
+    error on the diffs, not drift of the full-precision state."""
+
+    def test_round_matches_f32_within_bf16_tolerance(self, topo8):
+        def body(p, c):
+            exact = goptim.easgd_round(p[0], c, 0.1, "dp")
+            comp = goptim.easgd_round(
+                p[0], c, 0.1, "dp", compress_dtype=jnp.bfloat16
+            )
+            return (exact[0][None], exact[1], comp[0][None], comp[1])
+
+        f = jax.jit(
+            jax.shard_map(
+                body, mesh=topo8.mesh,
+                in_specs=(P("dp"), P()),
+                out_specs=(P("dp"), P(), P("dp"), P()),
+                check_vma=False,
+            )
+        )
+        rng = np.random.default_rng(0)
+        params = jnp.asarray(rng.normal(0, 1, (8, 1024)), jnp.float32)
+        center = jnp.asarray(rng.normal(0, 1, 1024), jnp.float32)
+        pe, ce, pc, cc = f(params, center)
+        # outputs stay f32
+        assert pc.dtype == jnp.float32 and cc.dtype == jnp.float32
+        # client move has no collective: identical
+        np.testing.assert_array_equal(np.asarray(pe), np.asarray(pc))
+        # center move: bf16 has ~8 mantissa bits -> relative error ~1/256
+        np.testing.assert_allclose(
+            np.asarray(cc), np.asarray(ce), rtol=2e-2, atol=2e-2
+        )
+        assert np.any(np.asarray(cc) != np.asarray(ce))  # really compressed
+
+    def test_easgd_trains_with_bf16_exchange(self, topo8):
+        x_tr, y_tr, x_te, y_te = load_mnist(
+            synthetic_train=2048, synthetic_test=512
+        )
+        model = MLP(compute_dtype=jnp.float32)
+        trainer = EASGDTrainer(
+            model, optax.sgd(0.05, momentum=0.9), topo8, tau=4,
+            exchange_dtype=jnp.bfloat16,
+        )
+        state = trainer.init_state(jax.random.key(0), x_tr[:2])
+        batches = Batches(x_tr, y_tr, global_batch=256, seed=0)
+        state, _ = trainer.fit(batches, state, epochs=4)
+        acc = trainer.evaluate(state, x_te, y_te, batch=256)
+        assert acc > 0.9, f"bf16-exchange EASGD failed to learn: acc={acc}"

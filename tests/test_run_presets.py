@@ -149,3 +149,22 @@ class TestEAMSGDAlias:
         assert _cfg("mnist-easgd").resolved_algo() == "easgd"
         with pytest.raises(ValueError, match="momentum"):
             _cfg("mnist-easgd", algo="eamsgd", momentum=0.0).resolved_algo()
+
+
+class TestExchangeDtypeFlag:
+    def test_bad_value_rejected_for_every_algo(self):
+        for algo in ("easgd", "sync", "ps-easgd"):
+            preset = "mnist-ps" if algo.startswith("ps-") else "mnist-easgd"
+            with pytest.raises(ValueError, match="exchange_dtype"):
+                run(_cfg(preset, train_size=256, global_batch=64, epochs=1,
+                         steps=4, algo=algo, exchange_dtype="bf-16"))
+
+    def test_non_easgd_algo_warns_not_silent(self):
+        with pytest.warns(UserWarning, match="exchange_dtype"):
+            run(_cfg("cifar-vgg-sync", train_size=64, global_batch=32,
+                     epochs=1, image_size=32, exchange_dtype="bf16"))
+
+    def test_bf16_exchange_trains(self):
+        r = run(_cfg("mnist-easgd", train_size=256, global_batch=64,
+                     epochs=1, exchange_dtype="bf16"))
+        assert r["trained_units"] == 1
