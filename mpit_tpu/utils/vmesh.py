@@ -14,8 +14,38 @@ in jax but runs no computation.
 
 import os
 import re
+import subprocess
+import sys
+from typing import Optional
 
 _COUNT_FLAG = "--xla_force_host_platform_device_count"
+
+
+def run_bounded(
+    code: str, timeout: float, quiet: bool = False, cwd: Optional[str] = None
+) -> Optional[int]:
+    """rc of ``python -c code`` bounded by ``timeout``; None on hang.
+
+    The ONE copy of the kill-safe pattern for subprocesses that may touch a
+    dead hardware backend (a child stuck in an uninterruptible syscall on
+    the tunnel must not block the parent): after a kill, the reap wait is
+    ALSO bounded, and an unkillable child is abandoned.
+    """
+    kw = (
+        {"stdout": subprocess.DEVNULL, "stderr": subprocess.DEVNULL}
+        if quiet
+        else {}  # otherwise inherit streams: compile stalls stay visible
+    )
+    proc = subprocess.Popen([sys.executable, "-c", code], cwd=cwd, **kw)
+    try:
+        return proc.wait(timeout=timeout)
+    except subprocess.TimeoutExpired:
+        proc.kill()
+        try:
+            proc.wait(timeout=10)
+        except subprocess.TimeoutExpired:
+            pass  # unkillable (D-state); abandon the zombie
+        return None
 
 
 def repin_platform(platform: str) -> None:
