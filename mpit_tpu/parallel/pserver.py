@@ -175,6 +175,16 @@ class PServer:
 
 
 def spawn_server_thread(server: PServer) -> threading.Thread:
-    t = threading.Thread(target=server.start, daemon=True, name="mpit-pserver")
+    def run():
+        try:
+            server.start()
+        except BaseException:
+            # already recorded in server.error by start(); swallowing here
+            # keeps the thread exit clean (re-raising from a thread only
+            # feeds the default excepthook noise) — direct/synchronous
+            # server.start() callers still get the raise
+            pass
+
+    t = threading.Thread(target=run, daemon=True, name="mpit-pserver")
     t.start()
     return t
