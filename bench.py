@@ -12,6 +12,9 @@ Extra fields are informative; the driver keys on the four required ones.
 Flags (SURVEY.md §7 step 7 — the harness covers every BASELINE config):
   --preset NAME   time one workload config instead (same JSON-line shape)
   --all           headline metric + a "configs" map over all five workloads
+  --profile DIR   capture a jax.profiler trace of the timed leg into DIR
+                  (opens in Perfetto/TensorBoard: XLA op timeline,
+                  collectives included)
 """
 
 import json
@@ -507,12 +510,22 @@ def main():
     platform_note = os.environ.get("MPIT_BENCH_PLATFORM_NOTE")
     import jax
 
+    from mpit_tpu.utils.profiling import trace
+
     cpu = jax.devices()[0].platform == "cpu"
+    profile_dir = None
+    if "--profile" in sys.argv:
+        i = sys.argv.index("--profile") + 1
+        if i >= len(sys.argv) or sys.argv[i].startswith("--"):
+            print("--profile requires a directory argument", file=sys.stderr)
+            return 2
+        profile_dir = sys.argv[i]
 
     if "--preset" in sys.argv:
         name = sys.argv[sys.argv.index("--preset") + 1]
         try:
-            res = bench_preset(name, cpu_smoke=cpu)
+            with trace(profile_dir):
+                res = bench_preset(name, cpu_smoke=cpu)
         except ValueError as e:
             print(str(e), file=sys.stderr)
             return 2
@@ -527,16 +540,14 @@ def main():
         }))
         return
 
-    if cpu:
-        # smoke-run sizing: a CPU mesh shares one host's cores AND the CPU
-        # backend's conv compile time grows steeply with batch size (>200s
-        # at 64/worker); keep the smoke run tiny — the number it prints is
-        # wiring validation, not a benchmark
-        pwb = 8
-        jax_res = bench_jax(per_worker_batch=pwb, rounds=3)
-    else:
-        pwb = 1024
-        jax_res = bench_jax(per_worker_batch=pwb)  # adaptive, completion-proven
+    # smoke-run sizing on cpu: a CPU mesh shares one host's cores AND the
+    # CPU backend's conv compile time grows steeply with batch size (>200s
+    # at 64/worker); keep the smoke run tiny — the number it prints is
+    # wiring validation, not a benchmark. On hardware: adaptive timed leg,
+    # completion-proven.
+    pwb, rounds = (8, 3) if cpu else (1024, None)
+    with trace(profile_dir):
+        jax_res = bench_jax(per_worker_batch=pwb, rounds=rounds)
     scaling = measure_scaling_efficiency(jax_res)
     # baseline at the SAME per-worker batch as the numerator (a 1024-batch
     # TPU rate over a 256-batch CPU rate would not be apples-to-apples)
