@@ -12,9 +12,12 @@ Extra fields are informative; the driver keys on the four required ones.
 Flags (SURVEY.md §7 step 7 — the harness covers every BASELINE config):
   --preset NAME   time one workload config instead (same JSON-line shape)
   --all           headline metric + a "configs" map over all five workloads
-  --profile DIR   capture a jax.profiler trace of the timed leg into DIR
-                  (opens in Perfetto/TensorBoard: XLA op timeline,
-                  collectives included)
+  --profile DIR   capture a jax.profiler trace of the whole benchmark run
+                  (staging + compile + timed legs) into DIR; opens in
+                  Perfetto/TensorBoard: XLA op timeline, collectives
+                  included. Profiling adds overhead — the JSON line carries
+                  "profiled": true so the number is never mistaken for a
+                  clean benchmark result.
 """
 
 import json
@@ -513,16 +516,23 @@ def main():
     from mpit_tpu.utils.profiling import trace
 
     cpu = jax.devices()[0].platform == "cpu"
-    profile_dir = None
-    if "--profile" in sys.argv:
-        i = sys.argv.index("--profile") + 1
-        if i >= len(sys.argv) or sys.argv[i].startswith("--"):
-            print("--profile requires a directory argument", file=sys.stderr)
-            return 2
-        profile_dir = sys.argv[i]
 
-    if "--preset" in sys.argv:
-        name = sys.argv[sys.argv.index("--preset") + 1]
+    def flag_arg(flag):
+        """Value of `flag <arg>` from argv; usage-errors via SystemExit(2)
+        when the argument is missing or another flag."""
+        if flag not in sys.argv:
+            return None
+        i = sys.argv.index(flag) + 1
+        if i >= len(sys.argv) or sys.argv[i].startswith("--"):
+            print(f"{flag} requires an argument", file=sys.stderr)
+            raise SystemExit(2)
+        return sys.argv[i]
+
+    profile_dir = flag_arg("--profile")
+    profiled = {"profiled": True} if profile_dir else {}
+
+    name = flag_arg("--preset")
+    if name is not None:
         try:
             with trace(profile_dir):
                 res = bench_preset(name, cpu_smoke=cpu)
@@ -537,6 +547,7 @@ def main():
             **{k: res[k] for k in ("chips", "algo", "model")},
             **{k: res[k] for k in ("mfu",) if k in res},
             **({"platform_note": platform_note} if platform_note else {}),
+            **profiled,
         }))
         return
 
@@ -574,6 +585,7 @@ def main():
         },
         **scaling,
         **({"platform_note": platform_note} if platform_note else {}),
+        **profiled,
     }
     if "--all" in sys.argv:
         out["configs"] = {

@@ -24,7 +24,9 @@ sys.path.insert(
 
 def main():
     ap = argparse.ArgumentParser()
-    ap.add_argument("--algo", choices=("sync", "easgd"), default="sync")
+    ap.add_argument(
+        "--algo", choices=("sync", "easgd", "downpour"), default="sync"
+    )
     ap.add_argument("--steps", type=int, default=40)
     ap.add_argument(
         "--local-devices", type=int, default=0,
@@ -65,12 +67,16 @@ def main():
     model = MLP(hidden=(64,), compute_dtype=np.float32)
     if ns.algo == "sync":
         trainer = DataParallelTrainer(model, optax.sgd(0.2), topo)
-    else:
+    elif ns.algo == "easgd":
         from mpit_tpu.parallel import EASGDTrainer
 
         trainer = EASGDTrainer(
             model, optax.sgd(0.2, momentum=0.9), topo, tau=4
         )
+    else:
+        from mpit_tpu.parallel import DownpourTrainer
+
+        trainer = DownpourTrainer(model, optax.sgd(0.2), topo, tau=4)
     state = trainer.init_state(jax.random.key(0), x[: max(2, w)])
     gb = 16 * w
     tau = getattr(trainer, "tau", 1)
