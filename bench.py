@@ -557,8 +557,20 @@ def main():
     # wiring validation, not a benchmark. On hardware: adaptive timed leg,
     # completion-proven.
     pwb, rounds = (8, 3) if cpu else (1024, None)
-    with trace(profile_dir):
-        jax_res = bench_jax(per_worker_batch=pwb, rounds=rounds)
+    configs = None
+    with trace(profile_dir):  # covers the headline AND (with --all) every
+        jax_res = bench_jax(per_worker_batch=pwb, rounds=rounds)  # preset
+        if "--all" in sys.argv:
+            configs = {
+                name: round(
+                    bench_preset(name, cpu_smoke=cpu)[
+                        "samples_per_sec_per_chip"
+                    ],
+                    1,
+                )
+                for name in ALL_BENCH_PRESETS
+                if name != "mnist-easgd"  # the headline metric above
+            }
     scaling = measure_scaling_efficiency(jax_res)
     # baseline at the SAME per-worker batch as the numerator (a 1024-batch
     # TPU rate over a 256-batch CPU rate would not be apples-to-apples)
@@ -587,15 +599,8 @@ def main():
         **({"platform_note": platform_note} if platform_note else {}),
         **profiled,
     }
-    if "--all" in sys.argv:
-        out["configs"] = {
-            name: round(
-                bench_preset(name, cpu_smoke=cpu)["samples_per_sec_per_chip"],
-                1,
-            )
-            for name in ALL_BENCH_PRESETS
-            if name != "mnist-easgd"  # the headline metric above
-        }
+    if configs is not None:
+        out["configs"] = configs
     print(json.dumps(out))
 
 

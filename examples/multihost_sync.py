@@ -85,7 +85,8 @@ def main():
         idx = np.random.default_rng(step).integers(0, len(x), tau * gb)
         if ns.algo == "sync":
             state, m = trainer.step(state, x[idx], y[idx])
-        else:  # one whole tau-round (local scan + elastic exchange) per step
+        else:  # one whole tau-round per step (local scan + exchange: EASGD's
+            # elastic psum, or Downpour's update push / stale center pull)
             state, m = trainer.step(
                 state,
                 x[idx].reshape(tau, gb, *x.shape[1:]),

@@ -1,0 +1,141 @@
+"""Property-based invariants (hypothesis) for the protocol and param layers.
+
+SURVEY.md §5 race-detection row: the PS protocol's correctness rests on MPI's
+per-(src,tag) message-ordering guarantee, and the survey's do-better plan is
+property tests on exactly that ordering. These generate arbitrary send
+interleavings and pytree shapes instead of hand-picked cases.
+"""
+
+import numpy as np
+import pytest
+from hypothesis import given, settings, strategies as st
+
+from mpit_tpu import native
+from mpit_tpu.transport import ANY_SOURCE, ANY_TAG, Broker
+from mpit_tpu.utils.params import flatten_params, unflatten_params
+
+
+def _make_broker(kind, size):
+    """Both message planes must satisfy the same ordering laws: the pure-
+    Python broker and the native C++ one (the reference-parity plane)."""
+    if kind == "native":
+        if not native.is_available():
+            pytest.skip("native broker unavailable in this image")
+        return native.NativeBroker(size)
+    return Broker(size)
+
+
+BROKERS = ("inproc", "native")
+
+# -- transport ordering ------------------------------------------------------
+
+# an interleaving: each element is (sender in {1,2}, tag in {0,1,2}); rank 0
+# receives everything
+_sends = st.lists(
+    st.tuples(st.integers(1, 2), st.integers(0, 2)),
+    min_size=0,
+    max_size=40,
+)
+
+
+@pytest.mark.parametrize("kind", BROKERS)
+@settings(max_examples=60, deadline=None)
+@given(_sends)
+def test_per_src_tag_fifo(kind, sends):
+    """recv(src, tag) must see that (src, tag) stream in send order, for any
+    interleaving of sends across sources and tags (the MPI ordering rule the
+    PS protocol relies on)."""
+    broker = _make_broker(kind, 3)
+    tps = broker.transports()
+    seq = {}
+    for i, (src, tag) in enumerate(sends):
+        tps[src].send(0, tag=tag, payload=(src, tag, i))
+        seq.setdefault((src, tag), []).append(i)
+    for (src, tag), expected in seq.items():
+        got = [
+            tps[0].recv(src=src, tag=tag, timeout=1).payload[2]
+            for _ in expected
+        ]
+        assert got == expected, f"(src={src},tag={tag}) out of order"
+
+
+@pytest.mark.parametrize("kind", BROKERS)
+@settings(max_examples=60, deadline=None)
+@given(_sends)
+def test_wildcard_recv_exactly_once(kind, sends):
+    """ANY_SOURCE/ANY_TAG receives deliver every message exactly once, and
+    each (src, tag) substream stays in send order even under wildcards."""
+    broker = _make_broker(kind, 3)
+    tps = broker.transports()
+    for i, (src, tag) in enumerate(sends):
+        tps[src].send(0, tag=tag, payload=i)
+    got = [
+        tps[0].recv(src=ANY_SOURCE, tag=ANY_TAG, timeout=1)
+        for _ in sends
+    ]
+    assert sorted(m.payload for m in got) == list(range(len(sends)))
+    assert not tps[0].probe()  # nothing left over
+    per_stream = {}
+    for m in got:
+        per_stream.setdefault((m.src, m.tag), []).append(m.payload)
+    for stream in per_stream.values():
+        assert stream == sorted(stream), "wildcard recv broke FIFO"
+
+
+@pytest.mark.parametrize("kind", BROKERS)
+@settings(max_examples=40, deadline=None)
+@given(_sends, st.integers(0, 2))
+def test_tag_filter_never_steals(kind, sends, want_tag):
+    """A tag-filtered recv must leave every other message untouched and
+    available, whatever the interleaving."""
+    broker = _make_broker(kind, 3)
+    tps = broker.transports()
+    matching = 0
+    for i, (src, tag) in enumerate(sends):
+        tps[src].send(0, tag=tag, payload=i)
+        matching += tag == want_tag
+    for _ in range(matching):
+        m = tps[0].recv(src=ANY_SOURCE, tag=want_tag, timeout=1)
+        assert m.tag == want_tag
+    rest = [
+        tps[0].recv(timeout=1) for _ in range(len(sends) - matching)
+    ]
+    assert all(m.tag != want_tag for m in rest)
+    assert not tps[0].probe()
+
+
+# -- flat-param round trip ---------------------------------------------------
+
+_leaf_shapes = st.lists(
+    st.lists(st.integers(1, 5), min_size=0, max_size=3), min_size=1,
+    max_size=6,
+)
+
+
+@settings(max_examples=40, deadline=None)
+@given(_leaf_shapes, st.randoms(use_true_random=False))
+def test_flatten_roundtrip_arbitrary_trees(shapes, rnd):
+    """flatten -> unflatten reproduces any nested dict pytree bit-exactly,
+    and the flat size is the sum of leaf sizes (the getParameters()
+    contract)."""
+    rng = np.random.default_rng(rnd.randrange(2**32))
+    tree = {}
+    node = tree
+    for i, shape in enumerate(shapes):
+        leaf = rng.normal(size=tuple(shape)).astype(np.float32)
+        node[f"leaf{i}"] = leaf
+        if i % 2:  # nest every other level to vary the structure
+            node[f"sub{i}"] = {}
+            node = node[f"sub{i}"]
+    flat, spec = flatten_params(tree)
+    assert flat.shape == (sum(int(np.prod(s)) for s in shapes),)
+    rebuilt = unflatten_params(spec, flat)
+
+    import jax
+
+    leaves0 = jax.tree.leaves(tree)
+    leaves1 = jax.tree.leaves(rebuilt)
+    assert len(leaves0) == len(leaves1)
+    for a, b in zip(leaves0, leaves1):
+        assert a.shape == b.shape and a.dtype == b.dtype
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
