@@ -57,33 +57,14 @@ def _backend_alive(timeout: float = 180.0, attempts: int = 2) -> bool:
 
 
 def _force_completion(state, m) -> float:
-    """Proof of execution, not just dispatch.
+    """Proof of execution, not just dispatch — shared implementation in
+    ``mpit_tpu.utils.profiling.force_completion`` (see its docstring for
+    the platform finding): one fused scalar, data-dependent on both the
+    final state (optimizer update) and the last metrics (fwd/bwd chain),
+    fetched with a single tunnel round-trip."""
+    from mpit_tpu.utils.profiling import force_completion
 
-    On this platform ``jax.block_until_ready`` returns before device
-    execution completes (round-1 finding: a LeNet step 'timed' a flat
-    ~115 µs at batch 256 AND 4096 — an impossible 2.5 PFLOP/s on a
-    197-TFLOP chip). The only trustworthy completion barrier is fetching a
-    host value that data-depends on the final computation. Two scalars
-    cover the whole chain: the last step's loss (depends on the forward/
-    backward of the final step, which chains through every prior state) and
-    a reduction over a small parameter leaf of the FINAL state (depends on
-    the final optimizer update itself).
-    """
-    import jax
-    import jax.numpy as jnp
-
-    leaves = [
-        leaf
-        for leaf in jax.tree_util.tree_leaves(state)
-        if hasattr(leaf, "dtype")
-        and jnp.issubdtype(leaf.dtype, jnp.floating)
-        and leaf.size > 1
-    ]
-    small = min(leaves, key=lambda leaf: leaf.size)
-    # ONE fused device scalar -> one host fetch (each fetch pays a full
-    # tunnel round-trip; two sequential fetches would double the fixed
-    # latency charged to the timed leg)
-    return float(jnp.sum(small) + jnp.asarray(m["loss"], jnp.float32))
+    return force_completion(state, m)
 
 
 # Dense bf16 peak FLOP/s per chip, by device_kind substring (models here

@@ -130,6 +130,7 @@ def run(cfg: TrainConfig) -> dict:
     from mpit_tpu.data import Batches
     from mpit_tpu.utils import (
         MetricsLogger,
+        force_completion,
         latest_checkpoint,
         restore_checkpoint,
         save_checkpoint,
@@ -205,7 +206,10 @@ def run(cfg: TrainConfig) -> dict:
                 prefetch=cfg.prefetch,
             )
         if metrics is not None:
-            jax.block_until_ready(metrics["loss"])
+            # completion proof covering BOTH the final state and the last
+            # metrics (block_until_ready lies on this platform, and the
+            # loss alone would not prove the state update finished)
+            force_completion(state, metrics)
     wall = time.perf_counter() - t_start
     trained = unit - start_unit
     samples = trained * tau * gb

@@ -229,3 +229,53 @@ def test_state_to_host_sharded_leaf(topo8):
     np.testing.assert_array_equal(host["s"], val)
     np.testing.assert_array_equal(host["r"], val)
     assert host["n"] == 3
+
+
+class TestForceCompletion:
+    """The shared completion-proof helper (the block_until_ready-lies
+    workaround): must fetch one scalar per argument and survive pytrees
+    with non-floating leaves (ints, PRNG keys — review-caught crash)."""
+
+    def test_returns_data_dependent_scalar_per_argument(self):
+        import jax.numpy as jnp
+
+        from mpit_tpu.utils import force_completion
+
+        state = {"w": jnp.full((4, 3), 2.0), "step": jnp.int32(7)}
+        metrics = {"loss": jnp.float32(1.5)}
+        # smallest floating leaf of each arg: w (sum 24.0) + loss (1.5)
+        assert force_completion(state, metrics) == 25.5
+
+    def test_prng_key_and_int_leaves_are_skipped(self):
+        import jax
+        import jax.numpy as jnp
+
+        from mpit_tpu.utils import force_completion
+
+        tree = {
+            "key": jax.random.key(0),
+            "count": jnp.int32(3),
+            "p": jnp.ones(5),
+        }
+        assert force_completion(tree) == 5.0
+
+    def test_no_floating_leaves_falls_back(self):
+        import jax.numpy as jnp
+
+        from mpit_tpu.utils import force_completion
+
+        assert force_completion({"i": jnp.int32(1)}) == 0.0
+
+    def test_step_timer_spreads_tuple_results(self):
+        import jax.numpy as jnp
+
+        from mpit_tpu.utils import StepTimer
+
+        t = StepTimer(skip_first=0)
+        t.start()
+        dt = t.stop(({"w": jnp.ones(3)}, {"loss": jnp.float32(0.5)}))
+        assert dt >= 0
+        t.start()
+        assert t.stop(jnp.float32(2.0)) >= 0
+        t.start()
+        assert t.stop(None) >= 0
