@@ -13,7 +13,49 @@ from functools import partial
 from typing import Any, Sequence
 
 import flax.linen as nn
+import jax
 import jax.numpy as jnp
+
+
+def space_to_depth_stem(x, kernel, dt):
+    """The 7×7/2 stem conv computed as a 4×4/1 conv over 2×2
+    space-to-depth input (the MLPerf-TPU reformulation).
+
+    Why: a 3-input-channel 7×7 conv contracts only 147 elements and the
+    MXU pads the 3-channel dim catastrophically; after space-to-depth the
+    contraction is 4·4·12 = 192 over a 12-channel input — better lane
+    fill, no tiny-channel conv. NUMERICALLY IDENTICAL to
+    ``nn.Conv(64, (7,7), strides=2, padding=(3,3))`` with the same kernel:
+    the 7×7 kernel is zero-padded to 8×8 (top/left), and both kernel and
+    input are re-laid-out with the same (di, dj, c) channel flattening, so
+    every original tap lands on exactly one s2d tap (the zero row/col
+    contributes nothing, matching the out-of-window taps). Proven by
+    ``tests/test_models.py`` equivalence test.
+    """
+    b, h, w, c = x.shape
+    if h % 2 or w % 2:
+        raise ValueError(
+            f"space-to-depth stem needs even spatial dims, got {h}x{w}"
+        )
+    x = (
+        x.reshape(b, h // 2, 2, w // 2, 2, c)
+        .transpose(0, 1, 3, 2, 4, 5)
+        .reshape(b, h // 2, w // 2, 4 * c)
+    )
+    k = jnp.pad(kernel, ((1, 0), (1, 0), (0, 0), (0, 0)))
+    out = k.shape[-1]
+    k = (
+        k.reshape(4, 2, 4, 2, c, out)
+        .transpose(0, 2, 1, 3, 4, 5)
+        .reshape(4, 4, 4 * c, out)
+    )
+    return jax.lax.conv_general_dilated(
+        x.astype(dt),
+        k.astype(dt),
+        window_strides=(1, 1),
+        padding=((2, 1), (2, 1)),
+        dimension_numbers=("NHWC", "HWIO", "NHWC"),
+    )
 
 
 class Bottleneck(nn.Module):
@@ -42,17 +84,37 @@ class Bottleneck(nn.Module):
 
 
 class ResNet50(nn.Module):
+    """``stem="conv"`` is the textbook 7×7/2; ``stem="space_to_depth"``
+    computes the same function via :func:`space_to_depth_stem` (MXU-
+    friendlier input layout; same 7×7×3×64 parameter shape, different flax
+    param name — checkpoints do not interchange between stems)."""
+
     num_classes: int = 1000
     stage_sizes: Sequence[int] = (3, 4, 6, 3)
     compute_dtype: Any = jnp.bfloat16
+    stem: str = "conv"
 
     @nn.compact
     def __call__(self, x):
         dt = self.compute_dtype
         x = x.astype(dt)
-        x = nn.Conv(
-            64, (7, 7), strides=(2, 2), padding=(3, 3), use_bias=False, dtype=dt
-        )(x)
+        if self.stem == "space_to_depth":
+            kernel = self.param(
+                "stem_kernel",
+                nn.initializers.lecun_normal(),
+                (7, 7, x.shape[-1], 64),
+                jnp.float32,
+            )
+            x = space_to_depth_stem(x, kernel, dt)
+        elif self.stem == "conv":
+            x = nn.Conv(
+                64, (7, 7), strides=(2, 2), padding=(3, 3), use_bias=False,
+                dtype=dt,
+            )(x)
+        else:
+            raise ValueError(
+                f"unknown stem {self.stem!r}; have: conv, space_to_depth"
+            )
         x = nn.relu(nn.GroupNorm(num_groups=32, dtype=dt)(x))
         x = nn.max_pool(x, (3, 3), strides=(2, 2), padding="SAME")
         for stage, blocks in enumerate(self.stage_sizes):

@@ -77,6 +77,8 @@ def _build_model(cfg: TrainConfig, meta: dict):
 
     if cfg.model in ("lstm", "lstm_lm", "ptb_lstm"):
         return get_model(cfg.model, vocab_size=meta.get("vocab_size", 10_000))
+    if cfg.model in ("resnet50", "resnet"):  # same alias set as the registry
+        return get_model(cfg.model, stem=cfg.resnet_stem)
     return get_model(cfg.model)
 
 

@@ -45,6 +45,9 @@ class TrainConfig:
     transport: str = "auto"  # ps-* message plane: auto | native | inproc
     client_timeout: Optional[float] = None  # ps-* watchdog (None = hang,
     # matching the reference's dead-rank semantics)
+    # resnet50 stem: "conv" (textbook 7x7/2) or "space_to_depth" (same
+    # function, MXU-friendlier input layout — models/resnet.py)
+    resnet_stem: str = "conv"
     # sequence models
     seq_len: int = 32
     # image models (ImageNet-shaped configs; smaller for CPU-mesh smoke runs)

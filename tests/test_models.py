@@ -47,3 +47,74 @@ def test_resnet50_forward_and_param_count():
 def test_unknown_model_raises():
     with pytest.raises(ValueError, match="unknown model"):
         get_model("transformer9000")
+
+
+class TestSpaceToDepthStem:
+    """The MLPerf-style stem reformulation must compute EXACTLY the
+    textbook 7x7/2 conv (same kernel, float32)."""
+
+    def test_matches_conv_stem_bitwise_math(self):
+        import jax
+        import jax.numpy as jnp
+
+        from mpit_tpu.models.resnet import space_to_depth_stem
+
+        rng = np.random.default_rng(0)
+        x = jnp.asarray(rng.normal(size=(2, 16, 20, 3)), jnp.float32)
+        k = jnp.asarray(rng.normal(size=(7, 7, 3, 8)), jnp.float32)
+        ref = jax.lax.conv_general_dilated(
+            x, k, window_strides=(2, 2), padding=((3, 3), (3, 3)),
+            dimension_numbers=("NHWC", "HWIO", "NHWC"),
+        )
+        got = space_to_depth_stem(x, k, jnp.float32)
+        assert got.shape == ref.shape == (2, 8, 10, 8)
+        np.testing.assert_allclose(
+            np.asarray(got), np.asarray(ref), rtol=1e-5, atol=1e-5
+        )
+
+    def test_odd_spatial_dims_rejected(self):
+        import jax.numpy as jnp
+        import pytest
+
+        from mpit_tpu.models.resnet import space_to_depth_stem
+
+        with pytest.raises(ValueError, match="even"):
+            space_to_depth_stem(
+                jnp.zeros((1, 15, 16, 3)), jnp.zeros((7, 7, 3, 8)),
+                jnp.float32,
+            )
+
+    def test_resnet50_s2d_stem_trains(self):
+        import jax
+        import jax.numpy as jnp
+        import optax
+
+        from mpit_tpu.models.resnet import ResNet50
+
+        model = ResNet50(
+            num_classes=10, stage_sizes=(1, 1), stem="space_to_depth",
+            compute_dtype=jnp.float32,
+        )
+        x = jnp.ones((2, 32, 32, 3))
+        params = model.init(jax.random.key(0), x)["params"]
+        assert params["stem_kernel"].shape == (7, 7, 3, 64)
+
+        def loss(p):
+            return model.apply({"params": p}, x).sum()
+
+        grads = jax.grad(loss)(params)
+        assert np.isfinite(
+            float(jnp.sum(jnp.abs(grads["stem_kernel"])))
+        )
+
+    def test_unknown_stem_raises(self):
+        import jax
+        import jax.numpy as jnp
+        import pytest
+
+        from mpit_tpu.models.resnet import ResNet50
+
+        with pytest.raises(ValueError, match="stem"):
+            ResNet50(stem="nope").init(
+                jax.random.key(0), jnp.ones((1, 32, 32, 3))
+            )
