@@ -75,9 +75,10 @@ def _ptb_windows(cfg: TrainConfig):
 def _build_model(cfg: TrainConfig, meta: dict):
     from mpit_tpu.models import get_model
 
-    if cfg.model in ("lstm", "lstm_lm", "ptb_lstm"):
+    name = cfg.model.lower()  # the registry lowercases; match it
+    if name in ("lstm", "lstm_lm", "ptb_lstm"):
         return get_model(cfg.model, vocab_size=meta.get("vocab_size", 10_000))
-    if cfg.model in ("resnet50", "resnet"):  # same alias set as the registry
+    if name in ("resnet50", "resnet"):  # same alias set as the registry
         return get_model(cfg.model, stem=cfg.resnet_stem)
     return get_model(cfg.model)
 

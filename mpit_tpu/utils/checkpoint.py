@@ -89,29 +89,43 @@ def save_checkpoint(
     # collective (multi-host allgather of sharded leaves) — must precede the
     # process-0 gate or non-zero processes deadlock the gather
     host_state = state_to_host(state)
-    if jax.process_index() != 0:
-        return None
-    os.makedirs(directory, exist_ok=True)
-    payload = serialization.to_bytes(host_state)
-    path = _ckpt_path(directory, step)
-    fd, tmp = tempfile.mkstemp(dir=directory, suffix=".tmp")
+    path = None
     try:
-        with os.fdopen(fd, "wb") as f:
-            f.write(payload)
-        os.replace(tmp, path)  # atomic: never a torn checkpoint at `path`
-    except BaseException:
-        if os.path.exists(tmp):
-            os.unlink(tmp)
-        raise
-    if metadata is not None:
-        meta_path = os.path.join(directory, f"ckpt_{step:08d}.json")
-        with open(meta_path, "w") as f:
-            json.dump({"step": step, **metadata}, f)
-    for old in list_checkpoints(directory)[:-keep]:
-        os.unlink(_ckpt_path(directory, old))
-        meta = os.path.join(directory, f"ckpt_{old:08d}.json")
-        if os.path.exists(meta):
-            os.unlink(meta)
+        if jax.process_index() == 0:
+            os.makedirs(directory, exist_ok=True)
+            payload = serialization.to_bytes(host_state)
+            path = _ckpt_path(directory, step)
+            fd, tmp = tempfile.mkstemp(dir=directory, suffix=".tmp")
+            try:
+                with os.fdopen(fd, "wb") as f:
+                    f.write(payload)
+                os.replace(tmp, path)  # atomic: never torn at `path`
+            except BaseException:
+                if os.path.exists(tmp):
+                    os.unlink(tmp)
+                raise
+            if metadata is not None:
+                meta_path = os.path.join(directory, f"ckpt_{step:08d}.json")
+                with open(meta_path, "w") as f:
+                    json.dump({"step": step, **metadata}, f)
+            for old in list_checkpoints(directory)[:-keep]:
+                os.unlink(_ckpt_path(directory, old))
+                meta = os.path.join(directory, f"ckpt_{old:08d}.json")
+                if os.path.exists(meta):
+                    os.unlink(meta)
+    finally:
+        # Returning before the write is globally visible would let a
+        # non-zero process restore-immediately and race the file into
+        # nonexistence (observed live in the 2-process integration drive):
+        # the save is not "done" for ANY process until it is done for all.
+        # In a finally so a process-0 write failure still releases the
+        # peers (they would otherwise block in the barrier forever while
+        # rank 0 raises). Assumes `directory` is on storage every process
+        # can see (shared fs / GCS on a real pod).
+        if jax.process_count() > 1:
+            from jax.experimental import multihost_utils
+
+            multihost_utils.sync_global_devices(f"mpit_ckpt_save_{step}")
     return path
 
 

@@ -10,16 +10,25 @@ import sys
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 
-def _launch(n, script_args, timeout=240):
+def _launch_script(script, n, script_args, timeout=240, jax_distributed=False):
+    """One launcher-invocation helper for every integration test (env
+    hygiene and timeout policy live here only)."""
     env = dict(os.environ)
     env["JAX_PLATFORMS"] = "cpu"
     env.pop("MPIT_RANK", None)
     env.pop("MPIT_WORLD_SIZE", None)
+    cmd = [sys.executable, "-m", "mpit_tpu.launch", "-n", str(n)]
+    if jax_distributed:
+        cmd.append("--jax-distributed")
+    cmd += [os.path.join(REPO, "examples", script), *script_args]
     return subprocess.run(
-        [sys.executable, "-m", "mpit_tpu.launch", "-n", str(n),
-         os.path.join(REPO, "examples", "ptest_proc.py"), *script_args],
-        cwd=REPO, env=env, capture_output=True, text=True, timeout=timeout,
+        cmd, cwd=REPO, env=env, capture_output=True, text=True,
+        timeout=timeout,
     )
+
+
+def _launch(n, script_args, timeout=240):
+    return _launch_script("ptest_proc.py", n, script_args, timeout=timeout)
 
 
 def test_three_process_ps_easgd_trains():
@@ -51,15 +60,10 @@ def test_jax_distributed_global_mesh(tmp_path):
     import json
 
     out = str(tmp_path / "mh")
-    env = dict(os.environ)
-    env.pop("MPIT_RANK", None)
-    env.pop("MPIT_WORLD_SIZE", None)
-    r = subprocess.run(
-        [sys.executable, "-m", "mpit_tpu.launch", "-n", "2",
-         "--jax-distributed",
-         os.path.join(REPO, "examples", "multihost_sync.py"),
-         "--local-devices", "2", "--steps", "25", "--out", out],
-        cwd=REPO, env=env, capture_output=True, text=True, timeout=240,
+    r = _launch_script(
+        "multihost_sync.py", 2,
+        ["--local-devices", "2", "--steps", "25", "--out", out],
+        jax_distributed=True,
     )
     assert r.returncode == 0, r.stdout + r.stderr
     metrics = [
@@ -79,16 +83,11 @@ def test_jax_distributed_easgd_round(tmp_path):
     import json
 
     out = str(tmp_path / "mh_easgd")
-    env = dict(os.environ)
-    env.pop("MPIT_RANK", None)
-    env.pop("MPIT_WORLD_SIZE", None)
-    r = subprocess.run(
-        [sys.executable, "-m", "mpit_tpu.launch", "-n", "2",
-         "--jax-distributed",
-         os.path.join(REPO, "examples", "multihost_sync.py"),
-         "--algo", "easgd", "--local-devices", "2", "--steps", "20",
+    r = _launch_script(
+        "multihost_sync.py", 2,
+        ["--algo", "easgd", "--local-devices", "2", "--steps", "20",
          "--out", out],
-        cwd=REPO, env=env, capture_output=True, text=True, timeout=240,
+        jax_distributed=True,
     )
     assert r.returncode == 0, r.stdout + r.stderr
     metrics = [json.load(open(f"{out}.rank{i}.json")) for i in range(2)]
@@ -96,3 +95,24 @@ def test_jax_distributed_easgd_round(tmp_path):
         assert m["num_workers"] == 4
         assert m["last_loss"] < m["first_loss"]
     assert metrics[0]["last_loss"] == metrics[1]["last_loss"]
+
+
+def test_jax_distributed_checkpoint_roundtrip(tmp_path):
+    """Multi-process checkpointing: worker-sharded EASGD leaves are
+    genuinely non-addressable per process here, so this drives the
+    process_allgather save path and the save-visibility barrier (a rank
+    restoring immediately after save must find the file — the race the
+    barrier exists to close)."""
+    import json
+
+    out = str(tmp_path / "mh_ck")
+    r = _launch_script(
+        "multihost_sync.py", 2,
+        ["--algo", "easgd", "--local-devices", "2", "--steps", "8",
+         "--ckpt-dir", str(tmp_path / "ckpt"), "--out", out],
+        timeout=300, jax_distributed=True,
+    )
+    assert r.returncode == 0, r.stdout + r.stderr
+    for i in range(2):
+        m = json.load(open(f"{out}.rank{i}.json"))
+        assert m["ckpt_roundtrip"] is True
