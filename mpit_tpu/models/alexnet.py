@@ -16,14 +16,42 @@ import jax.numpy as jnp
 
 
 class AlexNet(nn.Module):
+    """``stem="conv"`` is the textbook 11×11/4; ``stem="space_to_depth"``
+    computes the same function over 4×4 space-to-depth input
+    (``mpit_tpu.ops.stem`` — contraction 363 → 768, no MXU-hostile
+    3-channel conv; same 11×11×3×64 parameter shape, different flax param
+    name, so checkpoints do not interchange between stems)."""
+
     num_classes: int = 1000
     compute_dtype: Any = jnp.bfloat16
+    stem: str = "conv"
 
     @nn.compact
     def __call__(self, x):
         dt = self.compute_dtype
         x = x.astype(dt)
-        x = nn.Conv(64, (11, 11), strides=(4, 4), padding=(2, 2), dtype=dt)(x)
+        if self.stem == "space_to_depth":
+            from mpit_tpu.ops.stem import space_to_depth_conv
+
+            kernel = self.param(
+                "stem_kernel",
+                nn.initializers.lecun_normal(),
+                (11, 11, x.shape[-1], 64),
+                jnp.float32,
+            )
+            bias = self.param(
+                "stem_bias", nn.initializers.zeros_init(), (64,), jnp.float32
+            )
+            x = space_to_depth_conv(x, kernel, stride=4, padding=2, dt=dt)
+            x = x + bias.astype(dt)
+        elif self.stem == "conv":
+            x = nn.Conv(
+                64, (11, 11), strides=(4, 4), padding=(2, 2), dtype=dt
+            )(x)
+        else:
+            raise ValueError(
+                f"unknown stem {self.stem!r}; have: conv, space_to_depth"
+            )
         x = nn.relu(x)
         x = nn.max_pool(x, (3, 3), strides=(2, 2))
         x = nn.Conv(192, (5, 5), padding=(2, 2), dtype=dt)(x)

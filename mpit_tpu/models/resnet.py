@@ -13,49 +13,18 @@ from functools import partial
 from typing import Any, Sequence
 
 import flax.linen as nn
-import jax
 import jax.numpy as jnp
 
 
 def space_to_depth_stem(x, kernel, dt):
-    """The 7×7/2 stem conv computed as a 4×4/1 conv over 2×2
-    space-to-depth input (the MLPerf-TPU reformulation).
+    """ResNet's 7×7/2 stem via the general s2d-conv reformulation
+    (:func:`mpit_tpu.ops.stem.space_to_depth_conv` — see its derivation):
+    contraction 147 → 192 over 12 channels, no MXU-hostile 3-channel conv,
+    numerically identical to ``nn.Conv(64, (7,7), strides=2,
+    padding=(3,3))`` with the same kernel."""
+    from mpit_tpu.ops.stem import space_to_depth_conv
 
-    Why: a 3-input-channel 7×7 conv contracts only 147 elements and the
-    MXU pads the 3-channel dim catastrophically; after space-to-depth the
-    contraction is 4·4·12 = 192 over a 12-channel input — better lane
-    fill, no tiny-channel conv. NUMERICALLY IDENTICAL to
-    ``nn.Conv(64, (7,7), strides=2, padding=(3,3))`` with the same kernel:
-    the 7×7 kernel is zero-padded to 8×8 (top/left), and both kernel and
-    input are re-laid-out with the same (di, dj, c) channel flattening, so
-    every original tap lands on exactly one s2d tap (the zero row/col
-    contributes nothing, matching the out-of-window taps). Proven by
-    ``tests/test_models.py`` equivalence test.
-    """
-    b, h, w, c = x.shape
-    if h % 2 or w % 2:
-        raise ValueError(
-            f"space-to-depth stem needs even spatial dims, got {h}x{w}"
-        )
-    x = (
-        x.reshape(b, h // 2, 2, w // 2, 2, c)
-        .transpose(0, 1, 3, 2, 4, 5)
-        .reshape(b, h // 2, w // 2, 4 * c)
-    )
-    k = jnp.pad(kernel, ((1, 0), (1, 0), (0, 0), (0, 0)))
-    out = k.shape[-1]
-    k = (
-        k.reshape(4, 2, 4, 2, c, out)
-        .transpose(0, 2, 1, 3, 4, 5)
-        .reshape(4, 4, 4 * c, out)
-    )
-    return jax.lax.conv_general_dilated(
-        x.astype(dt),
-        k.astype(dt),
-        window_strides=(1, 1),
-        padding=((2, 1), (2, 1)),
-        dimension_numbers=("NHWC", "HWIO", "NHWC"),
-    )
+    return space_to_depth_conv(x, kernel, stride=2, padding=3, dt=dt)
 
 
 class Bottleneck(nn.Module):

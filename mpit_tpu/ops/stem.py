@@ -1,0 +1,75 @@
+"""Space-to-depth convolution: MXU-friendly strided stem convs.
+
+A k×k stride-s conv over a 3-channel image contracts only k·k·3 elements,
+and the MXU pads the tiny channel dim catastrophically (ResNet's 7×7/2 stem:
+147-element contraction at ≈5% utilization; AlexNet's 11×11/4: 363). The
+MLPerf-TPU reformulation computes the SAME function over s×s space-to-depth
+input: the kernel is zero-padded so every original tap lands on exactly one
+s2d tap, the conv becomes stride-1 over s²·C channels, and the contraction
+grows by up to s² with no tiny-channel dim.
+
+Derivation (symmetric padding p, stride s, s | H):
+  original output(i) taps rows s·i − p … s·i − p + k − 1.
+  lo = ceil(p/s) s2d rows of conv padding; the kernel is zero-padded by
+  t = s·lo − p on top/left (absorbing the out-of-window taps) and to a
+  multiple of s on bottom/right; u = (t+k+pad)/s s2d taps per dim; conv
+  padding hi = u − 1 − lo keeps one output per s2d row, and the result is
+  sliced to the original output size (for s ∤ (H+2p−k) the s2d grid has one
+  extra position).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def space_to_depth_conv(x, kernel, stride: int, padding: int, dt):
+    """``conv(x, kernel, stride, padding=(p,p))`` computed s2d-style.
+
+    Numerically identical to the plain strided conv (proven by
+    the ``tests/test_models.py`` equivalence tests).
+    Requires spatial dims divisible by ``stride`` and ``k > 2·padding``
+    (true for every real stem).
+    """
+    b, h, w, c = x.shape
+    kh, kw, kc, out_ch = kernel.shape
+    s, p = int(stride), int(padding)
+    if kh != kw:
+        raise ValueError(f"square kernels only, got {kh}x{kw}")
+    if kc != c:
+        raise ValueError(f"kernel expects {kc} channels, input has {c}")
+    if h % s or w % s:
+        raise ValueError(
+            f"space-to-depth conv needs spatial dims divisible by "
+            f"stride={s}, got {h}x{w}"
+        )
+    if kh <= 2 * p:
+        raise ValueError(f"need kernel {kh} > 2*padding {2 * p}")
+    lo = -(-p // s)
+    t = s * lo - p
+    taps = t + kh
+    u = -(-taps // s)
+    bpad = s * u - taps
+    k = jnp.pad(kernel, ((t, bpad), (t, bpad), (0, 0), (0, 0)))
+    k = (
+        k.reshape(u, s, u, s, c, out_ch)
+        .transpose(0, 2, 1, 3, 4, 5)
+        .reshape(u, u, s * s * c, out_ch)
+    )
+    xs = (
+        x.reshape(b, h // s, s, w // s, s, c)
+        .transpose(0, 1, 3, 2, 4, 5)
+        .reshape(b, h // s, w // s, s * s * c)
+    )
+    hi = u - 1 - lo
+    out = jax.lax.conv_general_dilated(
+        xs.astype(dt),
+        k.astype(dt),
+        window_strides=(1, 1),
+        padding=((lo, hi), (lo, hi)),
+        dimension_numbers=("NHWC", "HWIO", "NHWC"),
+    )
+    out_h = (h + 2 * p - kh) // s + 1
+    out_w = (w + 2 * p - kw) // s + 1
+    return out[:, :out_h, :out_w, :]

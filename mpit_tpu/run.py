@@ -78,8 +78,8 @@ def _build_model(cfg: TrainConfig, meta: dict):
     name = cfg.model.lower()  # the registry lowercases; match it
     if name in ("lstm", "lstm_lm", "ptb_lstm"):
         return get_model(cfg.model, vocab_size=meta.get("vocab_size", 10_000))
-    if name in ("resnet50", "resnet"):  # same alias set as the registry
-        return get_model(cfg.model, stem=cfg.resnet_stem)
+    if name in ("resnet50", "resnet", "alexnet"):  # stem-choice models,
+        return get_model(cfg.model, stem=cfg.stem)  # registry alias sets
     return get_model(cfg.model)
 
 

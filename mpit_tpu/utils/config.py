@@ -45,9 +45,10 @@ class TrainConfig:
     transport: str = "auto"  # ps-* message plane: auto | native | inproc
     client_timeout: Optional[float] = None  # ps-* watchdog (None = hang,
     # matching the reference's dead-rank semantics)
-    # resnet50 stem: "conv" (textbook 7x7/2) or "space_to_depth" (same
-    # function, MXU-friendlier input layout — models/resnet.py)
-    resnet_stem: str = "conv"
+    # stem for models with an MXU-hostile 3-channel first conv (resnet50,
+    # alexnet): "conv" (textbook) or "space_to_depth" (same function,
+    # MXU-friendlier input layout — mpit_tpu/ops/stem.py)
+    stem: str = "conv"
     # sequence models
     seq_len: int = 32
     # image models (ImageNet-shaped configs; smaller for CPU-mesh smoke runs)

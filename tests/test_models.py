@@ -78,11 +78,57 @@ class TestSpaceToDepthStem:
 
         from mpit_tpu.models.resnet import space_to_depth_stem
 
-        with pytest.raises(ValueError, match="even"):
+        with pytest.raises(ValueError, match="divisible"):
             space_to_depth_stem(
                 jnp.zeros((1, 15, 16, 3)), jnp.zeros((7, 7, 3, 8)),
                 jnp.float32,
             )
+
+    def test_alexnet_stem_matches_strided_conv(self):
+        """The general s2d-conv on AlexNet's 11x11/4 p=2 stem — including
+        the output-slice case (s2d grid has one extra position when the
+        stride does not divide H+2p-k)."""
+        import jax
+        import jax.numpy as jnp
+
+        from mpit_tpu.ops.stem import space_to_depth_conv
+
+        rng = np.random.default_rng(1)
+        x = jnp.asarray(rng.normal(size=(2, 32, 36, 3)), jnp.float32)
+        k = jnp.asarray(rng.normal(size=(11, 11, 3, 8)), jnp.float32)
+        ref = jax.lax.conv_general_dilated(
+            x, k, window_strides=(4, 4), padding=((2, 2), (2, 2)),
+            dimension_numbers=("NHWC", "HWIO", "NHWC"),
+        )
+        got = space_to_depth_conv(x, k, stride=4, padding=2, dt=jnp.float32)
+        assert got.shape == ref.shape == (2, 7, 8, 8)
+        np.testing.assert_allclose(
+            np.asarray(got), np.asarray(ref), rtol=1e-4, atol=1e-4
+        )
+
+    def test_alexnet_s2d_model_trains(self):
+        import jax
+        import jax.numpy as jnp
+
+        from mpit_tpu.models.alexnet import AlexNet
+
+        model = AlexNet(
+            num_classes=10, stem="space_to_depth",
+            compute_dtype=jnp.float32,
+        )
+        x = jnp.ones((2, 64, 64, 3))
+        params = model.init(jax.random.key(0), x)["params"]
+        assert params["stem_kernel"].shape == (11, 11, 3, 64)
+        def loss(p):
+            return model.apply({"params": p}, x).sum()
+
+        out = model.apply({"params": params}, x)
+        assert out.shape == (2, 10) and np.isfinite(np.asarray(out)).all()
+        grads = jax.grad(loss)(params)
+        gk = np.asarray(grads["stem_kernel"])
+        gb = np.asarray(grads["stem_bias"])
+        assert np.isfinite(gk).all() and np.abs(gk).sum() > 0
+        assert np.isfinite(gb).all() and np.abs(gb).sum() > 0
 
     def test_resnet50_s2d_stem_trains(self):
         import jax
