@@ -161,7 +161,7 @@ def _model_flops_per_sample(trainer, state, x, y):
 
 def _stage_and_time(
     trainer, is_sync, topo, x_tr, y_tr, pwb, tau,
-    rounds=None, target_seconds=2.0,
+    rounds=None, target_seconds=2.0, input_dtype="float32",
 ):
     """The one timing harness (both the headline and the preset benches).
 
@@ -182,11 +182,14 @@ def _stage_and_time(
     """
     import jax
 
+    from mpit_tpu.data import cast_input_dtype
+
     w = topo.num_workers
     gb = pwb * w
     rng = np.random.default_rng(0)
     sharding = topo.worker_sharding()
     step = trainer._step if is_sync else trainer._round
+    x_tr = cast_input_dtype(x_tr, input_dtype)
     staged = []
     for _ in range(8):
         idx = rng.integers(0, len(x_tr), tau * gb)
@@ -267,6 +270,7 @@ def bench_jax(
     tau: int = 4,
     num_workers=None,
     rounds=None,
+    input_dtype: str = "float32",
 ) -> dict:
     import jax
     import optax
@@ -283,7 +287,8 @@ def bench_jax(
         LeNet(), optax.sgd(0.05, momentum=0.9), topo, tau=tau
     )
     return _stage_and_time(
-        trainer, False, topo, x_tr, y_tr, per_worker_batch, tau, rounds
+        trainer, False, topo, x_tr, y_tr, per_worker_batch, tau, rounds,
+        input_dtype=input_dtype,
     )
 
 
@@ -302,7 +307,9 @@ _PRESET_BENCH = {
 ALL_BENCH_PRESETS = (*_PRESET_BENCH, "mnist-ps")
 
 
-def bench_ps_literal(cpu_smoke: bool = False) -> dict:
+def bench_ps_literal(
+    cpu_smoke: bool = False, input_dtype: str = "float32"
+) -> dict:
     """The reference's literal shape (BASELINE.json:7): host-async PS,
     2 pclients + 1 pserver, concurrent actors over the tagged transport.
 
@@ -321,10 +328,13 @@ def bench_ps_literal(cpu_smoke: bool = False) -> dict:
     from mpit_tpu.parallel import AsyncPSTrainer
     from mpit_tpu.utils.config import TrainConfig
 
+    from mpit_tpu.data import cast_input_dtype
+
     cfg = TrainConfig().apply_preset("mnist-ps")
     per_client = 8 if cpu_smoke else max(cfg.global_batch // cfg.clients, 1)
     steps = 24 if cpu_smoke else 600
     x_tr, y_tr, x_te, y_te = load_mnist(synthetic_train=2048)
+    x_tr = cast_input_dtype(x_tr, input_dtype)
     trainer = AsyncPSTrainer(
         _build_model(cfg, {}),
         optax.sgd(cfg.lr, momentum=cfg.momentum),
@@ -357,7 +367,10 @@ def bench_ps_literal(cpu_smoke: bool = False) -> dict:
     }
 
 
-def bench_preset(name: str, num_workers=None, cpu_smoke: bool = False) -> dict:
+def bench_preset(
+    name: str, num_workers=None, cpu_smoke: bool = False,
+    input_dtype: str = "float32",
+) -> dict:
     """Steady-state training samples/sec/chip for one BASELINE workload
     config (same staging/timing harness as the headline metric)."""
     import dataclasses
@@ -369,7 +382,7 @@ def bench_preset(name: str, num_workers=None, cpu_smoke: bool = False) -> dict:
     from mpit_tpu.utils.config import TrainConfig
 
     if name == "mnist-ps":
-        return bench_ps_literal(cpu_smoke)
+        return bench_ps_literal(cpu_smoke, input_dtype=input_dtype)
     if name not in _PRESET_BENCH:
         raise ValueError(
             f"unknown bench preset {name!r}; have "
@@ -398,7 +411,8 @@ def bench_preset(name: str, num_workers=None, cpu_smoke: bool = False) -> dict:
     opt = optax.sgd(cfg.lr, momentum=cfg.momentum)
     trainer = build_trainer(cfg, model, opt, topo)
     res = _stage_and_time(
-        trainer, cfg.algo == "sync", topo, x_tr, y_tr, pwb, tau, rounds
+        trainer, cfg.algo == "sync", topo, x_tr, y_tr, pwb, tau, rounds,
+        input_dtype=input_dtype,
     )
     return {**res, "algo": cfg.algo, "model": cfg.model}
 
@@ -511,12 +525,27 @@ def main():
 
     profile_dir = flag_arg("--profile")
     profiled = {"profiled": True} if profile_dir else {}
+    input_dtype = flag_arg("--input-dtype") or "float32"
+    from mpit_tpu.data import INPUT_DTYPES
+
+    if input_dtype not in INPUT_DTYPES:  # fail at flag parse, not mid-run
+        print(
+            f"--input-dtype must be one of {INPUT_DTYPES}, "
+            f"got {input_dtype!r}",
+            file=sys.stderr,
+        )
+        raise SystemExit(2)
+    dtype_tag = (
+        {"input_dtype": input_dtype} if input_dtype != "float32" else {}
+    )
 
     name = flag_arg("--preset")
     if name is not None:
         try:
             with trace(profile_dir):
-                res = bench_preset(name, cpu_smoke=cpu)
+                res = bench_preset(
+                    name, cpu_smoke=cpu, input_dtype=input_dtype
+                )
         except ValueError as e:
             print(str(e), file=sys.stderr)
             return 2
@@ -529,6 +558,7 @@ def main():
             **{k: res[k] for k in ("mfu",) if k in res},
             **({"platform_note": platform_note} if platform_note else {}),
             **profiled,
+            **dtype_tag,
         }))
         return
 
@@ -540,13 +570,15 @@ def main():
     pwb, rounds = (8, 3) if cpu else (1024, None)
     configs = None
     with trace(profile_dir):  # covers the headline AND (with --all) every
-        jax_res = bench_jax(per_worker_batch=pwb, rounds=rounds)  # preset
+        jax_res = bench_jax(  # preset
+            per_worker_batch=pwb, rounds=rounds, input_dtype=input_dtype
+        )
         if "--all" in sys.argv:
             configs = {
                 name: round(
-                    bench_preset(name, cpu_smoke=cpu)[
-                        "samples_per_sec_per_chip"
-                    ],
+                    bench_preset(
+                        name, cpu_smoke=cpu, input_dtype=input_dtype
+                    )["samples_per_sec_per_chip"],
                     1,
                 )
                 for name in ALL_BENCH_PRESETS
@@ -579,6 +611,7 @@ def main():
         **scaling,
         **({"platform_note": platform_note} if platform_note else {}),
         **profiled,
+        **dtype_tag,
     }
     if configs is not None:
         out["configs"] = configs

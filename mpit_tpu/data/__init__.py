@@ -18,6 +18,8 @@ from mpit_tpu.data.synthetic import (  # noqa: F401
     synthetic_lm_corpus,
 )
 from mpit_tpu.data.datasets import (  # noqa: F401
+    INPUT_DTYPES,
+    cast_input_dtype,
     load_mnist,
     load_cifar10,
     load_imagenet_like,

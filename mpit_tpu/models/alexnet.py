@@ -28,30 +28,14 @@ class AlexNet(nn.Module):
 
     @nn.compact
     def __call__(self, x):
+        from mpit_tpu.ops.stem import stem_conv
+
         dt = self.compute_dtype
         x = x.astype(dt)
-        if self.stem == "space_to_depth":
-            from mpit_tpu.ops.stem import space_to_depth_conv
-
-            kernel = self.param(
-                "stem_kernel",
-                nn.initializers.lecun_normal(),
-                (11, 11, x.shape[-1], 64),
-                jnp.float32,
-            )
-            bias = self.param(
-                "stem_bias", nn.initializers.zeros_init(), (64,), jnp.float32
-            )
-            x = space_to_depth_conv(x, kernel, stride=4, padding=2, dt=dt)
-            x = x + bias.astype(dt)
-        elif self.stem == "conv":
-            x = nn.Conv(
-                64, (11, 11), strides=(4, 4), padding=(2, 2), dtype=dt
-            )(x)
-        else:
-            raise ValueError(
-                f"unknown stem {self.stem!r}; have: conv, space_to_depth"
-            )
+        x = stem_conv(
+            self, x, features=64, kernel=11, stride=4, padding=2,
+            stem=self.stem, dt=dt, use_bias=True,
+        )
         x = nn.relu(x)
         x = nn.max_pool(x, (3, 3), strides=(2, 2))
         x = nn.Conv(192, (5, 5), padding=(2, 2), dtype=dt)(x)

@@ -65,25 +65,14 @@ class ResNet50(nn.Module):
 
     @nn.compact
     def __call__(self, x):
+        from mpit_tpu.ops.stem import stem_conv
+
         dt = self.compute_dtype
         x = x.astype(dt)
-        if self.stem == "space_to_depth":
-            kernel = self.param(
-                "stem_kernel",
-                nn.initializers.lecun_normal(),
-                (7, 7, x.shape[-1], 64),
-                jnp.float32,
-            )
-            x = space_to_depth_stem(x, kernel, dt)
-        elif self.stem == "conv":
-            x = nn.Conv(
-                64, (7, 7), strides=(2, 2), padding=(3, 3), use_bias=False,
-                dtype=dt,
-            )(x)
-        else:
-            raise ValueError(
-                f"unknown stem {self.stem!r}; have: conv, space_to_depth"
-            )
+        x = stem_conv(
+            self, x, features=64, kernel=7, stride=2, padding=3,
+            stem=self.stem, dt=dt, use_bias=False,
+        )
         x = nn.relu(nn.GroupNorm(num_groups=32, dtype=dt)(x))
         x = nn.max_pool(x, (3, 3), strides=(2, 2), padding="SAME")
         for stage, blocks in enumerate(self.stage_sizes):

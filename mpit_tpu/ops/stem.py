@@ -73,3 +73,43 @@ def space_to_depth_conv(x, kernel, stride: int, padding: int, dt):
     out_h = (h + 2 * p - kh) // s + 1
     out_w = (w + 2 * p - kw) // s + 1
     return out[:, :out_h, :out_w, :]
+
+
+def stem_conv(
+    module, x, features: int, kernel: int, stride: int, padding: int,
+    stem: str, dt, use_bias: bool = False,
+):
+    """The one strided-stem dispatch shared by stem-capable models
+    (resnet50, alexnet): ``stem="conv"`` is the textbook ``nn.Conv``;
+    ``stem="space_to_depth"`` computes the same function via
+    :func:`space_to_depth_conv` with an identically-shaped kernel parameter
+    registered on the CALLING module's scope (param name ``stem_kernel``/
+    ``stem_bias`` — checkpoints do not interchange between stems).
+
+    ``module`` is the flax module whose ``@nn.compact`` ``__call__`` is on
+    the stack — params and the Conv submodule land in its scope exactly as
+    if the dispatch were written inline.
+    """
+    import flax.linen as nn
+
+    if stem == "space_to_depth":
+        k = module.param(
+            "stem_kernel",
+            nn.initializers.lecun_normal(),
+            (kernel, kernel, x.shape[-1], features),
+            jnp.float32,
+        )
+        x = space_to_depth_conv(x, k, stride=stride, padding=padding, dt=dt)
+        if use_bias:
+            bias = module.param(
+                "stem_bias", nn.initializers.zeros_init(), (features,),
+                jnp.float32,
+            )
+            x = x + bias.astype(dt)
+        return x
+    if stem == "conv":
+        return nn.Conv(
+            features, (kernel, kernel), strides=(stride, stride),
+            padding=(padding, padding), use_bias=use_bias, dtype=dt,
+        )(x)
+    raise ValueError(f"unknown stem {stem!r}; have: conv, space_to_depth")

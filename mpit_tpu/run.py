@@ -142,6 +142,11 @@ def run(cfg: TrainConfig) -> dict:
 
     topo = mpit_tpu.init()
     x_tr, y_tr, x_te, y_te, meta = _load_dataset(cfg)
+    from mpit_tpu.data import cast_input_dtype
+
+    # train inputs only: eval accumulates in float32 regardless, and the
+    # staging win is per-step HBM/transfer traffic, which eval doesn't pay
+    x_tr = cast_input_dtype(x_tr, cfg.input_dtype)
     is_seq = cfg.dataset == "ptb"
     model = _build_model(cfg, meta)
     opt = optax.sgd(cfg.lr, momentum=cfg.momentum)

@@ -35,6 +35,10 @@ class TrainConfig:
     # exchange-collective compression for easgd/eamsgd: "none" (exact) or
     # "bf16" (halves ICI/DCN bytes per round; goptim.summed_client_diffs)
     exchange_dtype: str = "none"
+    # input staging dtype: "float32" or "bf16" (halves host->device bytes
+    # and first-layer HBM reads; models compute in bf16 anyway, so this
+    # just moves their entry cast to the host — data.cast_input_dtype)
+    input_dtype: str = "float32"
     # scale
     global_batch: int = 256
     epochs: int = 3

@@ -1,0 +1,86 @@
+"""Collect the honest preset benchmark table on the live backend.
+
+Runs every benchmarkable BASELINE preset serially through ``bench.bench_preset``
+(the same harness ``bench.py`` uses), printing one JSON row per preset and a
+final markdown table for docs/PERF.md. Optional variants per preset via flags:
+
+  --input-dtype bf16     stage float inputs as bfloat16 (data.cast_input_dtype)
+  --presets a,b,c        subset (default: all)
+
+Keep the host otherwise idle while this runs — the box has one CPU core and
+the timing legs dispatch from it.
+"""
+
+import json
+import os
+import sys
+
+sys.path.insert(
+    0, os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+)
+
+import bench  # noqa: E402
+
+
+def main():
+    argv = sys.argv[1:]
+
+    def flag(name, default=None):
+        """`name VALUE` from argv; usage-errors like bench.py's flag_arg
+        when the value is missing or is another flag."""
+        if name not in argv:
+            return default
+        i = argv.index(name) + 1
+        if i >= len(argv) or argv[i].startswith("--"):
+            print(f"{name} requires an argument", file=sys.stderr)
+            raise SystemExit(2)
+        return argv[i]
+
+    from mpit_tpu.data import INPUT_DTYPES
+
+    input_dtype = flag("--input-dtype", "float32")
+    if input_dtype not in INPUT_DTYPES:  # fail at startup, not per-preset
+        print(
+            f"--input-dtype must be one of {INPUT_DTYPES}, "
+            f"got {input_dtype!r}",
+            file=sys.stderr,
+        )
+        raise SystemExit(2)
+    names = flag("--presets")
+    names = names.split(",") if names else list(bench.ALL_BENCH_PRESETS)
+
+    rows = []
+    for name in names:
+        try:
+            res = bench.bench_preset(name, input_dtype=input_dtype)
+        except Exception as e:  # keep the sweep alive past one bad preset
+            print(json.dumps({"preset": name, "error": repr(e)}), flush=True)
+            continue
+        row = {
+            "preset": name,
+            "samples_per_sec_per_chip": round(
+                res["samples_per_sec_per_chip"], 1
+            ),
+            "mfu": res.get("mfu"),
+            "tau": res.get("tau"),
+            "per_worker_batch": res.get(
+                "per_worker_batch", res.get("per_client_batch")
+            ),
+            "timed_seconds": res.get("timed_seconds"),
+            "input_dtype": input_dtype,
+            **{k: res[k] for k in ("accuracy",) if k in res},
+        }
+        rows.append(row)
+        print(json.dumps(row), flush=True)
+
+    print("\n| Preset | samples/s/chip | MFU |")
+    print("|---|---|---|")
+    for r in rows:
+        mfu = f"{100 * r['mfu']:.1f}%" if r.get("mfu") else "—"
+        print(
+            f"| {r['preset']} | {r['samples_per_sec_per_chip']:,.0f} | {mfu} |"
+        )
+
+
+if __name__ == "__main__":
+    main()

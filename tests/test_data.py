@@ -252,3 +252,27 @@ class TestPrefetch:
         # the transform ran before staging
         first_x = np.asarray(got[0][0])
         assert (first_x % 2 == 0).all()
+
+
+class TestInputDtype:
+    def test_float_cast_and_int_passthrough(self):
+        import ml_dtypes
+
+        from mpit_tpu.data import cast_input_dtype
+
+        x = np.random.default_rng(0).uniform(0, 1, (4, 3)).astype(np.float32)
+        xb = cast_input_dtype(x, "bf16")
+        assert xb.dtype == ml_dtypes.bfloat16
+        # bf16 is a pure narrowing of the same values (round-to-nearest)
+        np.testing.assert_allclose(
+            xb.astype(np.float32), x, rtol=1e-2, atol=1e-2
+        )
+        tokens = np.arange(5, dtype=np.int32)
+        assert cast_input_dtype(tokens, "bf16") is tokens
+        assert cast_input_dtype(x, "float32") is x
+
+    def test_unknown_name_raises(self):
+        from mpit_tpu.data import cast_input_dtype
+
+        with pytest.raises(ValueError, match="unknown input dtype"):
+            cast_input_dtype(np.zeros(2, np.float32), "fp8")

@@ -48,6 +48,20 @@ class TestPresets:
                      image_size=64, epochs=1))
         assert r["trained_units"] == 2
 
+    def test_mnist_easgd_bf16_inputs(self):
+        # bf16 input staging is a storage change, not a math change: the
+        # model cast its inputs to bf16 on entry already, so the run must
+        # train end-to-end identically in structure (run.py input_dtype)
+        r = run(_cfg("mnist-easgd", train_size=256, global_batch=64,
+                     epochs=1, input_dtype="bf16"))
+        assert r["trained_units"] == 1
+        assert 0.0 <= r["accuracy"] <= 1.0
+
+    def test_unknown_input_dtype_raises(self):
+        with pytest.raises(ValueError, match="unknown input dtype"):
+            run(_cfg("mnist-easgd", train_size=256, global_batch=64,
+                     epochs=1, input_dtype="fp8"))
+
     def test_ptb_lstm_easgd(self):
         r = run(_cfg("ptb-lstm-easgd", train_size=64, global_batch=16,
                      seq_len=16, tau=2, epochs=1))
