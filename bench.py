@@ -369,7 +369,7 @@ def bench_ps_literal(
 
 def bench_preset(
     name: str, num_workers=None, cpu_smoke: bool = False,
-    input_dtype: str = "float32",
+    input_dtype: str = "float32", stem: str = None,
 ) -> dict:
     """Steady-state training samples/sec/chip for one BASELINE workload
     config (same staging/timing harness as the headline metric)."""
@@ -390,6 +390,15 @@ def bench_preset(
         )
     pwb, rounds = _PRESET_BENCH[name], None
     cfg = TrainConfig().apply_preset(name)
+    if stem is not None:  # measure the s2d-stem variant of a stem model
+        from mpit_tpu.models import STEM_MODELS
+
+        if cfg.model.lower() not in STEM_MODELS:
+            raise ValueError(
+                f"preset {name!r} (model {cfg.model!r}) has no stem "
+                f"choice; stem applies to {STEM_MODELS}"
+            )
+        cfg = dataclasses.replace(cfg, stem=stem)
     # On real hardware run the config's true resolution (224px for the
     # ImageNet configs — the large-tensor stress BASELINE.json:10 names);
     # only the CPU smoke path shrinks the workload.
@@ -414,7 +423,8 @@ def bench_preset(
         trainer, cfg.algo == "sync", topo, x_tr, y_tr, pwb, tau, rounds,
         input_dtype=input_dtype,
     )
-    return {**res, "algo": cfg.algo, "model": cfg.model}
+    return {**res, "algo": cfg.algo, "model": cfg.model,
+            **({"stem": cfg.stem} if stem is not None else {})}
 
 
 def measure_scaling_efficiency(full: dict) -> dict:

@@ -16,7 +16,6 @@ CPU-simulated mesh, prefix with:
   XLA_FLAGS=--xla_force_host_platform_device_count=8 JAX_PLATFORMS=cpu
 """
 
-import json
 import os
 import sys
 
@@ -24,21 +23,11 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 
 def main():
-    from mpit_tpu.utils.config import TrainConfig
+    # the CLI lives in the package (installed as `mpit-train`); this file
+    # is the same entry run from a checkout
+    from mpit_tpu.run import main as run_main
 
-    cfg = TrainConfig.from_args(description=__doc__)
-
-    import jax
-
-    if os.environ.get("JAX_PLATFORMS"):
-        # honor an explicit platform choice even when a sitecustomize
-        # pre-registered a hardware backend at interpreter start
-        jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
-
-    from mpit_tpu.run import run
-
-    results = run(cfg)
-    print(json.dumps(results, default=repr))
+    run_main(description=__doc__)
 
 
 if __name__ == "__main__":

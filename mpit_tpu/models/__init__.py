@@ -17,6 +17,11 @@ from mpit_tpu.models.mlp import MLP  # noqa: F401
 
 _REGISTRY = {"lenet": LeNet, "mlp": MLP}
 
+# registry names (and aliases) whose model takes a stem= choice
+# (conv | space_to_depth — mpit_tpu/ops/stem.py); the ONE list consumers
+# (run driver, bench, sweep script) gate stem flags on
+STEM_MODELS = ("resnet50", "resnet", "alexnet")
+
 
 def get_model(name: str, **kwargs):
     """Construct a model by registry name (lazily imported to keep startup

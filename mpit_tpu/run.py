@@ -9,6 +9,8 @@ profiler traces, checkpoint/resume.
 
 from __future__ import annotations
 
+import json
+import os
 import time
 from typing import Optional
 
@@ -73,13 +75,13 @@ def _ptb_windows(cfg: TrainConfig):
 
 
 def _build_model(cfg: TrainConfig, meta: dict):
-    from mpit_tpu.models import get_model
+    from mpit_tpu.models import STEM_MODELS, get_model
 
     name = cfg.model.lower()  # the registry lowercases; match it
     if name in ("lstm", "lstm_lm", "ptb_lstm"):
         return get_model(cfg.model, vocab_size=meta.get("vocab_size", 10_000))
-    if name in ("resnet50", "resnet", "alexnet"):  # stem-choice models,
-        return get_model(cfg.model, stem=cfg.stem)  # registry alias sets
+    if name in STEM_MODELS:
+        return get_model(cfg.model, stem=cfg.stem)
     return get_model(cfg.model)
 
 
@@ -329,3 +331,27 @@ def _run_async_ps(cfg, model, opt, x_tr, y_tr, x_te, y_te, log, results):
     )
     log.close()
     return results
+
+
+def main(argv=None, description: Optional[str] = None) -> None:
+    """CLI over every BASELINE workload config (installed as ``mpit-train``;
+    ``examples/train.py`` is the same entry run from a checkout, passing its
+    usage docstring as ``description``). Prints the results dict as one JSON
+    line."""
+    cfg = TrainConfig.from_args(
+        argv,
+        description=description
+        or "mpit_tpu training driver — any preset, any flag override "
+        "(e.g. --preset mnist-easgd --epochs 10). On the CPU-simulated "
+        "mesh, prefix with XLA_FLAGS=--xla_force_host_platform_device_"
+        "count=8 JAX_PLATFORMS=cpu.",
+    )
+
+    import jax
+
+    if os.environ.get("JAX_PLATFORMS"):
+        # honor an explicit platform choice even when a sitecustomize
+        # pre-registered a hardware backend at interpreter start
+        jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
+
+    print(json.dumps(run(cfg), default=repr))

@@ -6,6 +6,8 @@ final markdown table for docs/PERF.md. Optional variants per preset via flags:
 
   --input-dtype bf16     stage float inputs as bfloat16 (data.cast_input_dtype)
   --presets a,b,c        subset (default: all)
+  --stem space_to_depth  stem variant for stem-capable presets (resnet50,
+                         alexnet); others ignore it
 
 Keep the host otherwise idle while this runs — the box has one CPU core and
 the timing legs dispatch from it.
@@ -48,11 +50,30 @@ def main():
         raise SystemExit(2)
     names = flag("--presets")
     names = names.split(",") if names else list(bench.ALL_BENCH_PRESETS)
+    stem = flag("--stem")
+    if stem is not None and stem not in ("conv", "space_to_depth"):
+        print(
+            f"--stem must be conv or space_to_depth, got {stem!r}",
+            file=sys.stderr,
+        )
+        raise SystemExit(2)
+
+    from mpit_tpu.models import STEM_MODELS
+    from mpit_tpu.utils.config import TrainConfig
+
+    def stem_kw(name):
+        """Pass the stem only to presets whose model takes one."""
+        if stem is None or name == "mnist-ps":
+            return {}
+        model = TrainConfig().apply_preset(name).model.lower()
+        return {"stem": stem} if model in STEM_MODELS else {}
 
     rows = []
     for name in names:
         try:
-            res = bench.bench_preset(name, input_dtype=input_dtype)
+            res = bench.bench_preset(
+                name, input_dtype=input_dtype, **stem_kw(name)
+            )
         except Exception as e:  # keep the sweep alive past one bad preset
             print(json.dumps({"preset": name, "error": repr(e)}), flush=True)
             continue
@@ -68,7 +89,7 @@ def main():
             ),
             "timed_seconds": res.get("timed_seconds"),
             "input_dtype": input_dtype,
-            **{k: res[k] for k in ("accuracy",) if k in res},
+            **{k: res[k] for k in ("accuracy", "stem") if k in res},
         }
         rows.append(row)
         print(json.dumps(row), flush=True)
