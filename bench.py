@@ -381,14 +381,11 @@ def bench_preset(
     from mpit_tpu.run import _build_model, _load_dataset, build_trainer
     from mpit_tpu.utils.config import TrainConfig
 
-    if name == "mnist-ps":
-        return bench_ps_literal(cpu_smoke, input_dtype=input_dtype)
-    if name not in _PRESET_BENCH:
+    if name not in ALL_BENCH_PRESETS:
         raise ValueError(
             f"unknown bench preset {name!r}; have "
             f"{sorted(ALL_BENCH_PRESETS)}"
         )
-    pwb, rounds = _PRESET_BENCH[name], None
     cfg = TrainConfig().apply_preset(name)
     if stem is not None:  # measure the s2d-stem variant of a stem model
         from mpit_tpu.models import STEM_MODELS
@@ -399,6 +396,9 @@ def bench_preset(
                 f"choice; stem applies to {STEM_MODELS}"
             )
         cfg = dataclasses.replace(cfg, stem=stem)
+    if name == "mnist-ps":
+        return bench_ps_literal(cpu_smoke, input_dtype=input_dtype)
+    pwb, rounds = _PRESET_BENCH[name], None
     # On real hardware run the config's true resolution (224px for the
     # ImageNet configs — the large-tensor stress BASELINE.json:10 names);
     # only the CPU smoke path shrinks the workload.

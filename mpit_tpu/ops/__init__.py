@@ -12,3 +12,8 @@ path (same ops, same order, no reductions) and flag-gated off by default.
 """
 
 from mpit_tpu.ops.elastic import elastic_update, pallas_supported  # noqa: F401
+from mpit_tpu.ops.ring_attention import (  # noqa: F401
+    dense_attention,
+    make_ring_attention,
+    ring_attention,
+)

@@ -1,0 +1,164 @@
+"""Ring attention: exact attention over a sequence sharded across devices.
+
+Beyond-parity TPU extension (the reference predates long-context training
+and has no sequence parallelism — SURVEY.md §5; docs/PARITY.md "TPU-first
+extensions"). This is the standard ring formulation (Liu et al., "Ring
+Attention with Blockwise Transformers", arXiv:2310.01889 — PAPERS.md):
+
+- the sequence axis is sharded onto a mesh axis: every device holds a
+  contiguous block of queries, keys, and values;
+- K/V blocks rotate around the ring with ``lax.ppermute`` (neighbor
+  exchange over ICI — the one point-to-point primitive TPUs are built
+  for), W steps for a W-device ring;
+- each device folds every visiting block into its local queries' attention
+  with the online-softmax (flash) accumulator, so the full T×T score
+  matrix never materializes — memory is O(T·T/W²) per device and the
+  result is EXACTLY softmax(QKᵀ/√d)V, not an approximation.
+
+Compute/communication overlap: XLA schedules the next ``ppermute``
+alongside the current block's einsum; on a real slice each hop is a
+neighbor ICI transfer.
+
+All accumulation is float32 regardless of input dtype (bf16 inputs stay
+bf16 inside the einsums — MXU-friendly — but scores, the running max, and
+the output accumulator are f32, the standard numerically-safe recipe).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+def _online_block(
+    carry_m, carry_l, carry_acc, q, k, v, mask, scale
+):
+    """Fold one K/V block into the online-softmax accumulator.
+
+    Shapes: q (B,Tq,H,D); k/v (Tk-block versions); scores (B,H,Tq,Tk);
+    carry_m / carry_l (B,H,Tq); carry_acc (B,H,Tq,D). ``mask`` is None or
+    broadcastable to the score shape; masked positions never contribute
+    (exp(-inf)=0) and a row with no unmasked position so far keeps l=0.
+    """
+    s = jnp.einsum(
+        "bqhd,bkhd->bhqk", q, k, preferred_element_type=jnp.float32
+    ) * scale
+    if mask is not None:
+        s = jnp.where(mask, s, -jnp.inf)
+    block_max = jnp.max(s, axis=-1)
+    m_new = jnp.maximum(carry_m, block_max)
+    # -inf maxes (nothing unmasked yet) would make the exps below nan;
+    # substitute 0 — every term they touch is exp(-inf - 0) = 0 anyway
+    safe_m = jnp.where(jnp.isneginf(m_new), 0.0, m_new)
+    p = jnp.exp(s - safe_m[..., None])
+    correction = jnp.where(
+        jnp.isneginf(carry_m), 0.0, jnp.exp(carry_m - safe_m)
+    )
+    l_new = carry_l * correction + jnp.sum(p, axis=-1)
+    acc_new = carry_acc * correction[..., None] + jnp.einsum(
+        "bhqk,bkhd->bhqd", p, v, preferred_element_type=jnp.float32
+    )
+    return m_new, l_new, acc_new
+
+
+def ring_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    axis_name: str,
+    causal: bool = False,
+) -> jax.Array:
+    """Exact sequence-parallel attention inside ``shard_map``.
+
+    Args:
+      q, k, v: the LOCAL sequence shard, shape ``(B, T_local, H, D)``
+        (batch, per-device sequence block, heads, head dim). Shards are
+        contiguous blocks in ring order: device ``r`` on ``axis_name``
+        holds global positions ``[r·T_local, (r+1)·T_local)``.
+      axis_name: mesh axis the sequence is sharded over.
+      causal: mask position j from attending to positions > j (global
+        positions, computed from the ring rank — a causal LM over the
+        full sequence, not per-shard).
+
+    Returns the local shard of ``softmax(QKᵀ/√D)V``, same shape/dtype as
+    ``q``. Identical math to dense attention on the gathered sequence
+    (see tests/test_ring_attention.py for the equivalence proof).
+    """
+    if q.ndim != 4:
+        raise ValueError(f"expected (B, T, H, D) inputs, got {q.shape}")
+    world = lax.axis_size(axis_name)
+    rank = lax.axis_index(axis_name)
+    b, t_q, h, d = q.shape
+    t_k = k.shape[1]
+    scale = 1.0 / (d ** 0.5)
+    perm = [(j, (j + 1) % world) for j in range(world)]
+
+    m0 = jnp.full((b, h, t_q), -jnp.inf, jnp.float32)
+    l0 = jnp.zeros((b, h, t_q), jnp.float32)
+    acc0 = jnp.zeros((b, h, t_q, d), jnp.float32)
+    q_pos = rank * t_q + jnp.arange(t_q)
+
+    def body(i, carry):
+        k_blk, v_blk, m, l, acc = carry
+        # after i rotations we hold the block that ORIGINATED at rank - i
+        src = (rank - i) % world
+        mask = None
+        if causal:
+            k_pos = src * t_k + jnp.arange(t_k)
+            mask = (k_pos[None, :] <= q_pos[:, None])[None, None]
+        m, l, acc = _online_block(m, l, acc, q, k_blk, v_blk, mask, scale)
+        # rotate even on the last step: every device ends holding its own
+        # block again, so the op leaves no net displacement behind
+        k_blk = lax.ppermute(k_blk, axis_name, perm)
+        v_blk = lax.ppermute(v_blk, axis_name, perm)
+        return k_blk, v_blk, m, l, acc
+
+    *_unused, l, acc = lax.fori_loop(0, world, body, (k, v, m0, l0, acc0))
+    # causal rows always have >= 1 unmasked key (self), so l > 0; the
+    # guard still keeps a fully-masked row finite instead of 0/0
+    out = acc / jnp.maximum(l, jnp.finfo(jnp.float32).tiny)[..., None]
+    return jnp.einsum("bhqd->bqhd", out).astype(q.dtype)
+
+
+def dense_attention(
+    q: jax.Array, k: jax.Array, v: jax.Array, causal: bool = False
+) -> jax.Array:
+    """Reference dense attention over the FULL sequence (no sharding) —
+    the numerical ground truth ring_attention must match."""
+    d = q.shape[-1]
+    s = jnp.einsum(
+        "bqhd,bkhd->bhqk", q, k, preferred_element_type=jnp.float32
+    ) / (d ** 0.5)
+    if causal:
+        t_q, t_k = s.shape[-2], s.shape[-1]
+        mask = jnp.arange(t_k)[None, :] <= jnp.arange(t_q)[:, None]
+        s = jnp.where(mask[None, None], s, -jnp.inf)
+    p = jax.nn.softmax(s, axis=-1)
+    return jnp.einsum(
+        "bhqk,bkhd->bqhd", p, v, preferred_element_type=jnp.float32
+    ).astype(q.dtype)
+
+
+def make_ring_attention(
+    mesh, axis_name: str, causal: bool = False, jit: bool = True
+):
+    """Convenience wrapper: a jitted shard_map of :func:`ring_attention`
+    over ``mesh`` taking GLOBAL (B, T, H, D) arrays sharded on T.
+
+    The returned callable accepts arrays laid out any way jax can
+    redistribute; for zero-copy, pass arrays already sharded
+    ``P(None, axis_name)``-style on the sequence axis.
+    """
+    from jax.sharding import PartitionSpec as P
+
+    spec = P(None, axis_name)
+
+    def _ring(q, k, v):
+        return ring_attention(q, k, v, axis_name, causal=causal)
+
+    fn = jax.shard_map(
+        _ring, mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
+        check_vma=False,
+    )
+    return jax.jit(fn) if jit else fn

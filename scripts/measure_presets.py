@@ -63,7 +63,7 @@ def main():
 
     def stem_kw(name):
         """Pass the stem only to presets whose model takes one."""
-        if stem is None or name == "mnist-ps":
+        if stem is None:
             return {}
         model = TrainConfig().apply_preset(name).model.lower()
         return {"stem": stem} if model in STEM_MODELS else {}
