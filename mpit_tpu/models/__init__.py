@@ -41,6 +41,10 @@ def get_model(name: str, **kwargs):
             from mpit_tpu.models.resnet import ResNet50
 
             _REGISTRY[name] = ResNet50
+        elif name == "transformer":
+            from mpit_tpu.models.transformer import TransformerLM
+
+            _REGISTRY[name] = TransformerLM
         elif name in ("lstm", "lstm_lm", "ptb_lstm"):
             from mpit_tpu.models.lstm import LSTMLM
 

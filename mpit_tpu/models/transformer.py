@@ -1,0 +1,118 @@
+"""Causal transformer LM — the long-context model family.
+
+Beyond-parity extension (the reference's only sequence model is the PTB
+LSTM, SURVEY.md §5): a pre-LN decoder-only transformer whose attention can
+run either dense (single-device sequence) or as exact ring attention over a
+mesh axis (``seq_axis`` set — the model is then applied INSIDE shard_map
+with the sequence dimension sharded onto that axis, and every device holds
+``T/W`` positions; ``mpit_tpu.ops.ring_attention``).
+
+The same parameters produce the same function either way: positions are
+computed globally from the ring rank, attention is exact, and the loss is a
+per-position mean — see tests/test_seq_parallel.py for the bit-level
+equivalence checks across mesh shapes.
+
+TPU notes: bf16 compute / f32 params by default, NHD head layout feeding
+128-multiple-friendly matmuls; attention accumulates in f32 (the op's
+standard recipe).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from mpit_tpu.ops.ring_attention import dense_attention, ring_attention
+
+
+class Block(nn.Module):
+    d_model: int
+    num_heads: int
+    d_ff: int
+    compute_dtype: Any
+    seq_axis: Optional[str]
+
+    @nn.compact
+    def __call__(self, x):
+        dt = self.compute_dtype
+        h, d = self.num_heads, self.d_model // self.num_heads
+        y = nn.LayerNorm(dtype=dt)(x)
+        qkv = nn.Dense(3 * self.d_model, use_bias=False, dtype=dt)(y)
+        q, k, v = jnp.split(qkv, 3, axis=-1)
+        split = lambda a: a.reshape(*a.shape[:2], h, d)
+        q, k, v = split(q), split(k), split(v)
+        if self.seq_axis is not None:
+            att = ring_attention(q, k, v, self.seq_axis, causal=True)
+        else:
+            att = dense_attention(q, k, v, causal=True)
+        att = att.reshape(*att.shape[:2], self.d_model)
+        x = x + nn.Dense(self.d_model, use_bias=False, dtype=dt)(att)
+        y = nn.LayerNorm(dtype=dt)(x)
+        y = nn.Dense(self.d_ff, dtype=dt)(y)
+        y = nn.gelu(y)
+        x = x + nn.Dense(self.d_model, dtype=dt)(y)
+        return x
+
+
+class TransformerLM(nn.Module):
+    """Next-token LM over ``(B, T_local)`` int32 tokens → f32 logits.
+
+    ``seq_axis=None``: ordinary single-sequence model (T_local = T).
+    ``seq_axis="sp"``: sequence-parallel — MUST be called inside shard_map
+    over a mesh with that axis; tokens are the local contiguous block in
+    ring order and positional embeddings are indexed by GLOBAL position
+    (ring rank × T_local + local offset).
+    """
+
+    vocab_size: int
+    num_layers: int = 2
+    d_model: int = 128
+    num_heads: int = 4
+    d_ff: int = 0  # 0 -> 4*d_model
+    max_len: int = 1024
+    compute_dtype: Any = jnp.bfloat16
+    seq_axis: Optional[str] = None
+
+    @nn.compact
+    def __call__(self, tokens):
+        if self.d_model % self.num_heads:
+            raise ValueError(
+                f"d_model {self.d_model} not divisible by "
+                f"num_heads {self.num_heads}"
+            )
+        dt = self.compute_dtype
+        t_local = tokens.shape[1]
+        embed = nn.Embed(self.vocab_size, self.d_model, dtype=dt)
+        pos_table = self.param(
+            "pos_embedding",
+            nn.initializers.normal(0.02),
+            (self.max_len, self.d_model),
+            jnp.float32,
+        )
+        offset = 0
+        total_len = t_local
+        if self.seq_axis is not None:
+            total_len = t_local * jax.lax.axis_size(self.seq_axis)
+            offset = jax.lax.axis_index(self.seq_axis) * t_local
+        if total_len > self.max_len:
+            raise ValueError(
+                f"sequence of {total_len} exceeds max_len={self.max_len}"
+            )
+        pos = offset + jnp.arange(t_local)
+        x = embed(tokens) + pos_table[pos].astype(dt)
+        for _ in range(self.num_layers):
+            x = Block(
+                d_model=self.d_model,
+                num_heads=self.num_heads,
+                d_ff=self.d_ff or 4 * self.d_model,
+                compute_dtype=dt,
+                seq_axis=self.seq_axis,
+            )(x)
+        x = nn.LayerNorm(dtype=dt)(x)
+        # tied output head, genuinely in f32: Embed.attend would promote the
+        # query back to compute_dtype, quantizing large-vocab logits to bf16
+        table = embed.embedding.astype(jnp.float32)
+        return jnp.einsum("btd,vd->btv", x.astype(jnp.float32), table)

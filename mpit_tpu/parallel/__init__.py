@@ -8,6 +8,8 @@
   emulated staleness (same mapping).
 - :mod:`mpit_tpu.parallel.pserver` / ``pclient`` — host-async
   parameter-server fidelity mode (SURVEY.md §2 comps. 3-4, §5 item (ii)).
+- :mod:`mpit_tpu.parallel.seq`      — sequence-parallel training over a 2-D
+  (batch × sequence) mesh with ring attention (beyond-parity extension).
 """
 
 from mpit_tpu.parallel.common import TrainState, cross_entropy_loss  # noqa: F401
@@ -17,3 +19,4 @@ from mpit_tpu.parallel.downpour import DownpourTrainer, DownpourState  # noqa: F
 from mpit_tpu.parallel.pserver import PServer  # noqa: F401
 from mpit_tpu.parallel.pclient import PClient  # noqa: F401
 from mpit_tpu.parallel.ps_trainer import AsyncPSTrainer  # noqa: F401
+from mpit_tpu.parallel.seq import SeqParallelTrainer  # noqa: F401

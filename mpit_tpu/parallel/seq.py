@@ -1,0 +1,195 @@
+"""Sequence-parallel training: 2-D (batch × sequence) mesh.
+
+Beyond-parity extension (the reference is data-parallel only — SURVEY.md §2
+parallelism ledger): long sequences shard onto their own mesh axis, so a
+context that does not fit one device's attention still trains exactly.
+
+Design (the scaling-book recipe): a 2-D ``Mesh(("dp", "sp"))``; tokens
+``(B, T)`` shard batch→dp and sequence→sp; params/optimizer state stay
+replicated. Inside one jit-compiled shard_map step:
+
+- the model runs with ``seq_axis="sp"`` — its attention is exact ring
+  attention (K/V blocks rotate over the sp axis via ``lax.ppermute``,
+  ``mpit_tpu.ops.ring_attention``), everything else is position-local;
+- the loss is the global per-token mean: local mean + ``pmean`` over BOTH
+  axes (equal shard sizes make that exact);
+- gradients ``pmean`` over both axes — the one collective pair of the
+  step, fused by XLA into the compiled program.
+
+The math is mesh-shape-invariant: (dp=8, sp=1), (dp=2, sp=4) and
+(dp=1, sp=8) produce the same losses and the same updated parameters on
+the same global batch (tests/test_seq_parallel.py pins this).
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Optional
+
+import jax
+import jax.numpy as jnp
+import optax
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+import mpit_tpu.comm.topology as _topo_mod
+from mpit_tpu.comm.topology import Topology
+from mpit_tpu.parallel import common
+
+
+class SeqParallelTrainer:
+    """Sync trainer over a 2-D (batch_axis, seq_axis) mesh for LMs whose
+    model understands ``seq_axis`` (``TransformerLM(seq_axis="sp")``).
+
+    Usage::
+
+        topo = mpit_tpu.init(axis_names=("dp", "sp"), mesh_shape=(2, 4))
+        model = TransformerLM(vocab_size=V, seq_axis="sp")
+        trainer = SeqParallelTrainer(model, optax.adam(3e-4), topo)
+        state = trainer.init_state(jax.random.key(0), x[:per_dp, :])
+        state, metrics = trainer.step(state, x_global, y_global)
+
+    ``x_global`` is ``(B, T)`` with ``B`` divisible by the dp extent and
+    ``T`` by the sp extent; shards are contiguous blocks (ring order on the
+    sequence).
+    """
+
+    def __init__(
+        self,
+        model,
+        optimizer: optax.GradientTransformation,
+        topo: Optional[Topology] = None,
+        loss_fn: Optional[Callable] = None,
+        donate_state: bool = True,
+    ):
+        self.model = model
+        self.optimizer = optimizer
+        self.topo = topo if topo is not None else _topo_mod.topology()
+        mesh = self.topo.mesh
+        if len(mesh.axis_names) < 2:
+            raise ValueError(
+                "SeqParallelTrainer needs a 2-D mesh, e.g. "
+                "mpit_tpu.init(axis_names=('dp','sp'), mesh_shape=(B, S)); "
+                f"got axes {mesh.axis_names}"
+            )
+        self.batch_axis, self.seq_axis = mesh.axis_names[:2]
+        model_axis = getattr(model, "seq_axis", None)
+        if model_axis != self.seq_axis:
+            raise ValueError(
+                f"model.seq_axis={model_axis!r} must name the mesh's "
+                f"sequence axis {self.seq_axis!r} (construct the model "
+                f"with seq_axis={self.seq_axis!r})"
+            )
+        # the canonical CE-mean loss works per-token unchanged: logits
+        # (b, t, V) vs integer targets (b, t)
+        self.loss_fn = (
+            loss_fn
+            if loss_fn is not None
+            else common.default_loss_fn(model.apply)
+        )
+        axes = (self.batch_axis, self.seq_axis)
+        data_spec = P(self.batch_axis, self.seq_axis)
+
+        def train_step(state: common.TrainState, x, y):
+            loss, grads = jax.value_and_grad(self.loss_fn)(state.params, x, y)
+            grads = jax.lax.pmean(grads, axes)
+            loss = jax.lax.pmean(loss, axes)
+            updates, opt_state = self.optimizer.update(
+                grads, state.opt_state, state.params
+            )
+            params = optax.apply_updates(state.params, updates)
+            return (
+                common.TrainState(
+                    params=params, opt_state=opt_state, step=state.step + 1
+                ),
+                {"loss": loss},
+            )
+
+        self._step = jax.jit(
+            jax.shard_map(
+                train_step,
+                mesh=mesh,
+                in_specs=(P(), data_spec, data_spec),
+                out_specs=(P(), P()),
+                check_vma=False,
+            ),
+            donate_argnums=(0,) if donate_state else (),
+        )
+
+        def eval_step(params, x, y):
+            logits = self.model.apply({"params": params}, x)
+            correct = jnp.sum(jnp.argmax(logits, -1) == y)
+            loss_sum = optax.softmax_cross_entropy_with_integer_labels(
+                logits, y
+            ).sum()
+            return (
+                jax.lax.psum(correct, axes),
+                jax.lax.psum(loss_sum, axes),
+            )
+
+        self._eval = jax.jit(
+            jax.shard_map(
+                eval_step,
+                mesh=mesh,
+                in_specs=(P(), data_spec, data_spec),
+                out_specs=(P(), P()),
+                check_vma=False,
+            )
+        )
+
+    @property
+    def dp_size(self) -> int:
+        return int(self.topo.mesh.shape[self.batch_axis])
+
+    @property
+    def sp_size(self) -> int:
+        return int(self.topo.mesh.shape[self.seq_axis])
+
+    def data_sharding(self) -> NamedSharding:
+        """Sharding for global (B, T) token arrays on the 2-D mesh."""
+        return NamedSharding(
+            self.topo.mesh, P(self.batch_axis, self.seq_axis)
+        )
+
+    def _check(self, x):
+        b, t = x.shape[:2]
+        if b % self.dp_size or t % self.sp_size:
+            raise ValueError(
+                f"global batch {b}x{t} not divisible by mesh "
+                f"(dp={self.dp_size}, sp={self.sp_size})"
+            )
+
+    def init_state(self, rng, sample_x) -> common.TrainState:
+        """``sample_x``: a LOCAL-shaped (b, T/sp) token block (shapes only).
+
+        Init runs the model OUTSIDE shard_map, so positions/attention see a
+        single block — parameter shapes are identical either way.
+        """
+        dense = self.model
+        if getattr(dense, "seq_axis", None) is not None:
+            dense = dense.clone(seq_axis=None)
+        variables = dense.init(rng, jnp.asarray(sample_x))
+        state = common.TrainState.create(variables["params"], self.optimizer)
+        return jax.device_put(state, self.topo.replicated_sharding())
+
+    def step(self, state, x_global, y_global):
+        """One step on a global (B, T) batch of tokens + shifted targets."""
+        self._check(x_global)
+        return self._step(state, x_global, y_global)
+
+    def evaluate(self, state, x, y, batch: int = 512):
+        """Token-level accuracy and mean loss over a (N, T) eval set."""
+        self._check(x)
+        w = self.dp_size
+        batch = (min(batch, len(x)) // w) * w or w
+        n = (len(x) // batch) * batch
+        if n == 0:
+            raise ValueError("eval set smaller than one global batch")
+        correct = 0
+        loss_sum = 0.0
+        for i in range(0, n, batch):
+            c, l = self._eval(
+                state.params, x[i : i + batch], y[i : i + batch]
+            )
+            correct += int(c)
+            loss_sum += float(l)
+        tokens = n * x.shape[1]
+        return correct / tokens, loss_sum / tokens

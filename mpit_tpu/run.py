@@ -78,7 +78,7 @@ def _build_model(cfg: TrainConfig, meta: dict):
     from mpit_tpu.models import STEM_MODELS, get_model
 
     name = cfg.model.lower()  # the registry lowercases; match it
-    if name in ("lstm", "lstm_lm", "ptb_lstm"):
+    if name in ("lstm", "lstm_lm", "ptb_lstm", "transformer"):
         return get_model(cfg.model, vocab_size=meta.get("vocab_size", 10_000))
     if name in STEM_MODELS:
         return get_model(cfg.model, stem=cfg.stem)
