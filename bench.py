@@ -209,8 +209,11 @@ def _stage_and_time(
         trainer, state, x_tr[:gb], y_tr[:gb]
     )
     # warmup (compile; also compiles _force_completion's reduction)
+    from mpit_tpu.parallel.common import bound_cpu_dispatch
+
     for _ in range(3):
         state, m = step(state, *staged[0])
+        bound_cpu_dispatch(topo, m)  # cpu-mesh rendezvous deadlock guard
     _force_completion(state, m)
     # Pure fetch latency: everything is already complete here, so timing a
     # second completion fetch measures the host round-trip alone. It is
@@ -227,6 +230,7 @@ def _stage_and_time(
         t0 = time.perf_counter()
         for r in range(rounds):
             state, m = step(state, *staged[r % len(staged)])
+            bound_cpu_dispatch(topo, m)  # no-op on real chips (async)
         _force_completion(state, m)
         raw_dt = time.perf_counter() - t0
         # never subtract more than half the leg: the correction must trim

@@ -65,6 +65,98 @@ def accuracy(logits: np.ndarray, labels: np.ndarray) -> float:
     return float((np.argmax(logits, -1) == labels).mean())
 
 
+def synced_fit_loop(
+    topo,
+    step_fn,
+    batches,
+    state,
+    *,
+    sharding,
+    check,
+    log_tag: str,
+    epochs: int = 1,
+    log_every: int = 0,
+    start_epoch: int = 0,
+    skip_steps: int = 0,
+    on_step=None,
+    prefetch: int = 2,
+):
+    """The one per-step fit loop shared by the synchronous trainers
+    (sync-DP and seq-parallel differ only in sharding, batch check, and
+    log tag). Deterministic resume via ``start_epoch``/``skip_steps``
+    (epoch index seeds the permutation); ``on_step(steps, state, metrics)``
+    after every step; batches staged ``prefetch`` ahead with the step's own
+    sharding. Returns (state, last_metrics)."""
+    metrics = None
+    steps = 0
+    # one host fetch up front so log lines can number steps across resume
+    # without a per-step device round-trip
+    base_step = int(state.step) if log_every else 0
+
+    def step_batches(e, to_skip):
+        for x, y in batches.epoch(e):
+            if to_skip > 0:
+                to_skip -= 1
+                continue
+            check(x)
+            yield x, y
+
+    from mpit_tpu.data.prefetch import prefetch_to_device
+
+    for e in range(start_epoch, epochs):
+        to_skip = skip_steps if e == start_epoch else 0
+        for x, y in prefetch_to_device(
+            step_batches(e, to_skip), sharding, depth=prefetch
+        ):
+            state, metrics = step_fn(state, x, y)
+            bound_cpu_dispatch(topo, metrics)
+            steps += 1
+            if on_step is not None:
+                on_step(steps, state, metrics)
+            # gate on the HOST counter: `int(state.step)` every step would
+            # force a device round-trip per step
+            if log_every and steps % log_every == 0:
+                print(
+                    f"[{log_tag}] step={base_step + steps} "
+                    f"loss={float(metrics['loss']):.4f}"
+                )
+    return state, metrics
+
+
+def batched_count_eval(eval_fn, params, x, y, batch: int, group: int):
+    """Run a (params, x, y) -> (correct_sum, loss_sum) eval over the set in
+    ``group``-divisible batches (truncating the remainder). Returns
+    (correct, loss_sum, n_examples_used)."""
+    batch = (min(batch, len(x)) // group) * group or group
+    n = (len(x) // batch) * batch
+    if n == 0:
+        raise ValueError("eval set smaller than one global batch")
+    correct = 0
+    loss_sum = 0.0
+    for i in range(0, n, batch):
+        c, l = eval_fn(params, x[i : i + batch], y[i : i + batch])
+        correct += int(c)
+        loss_sum += float(l)
+    return correct, loss_sum, n
+
+
+def bound_cpu_dispatch(topo, tree) -> None:
+    """Serialize step dispatch on the virtual CPU mesh (no-op elsewhere).
+
+    XLA:CPU's cross-module collective rendezvous deadlocks when several
+    executions are in flight over the forced host-platform devices: async
+    dispatch pipelines step k+1 while k runs, participants from different
+    runs tangle on the shared pool, and one of N never arrives — the runtime
+    then either hangs or aborts the process (rendezvous.cc "Exiting to
+    ensure a consistent program state"). Observed on a 1-core host: an
+    8-device psum loop died ~2 of 3 runs; with one execution in flight it
+    passed every time. Real accelerator platforms pipeline correctly and
+    stay fully async.
+    """
+    if topo.platform == "cpu" and topo.num_devices > 1:
+        jax.block_until_ready(tree)
+
+
 class RoundTrainer:
     """Shared machinery for τ-round trainers (EASGD, Downpour).
 
@@ -100,7 +192,9 @@ class RoundTrainer:
         """One exchange round: τ local steps + the collective. Inputs are τ
         stacked global batches, shape (τ, W·B, ...)."""
         xr, yr = self.round_batches(np.asarray(x_round), np.asarray(y_round))
-        return self._round(state, xr, yr)
+        state, metrics = self._round(state, xr, yr)
+        bound_cpu_dispatch(self.topo, metrics)
+        return state, metrics
 
     def rounds_per_epoch(self, batches) -> int:
         return batches.steps_per_epoch() // self.tau
@@ -166,6 +260,7 @@ class RoundTrainer:
                 round_groups(e, to_skip), sharding, depth=prefetch
             ):
                 state, metrics = self._round(state, xr, yr)
+                bound_cpu_dispatch(self.topo, metrics)
                 rounds += 1
                 if on_round is not None:
                     on_round(rounds, state, metrics)

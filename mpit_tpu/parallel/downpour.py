@@ -27,7 +27,7 @@ import jax.numpy as jnp
 import optax
 from jax.sharding import PartitionSpec as P
 
-import mpit_tpu.comm.topology as _topo_mod
+from mpit_tpu.comm.topology import topology as _current_topology
 from mpit_tpu import goptim
 from mpit_tpu.comm.topology import Topology
 from mpit_tpu.parallel import common
@@ -69,7 +69,7 @@ class DownpourTrainer(common.RoundTrainer):
     ):
         self.model = model
         self.optimizer = optimizer
-        self.topo = topo if topo is not None else _topo_mod.topology()
+        self.topo = topo if topo is not None else _current_topology()
         self.tau = int(tau)
         self.staleness = int(staleness)
         if self.staleness < 0:

@@ -29,7 +29,7 @@ import jax.numpy as jnp
 import optax
 from jax.sharding import PartitionSpec as P
 
-import mpit_tpu.comm.topology as _topo_mod
+from mpit_tpu.comm.topology import topology as _current_topology
 from mpit_tpu import goptim
 from mpit_tpu.comm.topology import Topology
 from mpit_tpu.parallel import common
@@ -90,7 +90,7 @@ class EASGDTrainer(common.RoundTrainer):
         self.optimizer = optimizer
         self.use_pallas = bool(use_pallas)
         self.exchange_dtype = exchange_dtype
-        self.topo = topo if topo is not None else _topo_mod.topology()
+        self.topo = topo if topo is not None else _current_topology()
         self.tau = int(tau)
         w = self.topo.num_workers
         # β = 0.9 rule from the EASGD paper: α = β / W keeps the center move

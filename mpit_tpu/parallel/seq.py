@@ -30,7 +30,7 @@ import jax.numpy as jnp
 import optax
 from jax.sharding import NamedSharding, PartitionSpec as P
 
-import mpit_tpu.comm.topology as _topo_mod
+from mpit_tpu.comm.topology import topology as _current_topology
 from mpit_tpu.comm.topology import Topology
 from mpit_tpu.parallel import common
 
@@ -62,7 +62,7 @@ class SeqParallelTrainer:
     ):
         self.model = model
         self.optimizer = optimizer
-        self.topo = topo if topo is not None else _topo_mod.topology()
+        self.topo = topo if topo is not None else _current_topology()
         mesh = self.topo.mesh
         if len(mesh.axis_names) < 2:
             raise ValueError(
@@ -173,23 +173,45 @@ class SeqParallelTrainer:
     def step(self, state, x_global, y_global):
         """One step on a global (B, T) batch of tokens + shifted targets."""
         self._check(x_global)
-        return self._step(state, x_global, y_global)
+        state, metrics = self._step(state, x_global, y_global)
+        common.bound_cpu_dispatch(self.topo, metrics)
+        return state, metrics
+
+    def fit(
+        self,
+        batches,
+        state,
+        epochs: int = 1,
+        log_every: int = 0,
+        start_epoch: int = 0,
+        skip_steps: int = 0,
+        on_step=None,
+        prefetch: int = 2,
+    ):
+        """Epoch loop over (tokens, targets) :class:`~mpit_tpu.data.Batches`
+        — the shared :func:`common.synced_fit_loop`, staged with the 2-D
+        (dp, sp) sharding so no per-step redistribute sneaks in."""
+        return common.synced_fit_loop(
+            self.topo, self._step, batches, state,
+            sharding=self.data_sharding(),
+            check=self._check,
+            log_tag="seq-sync",
+            epochs=epochs, log_every=log_every, start_epoch=start_epoch,
+            skip_steps=skip_steps, on_step=on_step, prefetch=prefetch,
+        )
 
     def evaluate(self, state, x, y, batch: int = 512):
         """Token-level accuracy and mean loss over a (N, T) eval set."""
-        self._check(x)
-        w = self.dp_size
-        batch = (min(batch, len(x)) // w) * w or w
-        n = (len(x) // batch) * batch
-        if n == 0:
-            raise ValueError("eval set smaller than one global batch")
-        correct = 0
-        loss_sum = 0.0
-        for i in range(0, n, batch):
-            c, l = self._eval(
-                state.params, x[i : i + batch], y[i : i + batch]
+        # only T must divide sp here — batched_count_eval builds
+        # dp-divisible batches itself (the eval SET length owes the mesh
+        # nothing; caught by driving the PTB preset's 31-window eval set)
+        if x.shape[1] % self.sp_size:
+            raise ValueError(
+                f"sequence length {x.shape[1]} not divisible by "
+                f"sp={self.sp_size}"
             )
-            correct += int(c)
-            loss_sum += float(l)
+        correct, loss_sum, n = common.batched_count_eval(
+            self._eval, state.params, x, y, batch, self.dp_size
+        )
         tokens = n * x.shape[1]
         return correct / tokens, loss_sum / tokens

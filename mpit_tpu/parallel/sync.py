@@ -20,9 +20,8 @@ import jax.numpy as jnp
 import optax
 from jax.sharding import PartitionSpec as P
 
-import mpit_tpu.comm.topology as _topo_mod
+from mpit_tpu.comm.topology import topology as _current_topology
 from mpit_tpu.comm.topology import Topology
-from mpit_tpu.data.prefetch import prefetch_to_device
 from mpit_tpu.parallel import common
 
 
@@ -47,7 +46,7 @@ class DataParallelTrainer:
     ):
         self.model = model
         self.optimizer = optimizer
-        self.topo = topo if topo is not None else _topo_mod.topology()
+        self.topo = topo if topo is not None else _current_topology()
         self.loss_fn = (
             loss_fn
             if loss_fn is not None
@@ -113,23 +112,15 @@ class DataParallelTrainer:
     def step(self, state, x_global, y_global):
         """One sync-DP step on a global batch (leading dim divisible by W)."""
         common.check_global_batch(len(x_global), self.topo.num_workers)
-        return self._step(state, x_global, y_global)
+        state, metrics = self._step(state, x_global, y_global)
+        common.bound_cpu_dispatch(self.topo, metrics)
+        return state, metrics
 
     def evaluate(self, state, x, y, batch: int = 1024):
         """Full-dataset eval; returns (accuracy, mean_loss)."""
-        w = self.topo.num_workers
-        batch = (min(batch, len(x)) // w) * w or w
-        n = (len(x) // batch) * batch
-        correct = 0
-        loss_sum = 0.0
-        for i in range(0, n, batch):
-            c, l = self._eval(
-                state.params, x[i : i + batch], y[i : i + batch]
-            )
-            correct += int(c)
-            loss_sum += float(l)
-        if n == 0:
-            raise ValueError("eval set smaller than one global batch")
+        correct, loss_sum, n = common.batched_count_eval(
+            self._eval, state.params, x, y, batch, self.topo.num_workers
+        )
         return correct / n, loss_sum / n
 
     def fit(
@@ -143,45 +134,15 @@ class DataParallelTrainer:
         on_step=None,
         prefetch: int = 2,
     ):
-        """Epoch loop over a :class:`mpit_tpu.data.Batches`. Returns
-        (state, last_metrics). ``start_epoch``/``skip_steps`` re-enter the
-        deterministic data schedule for resume (epoch index seeds the
-        permutation); ``on_step(steps_done, state, metrics)`` fires after
-        every trained step. ``prefetch``: batches staged onto the mesh ahead
-        of the running step (async device_put overlaps transfer with
-        compute); 0 = stage synchronously."""
-        metrics = None
-        steps = 0
-        # one host fetch up front so log lines can number steps across
-        # resume without a per-step device round-trip
-        base_step = int(state.step) if log_every else 0
+        """Epoch loop over a :class:`mpit_tpu.data.Batches` — the shared
+        :func:`common.synced_fit_loop` with the sync-DP sharding/check.
+        Returns (state, last_metrics)."""
         w = self.topo.num_workers
-
-        def step_batches(e, to_skip):
-            for x, y in batches.epoch(e):
-                if to_skip > 0:
-                    to_skip -= 1
-                    continue
-                common.check_global_batch(len(x), w)
-                yield x, y
-
-        sharding = self.topo.worker_sharding()
-        for e in range(start_epoch, epochs):
-            to_skip = skip_steps if e == start_epoch else 0
-            for x, y in prefetch_to_device(
-                step_batches(e, to_skip), sharding, depth=prefetch
-            ):
-                state, metrics = self._step(state, x, y)
-                steps += 1
-                if on_step is not None:
-                    on_step(steps, state, metrics)
-                # gate on the HOST step counter: `int(state.step)` every
-                # step would force a device round-trip per step (a real
-                # throughput tax on the tunnel); only the logged steps may
-                # fetch device values
-                if log_every and steps % log_every == 0:
-                    print(
-                        f"[sync-dp] step={base_step + steps} "
-                        f"loss={float(metrics['loss']):.4f}"
-                    )
-        return state, metrics
+        return common.synced_fit_loop(
+            self.topo, self._step, batches, state,
+            sharding=self.topo.worker_sharding(),
+            check=lambda x: common.check_global_batch(len(x), w),
+            log_tag="sync-dp",
+            epochs=epochs, log_every=log_every, start_epoch=start_epoch,
+            skip_steps=skip_steps, on_step=on_step, prefetch=prefetch,
+        )

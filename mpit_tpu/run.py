@@ -78,7 +78,16 @@ def _build_model(cfg: TrainConfig, meta: dict):
     from mpit_tpu.models import STEM_MODELS, get_model
 
     name = cfg.model.lower()  # the registry lowercases; match it
-    if name in ("lstm", "lstm_lm", "ptb_lstm", "transformer"):
+    if name == "transformer":
+        return get_model(
+            cfg.model,
+            vocab_size=meta.get("vocab_size", 10_000),
+            max_len=max(cfg.seq_len, 32),
+            # seq-sync applies the model inside shard_map with the sequence
+            # sharded on the mesh's "sp" axis (ring attention)
+            seq_axis="sp" if cfg.resolved_algo() == "seq-sync" else None,
+        )
+    if name in ("lstm", "lstm_lm", "ptb_lstm"):
         return get_model(cfg.model, vocab_size=meta.get("vocab_size", 10_000))
     if name in STEM_MODELS:
         return get_model(cfg.model, stem=cfg.stem)
@@ -92,6 +101,7 @@ def build_trainer(cfg: TrainConfig, model, opt, topo):
         DataParallelTrainer,
         DownpourTrainer,
         EASGDTrainer,
+        SeqParallelTrainer,
     )
 
     if cfg.exchange_dtype not in ("none", "bf16"):
@@ -119,14 +129,53 @@ def build_trainer(cfg: TrainConfig, model, opt, topo):
                                staleness=cfg.staleness)
     if algo == "sync":
         return DataParallelTrainer(model, opt, topo)
+    if algo == "seq-sync":
+        return SeqParallelTrainer(model, opt, topo)
     raise ValueError(f"unknown algo {cfg.algo!r}")
+
+
+def _world_for(cfg: TrainConfig):
+    """The topology ``cfg`` needs, rebuilding the world when the pinned one
+    does not fit (seq-sync wants a 2-D dp×sp mesh with the configured sp
+    extent; everything else wants an effectively 1-D worker mesh)."""
+    import jax
+
+    import mpit_tpu
+    # direct from the submodule: the comm package re-exports topology (the
+    # function), shadowing the submodule attribute of the same name
+    from mpit_tpu.comm.topology import is_initialized
+    from mpit_tpu.comm.topology import topology as current_topology
+
+    algo = cfg.resolved_algo()
+    if is_initialized():
+        cur = current_topology()
+        names = cur.mesh.axis_names
+        shape = cur.mesh.devices.shape
+        if algo == "seq-sync":
+            fits = names[:2] == ("dp", "sp") and shape[1] == cfg.sp
+        else:
+            fits = all(n == 1 for n in shape[1:])
+        if fits:
+            return cur
+        mpit_tpu.finalize()
+    if algo == "seq-sync":
+        n = len(jax.devices())
+        if n % cfg.sp:
+            raise ValueError(
+                f"sp={cfg.sp} does not divide the {n} available devices"
+            )
+        return mpit_tpu.init(
+            axis_names=("dp", "sp"), mesh_shape=(n // cfg.sp, cfg.sp)
+        )
+    return mpit_tpu.init()
 
 
 def run(cfg: TrainConfig) -> dict:
     """Train per ``cfg``; returns a results dict (acc, loss, throughput...).
 
-    ``mpit_tpu.init()`` must not have been pinned to a conflicting world —
-    the driver calls ``init()`` itself (idempotent if already initialized).
+    The driver builds the world itself (idempotent when a fitting topology
+    exists; a non-fitting pinned mesh — e.g. a leftover 2-D seq-sync mesh —
+    is finalized and rebuilt, see :func:`_world_for`).
     """
     import jax
     import optax
@@ -142,7 +191,7 @@ def run(cfg: TrainConfig) -> dict:
         trace,
     )
 
-    topo = mpit_tpu.init()
+    topo = _world_for(cfg)
     x_tr, y_tr, x_te, y_te, meta = _load_dataset(cfg)
     from mpit_tpu.data import cast_input_dtype
 
@@ -177,7 +226,7 @@ def run(cfg: TrainConfig) -> dict:
             results["resumed_from"] = step
 
     batches = Batches(x_tr, y_tr, global_batch=gb, seed=cfg.seed)
-    is_sync = cfg.algo == "sync"
+    is_sync = cfg.resolved_algo() in ("sync", "seq-sync")
     tau = 1 if is_sync else cfg.tau
     units_per_epoch = batches.steps_per_epoch() // tau
     if units_per_epoch == 0:
@@ -232,8 +281,10 @@ def run(cfg: TrainConfig) -> dict:
         results["eval_loss"] = eval_loss
     else:
         acc = trainer.evaluate(state, x_te, y_te)
-    if is_seq:
-        acc = acc / cfg.seq_len  # eval counts correct *tokens* per window
+    if is_seq and cfg.resolved_algo() != "seq-sync":
+        # eval counts correct *tokens* per window; the seq-sync trainer
+        # already normalizes per token itself
+        acc = acc / cfg.seq_len
     results.update(
         accuracy=acc,
         final_loss=float(metrics["loss"]) if metrics is not None else None,
@@ -241,7 +292,9 @@ def run(cfg: TrainConfig) -> dict:
         samples=samples,
         wall_s=wall,
         samples_per_sec=samples / wall,
-        samples_per_sec_per_chip=samples / wall / topo.num_workers,
+        # per DEVICE, not per worker-axis entry: on seq-sync's 2-D mesh all
+        # dp*sp chips execute the step (identical on 1-D meshes)
+        samples_per_sec_per_chip=samples / wall / topo.num_devices,
         step_time={"steps": trained,
                    "mean_s": wall / trained if trained else None},
         last_checkpoint=(latest_checkpoint(cfg.ckpt_dir)

@@ -22,9 +22,11 @@ class TrainConfig:
     preset: Optional[str] = None  # one of PRESETS, or None for flag-driven
     model: str = "lenet"
     dataset: str = "mnist"
-    # easgd | eamsgd | downpour | sync | ps-easgd | ps-eamsgd | ps-downpour
-    # (eamsgd = EASGD with momentum in the local optimizer, the paper's
-    # momentum variant; the alias asserts momentum > 0)
+    # easgd | eamsgd | downpour | sync | seq-sync | ps-easgd | ps-eamsgd |
+    # ps-downpour (eamsgd = EASGD with momentum in the local optimizer, the
+    # paper's momentum variant — the alias asserts momentum > 0; seq-sync =
+    # sync DP over a 2-D dp x sp mesh with sequence-parallel ring attention,
+    # transformer only)
     algo: str = "easgd"
     # optimization (reference conf table: lr, τ, α — SURVEY.md §5)
     lr: float = 0.05
@@ -55,6 +57,9 @@ class TrainConfig:
     stem: str = "conv"
     # sequence models
     seq_len: int = 32
+    # seq-sync only: sequence-parallel extent (devices per ring; the mesh is
+    # (num_devices // sp) x sp — batch axis "dp", sequence axis "sp")
+    sp: int = 1
     # image models (ImageNet-shaped configs; smaller for CPU-mesh smoke runs)
     image_size: int = 224
     # plumbing
@@ -175,5 +180,12 @@ PRESETS: dict[str, dict] = {
         model="lstm", dataset="ptb", algo="easgd",
         lr=1.0, momentum=0.0, tau=4, global_batch=128, epochs=1,
         seq_len=32,
+    ),
+    # beyond-parity: long-context transformer LM, sequence-parallel sync DP
+    # over a dp x sp mesh (ring attention; --sp picks the ring width)
+    "ptb-transformer-seq": dict(
+        model="transformer", dataset="ptb", algo="seq-sync",
+        lr=0.001, momentum=0.9, global_batch=32, epochs=1,
+        seq_len=256, sp=1,
     ),
 }

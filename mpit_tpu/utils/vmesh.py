@@ -19,6 +19,21 @@ import sys
 from typing import Optional
 
 _COUNT_FLAG = "--xla_force_host_platform_device_count"
+# XLA:CPU collectives run one thread per virtual device and abort the whole
+# process (SIGABRT, "Termination timeout ... Exiting to ensure a consistent
+# program state", rendezvous.cc) if any participant misses the rendezvous
+# within the default 40 s. On a loaded single-core box 8 device threads can
+# legitimately take longer to all get scheduled — raise the ceiling so slow
+# is slow, not dead. Observed crashing ~1/3 of suite runs on the 1-CPU rig.
+_RENDEZVOUS_FLAGS = {
+    # the matching warn_stuck flag is NOT registered in this jaxlib (an
+    # unknown XLA_FLAGS entry is fatal), so only the termination ceiling is
+    # raised. 120 s tolerates slow scheduling of N device threads on a
+    # 1-core host without turning a genuine deadlock (see
+    # parallel/common.bound_cpu_dispatch, the actual mitigation) into a
+    # 15-minute hang.
+    "--xla_cpu_collective_call_terminate_timeout_seconds": 120,
+}
 
 
 def run_bounded(
@@ -64,6 +79,12 @@ def force_virtual_devices(n: int, platform: str = "cpu") -> None:
     Replaces any pre-existing device-count flag (CI images sometimes set
     one).  Call before backend init.
     """
-    flags = re.sub(_COUNT_FLAG + r"=\d+", "", os.environ.get("XLA_FLAGS", ""))
-    os.environ["XLA_FLAGS"] = (flags + f" {_COUNT_FLAG}={n}").strip()
+    flags = os.environ.get("XLA_FLAGS", "")
+    for flag in (_COUNT_FLAG, *_RENDEZVOUS_FLAGS):
+        flags = re.sub(flag + r"=\d+", "", flags)
+    extra = " ".join(
+        [f"{_COUNT_FLAG}={n}"]
+        + [f"{k}={v}" for k, v in _RENDEZVOUS_FLAGS.items()]
+    )
+    os.environ["XLA_FLAGS"] = " ".join((flags + " " + extra).split())
     repin_platform(platform)

@@ -69,6 +69,23 @@ class TestPresets:
         # token-level accuracy, properly normalized to [0, 1]
         assert 0.0 <= r["accuracy"] <= 1.0
 
+    def test_ptb_transformer_seq(self):
+        # sp=4 on the 8-device mesh: a (2, 4) dp x sp world, ring attention
+        # in the compiled step; afterwards a default-algo run must rebuild
+        # the 1-D world transparently (_world_for)
+        from mpit_tpu.comm.topology import topology as current_topology
+
+        r = run(_cfg("ptb-transformer-seq", train_size=32, global_batch=8,
+                     seq_len=32, sp=4, epochs=1))
+        assert r["trained_units"] == 4
+        assert 0.0 <= r["accuracy"] <= 1.0 and "eval_loss" in r
+        assert r["workers"] == 2  # dp extent of the (2, 4) mesh
+        topo = current_topology()
+        assert dict(topo.mesh.shape) == {"dp": 2, "sp": 4}
+        r2 = run(_cfg("mnist-easgd", train_size=256, global_batch=64,
+                      epochs=1))
+        assert r2["workers"] == 8  # world rebuilt to the 1-D mesh
+
 
 class TestDriverPlumbing:
     def test_metrics_and_checkpoint(self, tmp_path):

@@ -153,6 +153,21 @@ class TestValidation:
             trainer.step(state, x[:3], y[:3])
         mpit_tpu.finalize()
 
+    def test_evaluate_accepts_indivisible_set_length(self):
+        """The eval SET length owes the mesh nothing — only T must divide
+        sp; the batch loop builds dp-divisible batches itself (caught by
+        driving the PTB preset: its 31-window eval set crashed)."""
+        mpit_tpu.finalize()
+        topo = mpit_tpu.init(axis_names=("dp", "sp"), mesh_shape=(2, 4))
+        trainer = SeqParallelTrainer(
+            _model("sp"), optax.sgd(0.1), topo, donate_state=False
+        )
+        x, y = _data(seed=5, n=7)  # 7 windows: not divisible by dp=2
+        state = trainer.init_state(jax.random.key(0), x[:2, : T // 4])
+        acc, loss = trainer.evaluate(state, x, y)
+        assert 0.0 <= acc <= 1.0 and np.isfinite(loss)
+        mpit_tpu.finalize()
+
     def test_max_len_guard(self):
         m = dataclasses.replace(_model(None), max_len=T // 2)
         with pytest.raises(ValueError, match="max_len"):

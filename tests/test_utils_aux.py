@@ -157,11 +157,13 @@ class TestMetrics:
 
 class TestConfig:
     def test_presets_cover_baseline_configs(self):
-        # BASELINE.md table rows 1-5 (+ the literal ps shape)
-        assert set(PRESETS) == {
+        # BASELINE.md table rows 1-5 (+ the literal ps shape); extras must
+        # be a superset, never displace a baseline config
+        assert set(PRESETS) >= {
             "mnist-easgd", "mnist-ps", "cifar-vgg-sync",
             "alexnet-downpour", "resnet50-sync", "ptb-lstm-easgd",
         }
+        assert "ptb-transformer-seq" in PRESETS  # beyond-parity preset
 
     def test_json_roundtrip(self):
         cfg = TrainConfig(model="vgg", lr=0.02, tau=8)
