@@ -152,7 +152,15 @@ def _model_flops_per_sample(trainer, state, x, y):
 
     try:
         params = state.center if hasattr(state, "center") else state.params
-        jaxpr = jax.make_jaxpr(jax.grad(trainer.loss_fn))(params, x, y)
+        loss_fn = trainer.loss_fn
+        model = getattr(trainer, "model", None)
+        if model is not None and getattr(model, "seq_axis", None):
+            # the sharded model needs a mesh axis to trace; its dense twin
+            # computes the same FLOPs per sample
+            from mpit_tpu.parallel.common import default_loss_fn
+
+            loss_fn = default_loss_fn(model.clone(seq_axis=None).apply)
+        jaxpr = jax.make_jaxpr(jax.grad(loss_fn))(params, x, y)
         flops = _jaxpr_flops(jaxpr.jaxpr)
         return flops / len(x) if np.isfinite(flops) and flops > 0 else None
     except Exception:
@@ -187,7 +195,13 @@ def _stage_and_time(
     w = topo.num_workers
     gb = pwb * w
     rng = np.random.default_rng(0)
-    sharding = topo.worker_sharding()
+    # the seq trainer's inputs shard over BOTH mesh axes; everything else
+    # shards the leading batch axis over the worker axis
+    sharding = (
+        trainer.data_sharding()
+        if hasattr(trainer, "data_sharding")
+        else topo.worker_sharding()
+    )
     step = trainer._step if is_sync else trainer._round
     x_tr = cast_input_dtype(x_tr, input_dtype)
     staged = []
@@ -247,10 +261,11 @@ def _stage_and_time(
         )
 
     samples = rounds * tau * gb
+    chips = topo.num_devices  # == w except on the 2-D seq-sync mesh
     res = {
         "samples_per_sec": samples / dt,
-        "samples_per_sec_per_chip": samples / dt / w,
-        "chips": w,
+        "samples_per_sec_per_chip": samples / dt / chips,
+        "chips": chips,
         "platform": topo.platform,
         "tau": tau,
         "per_worker_batch": pwb,
@@ -305,6 +320,9 @@ _PRESET_BENCH = {
     "alexnet-downpour": 64,
     "resnet50-sync": 32,
     "ptb-lstm-easgd": 128,
+    # beyond-parity long-context config (T=256 tokens/sample; sp=1 on one
+    # chip — the ring is exercised by the CPU-mesh tests and dryrun)
+    "ptb-transformer-seq": 64,
 }
 # every benchmarkable preset (the staged collective ones above plus the
 # host-async literal-PS shape, which has its own harness)
@@ -373,7 +391,7 @@ def bench_ps_literal(
 
 def bench_preset(
     name: str, num_workers=None, cpu_smoke: bool = False,
-    input_dtype: str = "float32", stem: str = None,
+    input_dtype: str = "float32", stem: str = None, remat: bool = False,
 ) -> dict:
     """Steady-state training samples/sec/chip for one BASELINE workload
     config (same staging/timing harness as the headline metric)."""
@@ -400,6 +418,8 @@ def bench_preset(
                 f"choice; stem applies to {STEM_MODELS}"
             )
         cfg = dataclasses.replace(cfg, stem=stem)
+    if remat:
+        cfg = dataclasses.replace(cfg, remat=True)
     if name == "mnist-ps":
         return bench_ps_literal(cpu_smoke, input_dtype=input_dtype)
     pwb, rounds = _PRESET_BENCH[name], None
@@ -413,9 +433,25 @@ def bench_preset(
         pwb, rounds, image_cap = 8, 3, 64
 
     mpit_tpu.finalize()
-    topo = mpit_tpu.init(num_workers=num_workers)
+    if cfg.resolved_algo() == "seq-sync":
+        if num_workers is not None:  # honor a carved-down world here too
+            usable = (num_workers // cfg.sp) * cfg.sp
+            topo = mpit_tpu.init(
+                axis_names=("dp", "sp"),
+                mesh_shape=(usable // cfg.sp, cfg.sp),
+                num_workers=usable,
+            )
+        else:
+            from mpit_tpu.run import _world_for
+
+            topo = _world_for(cfg)
+    else:
+        topo = mpit_tpu.init(num_workers=num_workers)
+    # all devices execute every step; on the 2-D seq-sync mesh that is
+    # dp*sp chips, not just the worker-axis extent
     gb = pwb * topo.num_workers
-    tau = 1 if cfg.algo == "sync" else cfg.tau
+    is_sync = cfg.resolved_algo() in ("sync", "seq-sync")
+    tau = 1 if is_sync else cfg.tau
     cfg = dataclasses.replace(
         cfg, train_size=tau * gb * 2, image_size=min(cfg.image_size, image_cap)
     )
@@ -424,11 +460,12 @@ def bench_preset(
     opt = optax.sgd(cfg.lr, momentum=cfg.momentum)
     trainer = build_trainer(cfg, model, opt, topo)
     res = _stage_and_time(
-        trainer, cfg.algo == "sync", topo, x_tr, y_tr, pwb, tau, rounds,
+        trainer, is_sync, topo, x_tr, y_tr, pwb, tau, rounds,
         input_dtype=input_dtype,
     )
     return {**res, "algo": cfg.algo, "model": cfg.model,
-            **({"stem": cfg.stem} if stem is not None else {})}
+            **({"stem": cfg.stem} if stem is not None else {}),
+            **({"remat": True} if remat else {})}
 
 
 def measure_scaling_efficiency(full: dict) -> dict:

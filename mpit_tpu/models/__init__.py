@@ -22,6 +22,10 @@ _REGISTRY = {"lenet": LeNet, "mlp": MLP}
 # (run driver, bench, sweep script) gate stem flags on
 STEM_MODELS = ("resnet50", "resnet", "alexnet")
 
+# registry names whose model takes a remat= flag (block rematerialization,
+# jax.checkpoint via nn.remat) — same single-list contract as STEM_MODELS
+REMAT_MODELS = ("resnet50", "resnet", "transformer")
+
 
 def get_model(name: str, **kwargs):
     """Construct a model by registry name (lazily imported to keep startup

@@ -62,6 +62,9 @@ class ResNet50(nn.Module):
     stage_sizes: Sequence[int] = (3, 4, 6, 3)
     compute_dtype: Any = jnp.bfloat16
     stem: str = "conv"
+    # rematerialize each bottleneck on backward: the jax.checkpoint
+    # memory/FLOPs trade — fits bigger batches at 224px
+    remat: bool = False
 
     @nn.compact
     def __call__(self, x):
@@ -75,14 +78,20 @@ class ResNet50(nn.Module):
         )
         x = nn.relu(nn.GroupNorm(num_groups=32, dtype=dt)(x))
         x = nn.max_pool(x, (3, 3), strides=(2, 2), padding="SAME")
+        # explicit names: nn.remat renames the wrapped class, which would
+        # fork the param tree between remat modes
+        block_cls = nn.remat(Bottleneck) if self.remat else Bottleneck
+        idx = 0
         for stage, blocks in enumerate(self.stage_sizes):
             for block in range(blocks):
                 strides = (2, 2) if stage > 0 and block == 0 else (1, 1)
-                x = Bottleneck(
+                x = block_cls(
                     features=64 * 2**stage,
                     strides=strides,
                     compute_dtype=dt,
+                    name=f"Bottleneck_{idx}",
                 )(x)
+                idx += 1
         x = jnp.mean(x, axis=(1, 2))
         x = nn.Dense(self.num_classes, dtype=dt)(x)
         return x.astype(jnp.float32)

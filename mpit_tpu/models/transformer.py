@@ -75,6 +75,10 @@ class TransformerLM(nn.Module):
     max_len: int = 1024
     compute_dtype: Any = jnp.bfloat16
     seq_axis: Optional[str] = None
+    # rematerialize each block on the backward pass: activation memory
+    # drops from O(layers) to O(1) blocks for ~1/3 more FLOPs — the
+    # standard jax.checkpoint trade to fit longer T or bigger B in HBM
+    remat: bool = False
 
     @nn.compact
     def __call__(self, tokens):
@@ -103,13 +107,17 @@ class TransformerLM(nn.Module):
             )
         pos = offset + jnp.arange(t_local)
         x = embed(tokens) + pos_table[pos].astype(dt)
-        for _ in range(self.num_layers):
-            x = Block(
+        # explicit names: nn.remat renames the wrapped class (Checkpoint
+        # Block), which would fork the param tree between remat modes
+        block_cls = nn.remat(Block) if self.remat else Block
+        for i in range(self.num_layers):
+            x = block_cls(
                 d_model=self.d_model,
                 num_heads=self.num_heads,
                 d_ff=self.d_ff or 4 * self.d_model,
                 compute_dtype=dt,
                 seq_axis=self.seq_axis,
+                name=f"Block_{i}",
             )(x)
         x = nn.LayerNorm(dtype=dt)(x)
         # tied output head, genuinely in f32: Embed.attend would promote the

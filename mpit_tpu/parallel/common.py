@@ -101,8 +101,6 @@ def synced_fit_loop(
             check(x)
             yield x, y
 
-    from mpit_tpu.data.prefetch import prefetch_to_device
-
     for e in range(start_epoch, epochs):
         to_skip = skip_steps if e == start_epoch else 0
         for x, y in prefetch_to_device(

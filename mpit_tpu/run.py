@@ -75,9 +75,17 @@ def _ptb_windows(cfg: TrainConfig):
 
 
 def _build_model(cfg: TrainConfig, meta: dict):
-    from mpit_tpu.models import STEM_MODELS, get_model
+    from mpit_tpu.models import REMAT_MODELS, STEM_MODELS, get_model
 
     name = cfg.model.lower()  # the registry lowercases; match it
+    if cfg.remat and name not in REMAT_MODELS:
+        import warnings
+
+        warnings.warn(
+            f"remat is implemented for {REMAT_MODELS} only; model "
+            f"{cfg.model!r} runs without it",
+            stacklevel=2,
+        )
     if name == "transformer":
         return get_model(
             cfg.model,
@@ -86,9 +94,12 @@ def _build_model(cfg: TrainConfig, meta: dict):
             # seq-sync applies the model inside shard_map with the sequence
             # sharded on the mesh's "sp" axis (ring attention)
             seq_axis="sp" if cfg.resolved_algo() == "seq-sync" else None,
+            remat=cfg.remat,
         )
     if name in ("lstm", "lstm_lm", "ptb_lstm"):
         return get_model(cfg.model, vocab_size=meta.get("vocab_size", 10_000))
+    if name in ("resnet50", "resnet"):
+        return get_model(cfg.model, stem=cfg.stem, remat=cfg.remat)
     if name in STEM_MODELS:
         return get_model(cfg.model, stem=cfg.stem)
     return get_model(cfg.model)

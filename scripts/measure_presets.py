@@ -8,6 +8,7 @@ final markdown table for docs/PERF.md. Optional variants per preset via flags:
   --presets a,b,c        subset (default: all)
   --stem space_to_depth  stem variant for stem-capable presets (resnet50,
                          alexnet); others ignore it
+  --remat                rematerialize blocks (resnet50/transformer presets)
 
 Keep the host otherwise idle while this runs — the box has one CPU core and
 the timing legs dispatch from it.
@@ -58,21 +59,26 @@ def main():
         )
         raise SystemExit(2)
 
-    from mpit_tpu.models import STEM_MODELS
+    from mpit_tpu.models import REMAT_MODELS, STEM_MODELS
     from mpit_tpu.utils.config import TrainConfig
 
-    def stem_kw(name):
-        """Pass the stem only to presets whose model takes one."""
-        if stem is None:
-            return {}
+    remat = "--remat" in argv
+
+    def variant_kw(name):
+        """Pass stem/remat only to presets whose model takes them."""
         model = TrainConfig().apply_preset(name).model.lower()
-        return {"stem": stem} if model in STEM_MODELS else {}
+        kw = {}
+        if stem is not None and model in STEM_MODELS:
+            kw["stem"] = stem
+        if remat and model in REMAT_MODELS:
+            kw["remat"] = True
+        return kw
 
     rows = []
     for name in names:
         try:
             res = bench.bench_preset(
-                name, input_dtype=input_dtype, **stem_kw(name)
+                name, input_dtype=input_dtype, **variant_kw(name)
             )
         except Exception as e:  # keep the sweep alive past one bad preset
             print(json.dumps({"preset": name, "error": repr(e)}), flush=True)

@@ -57,6 +57,14 @@ class TestPresets:
         assert r["trained_units"] == 1
         assert 0.0 <= r["accuracy"] <= 1.0
 
+    def test_remat_trains_and_warns_on_unsupported_model(self):
+        r = run(_cfg("ptb-transformer-seq", train_size=32, global_batch=8,
+                     seq_len=32, sp=2, epochs=1, remat=True))
+        assert r["trained_units"] == 4 and "eval_loss" in r
+        with pytest.warns(UserWarning, match="remat is implemented"):
+            run(_cfg("mnist-easgd", train_size=256, global_batch=64,
+                     epochs=1, remat=True))
+
     def test_unknown_input_dtype_raises(self):
         with pytest.raises(ValueError, match="unknown input dtype"):
             run(_cfg("mnist-easgd", train_size=256, global_batch=64,
