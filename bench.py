@@ -419,6 +419,13 @@ def bench_preset(
             )
         cfg = dataclasses.replace(cfg, stem=stem)
     if remat:
+        from mpit_tpu.models import REMAT_MODELS
+
+        if cfg.model.lower() not in REMAT_MODELS:
+            raise ValueError(
+                f"preset {name!r} (model {cfg.model!r}) has no remat "
+                f"support; remat applies to {REMAT_MODELS}"
+            )
         cfg = dataclasses.replace(cfg, remat=True)
     if name == "mnist-ps":
         return bench_ps_literal(cpu_smoke, input_dtype=input_dtype)

@@ -98,11 +98,14 @@ def _build_model(cfg: TrainConfig, meta: dict):
         )
     if name in ("lstm", "lstm_lm", "ptb_lstm"):
         return get_model(cfg.model, vocab_size=meta.get("vocab_size", 10_000))
-    if name in ("resnet50", "resnet"):
-        return get_model(cfg.model, stem=cfg.stem, remat=cfg.remat)
+    # capability kwargs derive from the registry lists — the ONE source of
+    # which model takes which flag
+    kwargs = {}
     if name in STEM_MODELS:
-        return get_model(cfg.model, stem=cfg.stem)
-    return get_model(cfg.model)
+        kwargs["stem"] = cfg.stem
+    if name in REMAT_MODELS:
+        kwargs["remat"] = cfg.remat
+    return get_model(cfg.model, **kwargs)
 
 
 def build_trainer(cfg: TrainConfig, model, opt, topo):

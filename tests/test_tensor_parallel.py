@@ -1,0 +1,121 @@
+"""Tensor parallelism (GSPMD annotations): tp-invariance on the 8-device mesh.
+
+The trainer writes NO collectives — correctness is entirely "annotate the
+Megatron shardings, let the partitioner insert psums". The checks: weights
+really land sharded, the loss/param trajectory is invariant across
+(dp, tp) factorizations, and it matches the explicit-collective
+DataParallelTrainer at tp=1.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+import mpit_tpu
+from mpit_tpu.models.transformer import TransformerLM
+from mpit_tpu.parallel import DataParallelTrainer, TensorParallelTrainer
+
+V, B, T = 29, 8, 32
+
+
+def _model():
+    return TransformerLM(
+        vocab_size=V, num_layers=2, d_model=32, num_heads=8, max_len=T,
+        compute_dtype=jnp.float32,
+    )
+
+
+def _data(seed=0, n=B):
+    rng = np.random.default_rng(seed)
+    x = rng.integers(0, V, (n, T)).astype(np.int32)
+    return x, np.roll(x, -1, axis=1).astype(np.int32)
+
+
+def _run_tp(mesh_shape, steps=3):
+    mpit_tpu.finalize()
+    topo = mpit_tpu.init(axis_names=("dp", "tp"), mesh_shape=mesh_shape)
+    tr = TensorParallelTrainer(
+        _model(), optax.sgd(0.1, momentum=0.9), topo, donate_state=False
+    )
+    x, y = _data()
+    state = tr.init_state(jax.random.key(0), x[:2])
+    losses = []
+    for _ in range(steps):
+        state, m = tr.step(state, x, y)
+        losses.append(float(m["loss"]))
+    params = jax.tree.map(np.asarray, jax.device_get(state.params))
+    ev = tr.evaluate(state, x, y)
+    mpit_tpu.finalize()
+    return losses, params, ev
+
+
+class TestTensorParallel:
+    def test_weights_actually_sharded(self):
+        mpit_tpu.finalize()
+        topo = mpit_tpu.init(axis_names=("dp", "tp"), mesh_shape=(2, 4))
+        tr = TensorParallelTrainer(
+            _model(), optax.sgd(0.1), topo, donate_state=False
+        )
+        x, _ = _data()
+        state = tr.init_state(jax.random.key(0), x[:2])
+        qkv = state.params["Block_0"]["Dense_0"]["kernel"]
+        down = state.params["Block_0"]["Dense_3"]["kernel"]
+        # column-sharded qkv: each device holds 1/tp of the output dim
+        assert qkv.sharding.spec == ("tp",) or qkv.sharding.spec[-1] == "tp"
+        assert down.sharding.spec[0] == "tp"
+        # and the embedding stays replicated
+        emb = state.params["Embed_0"]["embedding"]
+        assert all(s is None for s in emb.sharding.spec)
+        mpit_tpu.finalize()
+
+    def test_tp_factorizations_match_each_other_and_dp(self):
+        ref_losses, ref_params, ref_ev = _run_tp((8, 1))
+        for shape in ((2, 4), (1, 8)):
+            losses, params, ev = _run_tp(shape)
+            np.testing.assert_allclose(
+                losses, ref_losses, rtol=1e-4, atol=1e-5,
+                err_msg=f"losses diverged for mesh {shape}",
+            )
+            jax.tree.map(
+                lambda a, b: np.testing.assert_allclose(
+                    a, b, rtol=2e-4, atol=2e-4
+                ),
+                params, ref_params,
+            )
+            assert ev[0] == pytest.approx(ref_ev[0], abs=1e-6)
+        # cross-check against the explicit-collective DP trainer (same
+        # math, hand-written psum) on the plain 1-D mesh
+        mpit_tpu.finalize()
+        topo = mpit_tpu.init(num_workers=8)
+        dp = DataParallelTrainer(
+            _model(), optax.sgd(0.1, momentum=0.9), topo,
+            donate_state=False,
+        )
+        x, y = _data()
+        state = dp.init_state(jax.random.key(0), x[:1])
+        dp_losses = []
+        for _ in range(3):
+            state, m = dp.step(state, x, y)
+            dp_losses.append(float(m["loss"]))
+        np.testing.assert_allclose(dp_losses, ref_losses, rtol=1e-4,
+                                   atol=1e-5)
+        mpit_tpu.finalize()
+
+    def test_validation(self):
+        mpit_tpu.finalize()
+        topo = mpit_tpu.init()
+        with pytest.raises(ValueError, match="second axis is 'tp'"):
+            TensorParallelTrainer(_model(), optax.sgd(0.1), topo)
+        mpit_tpu.finalize()
+        topo = mpit_tpu.init(axis_names=("dp", "tp"), mesh_shape=(1, 8))
+        with pytest.raises(ValueError, match="not divisible by tp"):
+            TensorParallelTrainer(
+                _model().clone(num_heads=2), optax.sgd(0.1), topo
+            )
+        with pytest.raises(ValueError, match="dense-attention"):
+            TensorParallelTrainer(
+                _model().clone(seq_axis="sp"), optax.sgd(0.1), topo
+            )
+        mpit_tpu.finalize()
