@@ -1,0 +1,307 @@
+"""Pipeline parallelism: GPipe-style microbatching over a ``pp`` mesh axis.
+
+Beyond-parity extension completing the parallelism suite (dp: all
+trainers; sp: ring attention; tp: GSPMD Megatron shardings; pp: here).
+The transformer's layer stack shards by STAGE: device ``s`` on the ``pp``
+axis holds layers ``[s·L/S, (s+1)·L/S)`` as stacked leaves, activations
+flow stage-to-stage with ``lax.ppermute`` (the TPU's neighbor-ICI
+primitive), and the batch is cut into microbatches so stages overlap —
+the classic schedule: tick ``t`` has stage ``s`` working microbatch
+``t−s``, ``M + S − 1`` ticks total, bubble fraction ``(S−1)/(M+S−1)``.
+
+The backward pass is NOT hand-written: ``jax.grad`` transposes the whole
+scan-of-ppermute program (the transpose of a ppermute is the reverse
+ppermute), so gradients flow backward through the pipeline automatically.
+
+Everything here is pure jax (no flax): the model is a dict of arrays with
+the block stack as stacked leaves — exactly the layout pipelining wants —
+and the optimizer is a manual SGD+momentum so its state tree mirrors the
+param tree (same shard_map specs apply to both).
+
+Boundary ownership keeps replicated params consistent: the embedding's
+input side contributes only on stage 0, the final norm and the tied
+head's output side only on the last stage (elsewhere their outputs are
+masked to zero), so each replicated param's raw gradient is nonzero only
+on its owning stage(s) — the tied embedding has two, whose contributions
+are complementary; the ``psum`` over pp sums them into the identical
+total gradient everywhere before the optimizer touches the replicated
+copies.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from mpit_tpu.comm.topology import topology as _current_topology
+from mpit_tpu.comm.topology import Topology
+from mpit_tpu.ops.ring_attention import dense_attention
+from mpit_tpu.parallel.common import bound_cpu_dispatch
+
+
+def _layer_norm(x, scale, bias, eps=1e-6):
+    x32 = x.astype(jnp.float32)
+    mu = x32.mean(-1, keepdims=True)
+    var = ((x32 - mu) ** 2).mean(-1, keepdims=True)
+    return ((x32 - mu) * lax.rsqrt(var + eps)).astype(x.dtype) * scale + bias
+
+
+def block_fn(p, h, num_heads: int):
+    """One pre-LN transformer block from stacked-leaf params ``p`` (a dict
+    of per-layer arrays WITHOUT the leading layer dim)."""
+    b, t, d = h.shape
+    y = _layer_norm(h, p["ln1_s"], p["ln1_b"])
+    qkv = y @ p["qkv_w"]
+    q, k, v = jnp.split(qkv, 3, axis=-1)
+    split = lambda a: a.reshape(b, t, num_heads, d // num_heads)
+    att = dense_attention(split(q), split(k), split(v), causal=True)
+    h = h + att.reshape(b, t, d) @ p["attn_o"]
+    y = _layer_norm(h, p["ln2_s"], p["ln2_b"])
+    y = jax.nn.gelu(y @ p["mlp_up"] + p["mlp_up_b"])
+    return h + y @ p["mlp_down"] + p["mlp_down_b"]
+
+
+def init_params(
+    rng, vocab_size: int, num_layers: int, d_model: int, d_ff: int,
+    max_len: int,
+) -> dict:
+    """{"blocks": stacked (L, ...) leaves, "rest": embed/pos/final-norm}."""
+    k = iter(jax.random.split(rng, 8))
+    dist = lambda key, *s: (jax.random.normal(key, s) / np.sqrt(s[-2])
+                            ).astype(jnp.float32)
+    L, D, F = num_layers, d_model, d_ff
+    blocks = {
+        "qkv_w": dist(next(k), L, D, 3 * D),
+        "attn_o": dist(next(k), L, D, D),
+        "mlp_up": dist(next(k), L, D, F),
+        "mlp_up_b": jnp.zeros((L, F)),
+        "mlp_down": dist(next(k), L, F, D),
+        "mlp_down_b": jnp.zeros((L, D)),
+        "ln1_s": jnp.ones((L, D)), "ln1_b": jnp.zeros((L, D)),
+        "ln2_s": jnp.ones((L, D)), "ln2_b": jnp.zeros((L, D)),
+    }
+    rest = {
+        "embed": jax.random.normal(next(k), (vocab_size, D)) * 0.02,
+        "pos": jax.random.normal(next(k), (max_len, D)) * 0.02,
+        "lnf_s": jnp.ones((D,)), "lnf_b": jnp.zeros((D,)),
+    }
+    return {"blocks": blocks, "rest": rest}
+
+
+def reference_apply(params, x, num_heads: int):
+    """Unpipelined ground truth: the same function, all layers in order."""
+    h = params["rest"]["embed"][x] + params["rest"]["pos"][: x.shape[1]]
+    h = lax.scan(
+        lambda c, p: (block_fn(p, c, num_heads), None), h, params["blocks"]
+    )[0]
+    h = _layer_norm(h, params["rest"]["lnf_s"], params["rest"]["lnf_b"])
+    return h @ params["rest"]["embed"].T
+
+
+class PipelineParallelTrainer:
+    """GPipe trainer for the pure-jax transformer LM over a (dp, pp) mesh.
+
+    Usage::
+
+        topo = mpit_tpu.init(axis_names=("dp", "pp"), mesh_shape=(2, 4))
+        tr = PipelineParallelTrainer(
+            vocab_size=V, num_layers=8, d_model=64, num_heads=4,
+            seq_len=T, topo=topo, n_micro=4, lr=0.1, momentum=0.9)
+        state = tr.init_state(jax.random.key(0))
+        state, metrics = tr.step(state, x_global, y_global)
+
+    Requires ``num_layers % pp == 0`` and the per-dp-shard batch divisible
+    by ``n_micro``. Math is schedule-invariant: the same trajectory as the
+    unpipelined reference and as any other (dp, pp) factorization
+    (tests/test_pipeline_parallel.py).
+    """
+
+    def __init__(
+        self,
+        vocab_size: int,
+        num_layers: int,
+        d_model: int,
+        num_heads: int,
+        seq_len: int,
+        topo: Optional[Topology] = None,
+        d_ff: int = 0,
+        n_micro: int = 4,
+        lr: float = 0.1,
+        momentum: float = 0.9,
+    ):
+        self.topo = topo if topo is not None else _current_topology()
+        mesh = self.topo.mesh
+        if len(mesh.axis_names) < 2 or mesh.axis_names[1] != "pp":
+            raise ValueError(
+                "PipelineParallelTrainer needs a mesh whose second axis is "
+                f"'pp'; got axes {mesh.axis_names}"
+            )
+        self.pp = int(mesh.shape["pp"])
+        self.dp = int(mesh.shape[mesh.axis_names[0]])
+        if num_layers % self.pp:
+            raise ValueError(
+                f"num_layers={num_layers} not divisible by pp={self.pp}"
+            )
+        if d_model % num_heads:
+            raise ValueError(
+                f"d_model={d_model} not divisible by num_heads={num_heads}"
+            )
+        self.vocab_size = vocab_size
+        self.num_layers = num_layers
+        self.d_model = d_model
+        self.num_heads = num_heads
+        self.d_ff = d_ff or 4 * d_model
+        self.seq_len = seq_len
+        self.n_micro = n_micro
+        self.lr, self.momentum = lr, momentum
+        dp_axis = mesh.axis_names[0]
+
+        spec = {"blocks": P("pp"), "rest": P()}
+        heads = num_heads
+        M, S = n_micro, self.pp
+
+        def forward(params, x):
+            """Loss on this (dp, pp) shard's batch block ``x`` (b, T)."""
+            s = lax.axis_index("pp")
+            rest = params["rest"]
+            b, t = x.shape
+            h = rest["embed"][x] + rest["pos"][:t]
+            # the pipeline consumes stage 0's embedding only; masking the
+            # rest keeps every replicated-param gradient single-owner
+            h = jnp.where(s == 0, h, 0.0)
+            mb = b // M
+            h_mb = h.reshape(M, mb, t, -1)
+
+            def stage(blocks, inp):
+                return lax.scan(
+                    lambda c, p: (block_fn(p, c, heads), None), inp, blocks
+                )[0]
+
+            perm = [(i, (i + 1) % S) for i in range(S)]
+            zero = jnp.zeros_like(h_mb[0])
+
+            def tick(carry, t_idx):
+                prev_out, outbuf = carry
+                recv = lax.ppermute(prev_out, "pp", perm)
+                my_mb = lax.dynamic_index_in_dim(
+                    h_mb, jnp.clip(t_idx, 0, M - 1), 0, keepdims=False
+                )
+                inp = jnp.where(s == 0, my_mb, recv)
+                out = stage(params["blocks"], inp)
+                out_idx = jnp.clip(t_idx - (S - 1), 0, M - 1)
+                valid = (t_idx >= S - 1) & (t_idx - (S - 1) < M)
+                cur = lax.dynamic_index_in_dim(
+                    outbuf, out_idx, 0, keepdims=False
+                )
+                outbuf = lax.dynamic_update_index_in_dim(
+                    outbuf, jnp.where(valid, out, cur), out_idx, 0
+                )
+                return (out, outbuf), None
+
+            (_, outbuf), _ = lax.scan(
+                tick,
+                (zero, jnp.zeros_like(h_mb)),
+                jnp.arange(M + S - 1),
+            )
+            # only the LAST stage's buffer holds the pipeline output; the
+            # head runs there alone so its params have one grad owner too
+            h_out = outbuf.reshape(b, t, -1)
+            h_out = _layer_norm(h_out, rest["lnf_s"], rest["lnf_b"])
+            logits = h_out @ rest["embed"].T
+            return jnp.where(s == S - 1, logits, 0.0)
+
+        def loss_fn(params, x, y):
+            """LOCAL masked loss — no collective inside: differentiating
+            through a psum multiplies every cotangent by the axis size
+            (psum transposes to psum), which scaled all grads by pp until
+            this was graded locally and reduced afterwards."""
+            s = lax.axis_index("pp")
+            logits = forward(params, x).astype(jnp.float32)
+            logp = jax.nn.log_softmax(logits, axis=-1)
+            ce = -jnp.take_along_axis(logp, y[..., None], axis=-1).mean()
+            return jnp.where(s == S - 1, ce, 0.0)
+
+        def train_step(state, x, y):
+            params, mom = state["params"], state["momentum"]
+            loss, grads = jax.value_and_grad(loss_fn)(params, x, y)
+            # the head stage owns the loss; psum makes it world-visible
+            loss = lax.psum(loss, "pp")
+            # single-owner replicated grads -> identical everywhere
+            grads["rest"] = lax.psum(grads["rest"], "pp")
+            grads = lax.pmean(grads, dp_axis)
+            loss = lax.pmean(loss, dp_axis)
+            mom = jax.tree.map(
+                lambda m, g: momentum * m + g, mom, grads
+            )
+            params = jax.tree.map(
+                lambda p, m: p - lr * m, params, mom
+            )
+            return (
+                {"params": params, "momentum": mom,
+                 "step": state["step"] + 1},
+                {"loss": loss},
+            )
+
+        state_spec = {"params": spec, "momentum": spec, "step": P()}
+        self._step = jax.jit(
+            jax.shard_map(
+                train_step,
+                mesh=mesh,
+                in_specs=(state_spec, P(dp_axis), P(dp_axis)),
+                out_specs=(state_spec, P()),
+                check_vma=False,
+            )
+        )
+
+    def init_state(self, rng) -> dict:
+        params = init_params(
+            rng, self.vocab_size, self.num_layers, self.d_model,
+            self.d_ff, self.seq_len,
+        )
+        state = {
+            "params": params,
+            "momentum": jax.tree.map(jnp.zeros_like, params),
+            "step": jnp.zeros((), jnp.int32),
+        }
+        mesh = self.topo.mesh
+
+        def group_shardings(tree):
+            return {
+                "blocks": jax.tree.map(
+                    lambda _: NamedSharding(mesh, P("pp")), tree["blocks"]
+                ),
+                "rest": jax.tree.map(
+                    lambda _: NamedSharding(mesh, P()), tree["rest"]
+                ),
+            }
+
+        shardings = {
+            "params": group_shardings(params),
+            "momentum": group_shardings(params),
+            "step": NamedSharding(mesh, P()),
+        }
+        return jax.device_put(state, shardings)
+
+    def step(self, state, x_global, y_global):
+        """One pipelined step on a global (B, T) batch."""
+        b = len(x_global)
+        if b % self.dp or (b // self.dp) % self.n_micro:
+            raise ValueError(
+                f"global batch {b} must split into dp={self.dp} shards of "
+                f"a multiple of n_micro={self.n_micro}"
+            )
+        if x_global.shape[1] > self.seq_len:
+            raise ValueError(
+                f"sequence of {x_global.shape[1]} exceeds the position "
+                f"table (seq_len={self.seq_len})"
+            )
+        state, metrics = self._step(
+            state, jnp.asarray(x_global), jnp.asarray(y_global)
+        )
+        bound_cpu_dispatch(self.topo, metrics)
+        return state, metrics

@@ -1,0 +1,123 @@
+"""Pipeline parallelism: schedule-invariance on the 8-device mesh.
+
+The GPipe schedule must be pure bookkeeping: the pipelined loss/update
+trajectory must equal the unpipelined reference apply with the same
+params, for every (dp, pp) factorization and microbatch count.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax  # noqa: F401  (parity with sibling test imports)
+import pytest
+
+import mpit_tpu
+from mpit_tpu.parallel.pipeline import (
+    PipelineParallelTrainer,
+    init_params,
+    reference_apply,
+)
+
+V, B, T, L, D, H = 23, 8, 16, 8, 32, 4
+
+
+def _data(seed=0):
+    rng = np.random.default_rng(seed)
+    x = rng.integers(0, V, (B, T)).astype(np.int32)
+    return x, np.roll(x, -1, axis=1).astype(np.int32)
+
+
+def _ref_loss(params, x, y):
+    logits = reference_apply(params, jnp.asarray(x), H).astype(jnp.float32)
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    return float(
+        -jnp.take_along_axis(logp, jnp.asarray(y)[..., None], -1).mean()
+    )
+
+
+def _run(mesh_shape, n_micro, steps=3):
+    mpit_tpu.finalize()
+    topo = mpit_tpu.init(axis_names=("dp", "pp"), mesh_shape=mesh_shape)
+    tr = PipelineParallelTrainer(
+        vocab_size=V, num_layers=L, d_model=D, num_heads=H, seq_len=T,
+        topo=topo, n_micro=n_micro, lr=0.1, momentum=0.9,
+    )
+    state = tr.init_state(jax.random.key(0))
+    x, y = _data()
+    losses = []
+    for _ in range(steps):
+        state, m = tr.step(state, x, y)
+        losses.append(float(m["loss"]))
+    params = jax.tree.map(np.asarray, jax.device_get(state["params"]))
+    mpit_tpu.finalize()
+    return losses, params
+
+
+class TestPipelineParallel:
+    def test_first_loss_matches_unpipelined_reference(self):
+        losses, _ = _run((1, 8), n_micro=4, steps=1)
+        params = init_params(jax.random.key(0), V, L, D, 4 * D, T)
+        x, y = _data()
+        assert losses[0] == pytest.approx(_ref_loss(params, x, y), rel=1e-5)
+
+    def test_factorizations_and_microbatching_match(self):
+        ref_losses, ref_params = _run((1, 8), n_micro=4)
+        for shape, m in (((2, 4), 4), ((4, 2), 2), ((1, 8), 8)):
+            losses, params = _run(shape, n_micro=m)
+            np.testing.assert_allclose(
+                losses, ref_losses, rtol=2e-5, atol=2e-6,
+                err_msg=f"mesh {shape} n_micro={m}",
+            )
+            jax.tree.map(
+                lambda a, b: np.testing.assert_allclose(
+                    a, b, rtol=2e-4, atol=2e-4
+                ),
+                params, ref_params,
+            )
+
+    def test_trains_to_low_loss(self):
+        mpit_tpu.finalize()
+        topo = mpit_tpu.init(axis_names=("dp", "pp"), mesh_shape=(2, 4))
+        tr = PipelineParallelTrainer(
+            vocab_size=V, num_layers=L, d_model=D, num_heads=H, seq_len=T,
+            topo=topo, n_micro=2, lr=0.3, momentum=0.9,
+        )
+        state = tr.init_state(jax.random.key(1))
+        stream = np.arange(B * T * 2, dtype=np.int32) % V
+        x = stream.reshape(-1, T)[:B]
+        y = np.roll(x, -1, axis=1).astype(np.int32)
+        first = last = None
+        for _ in range(40):
+            state, m = tr.step(state, x, y)
+            first = first if first is not None else float(m["loss"])
+            last = float(m["loss"])
+        assert last < first * 0.5, (first, last)
+        mpit_tpu.finalize()
+
+    def test_validation(self):
+        mpit_tpu.finalize()
+        topo = mpit_tpu.init(axis_names=("dp", "pp"), mesh_shape=(1, 8))
+        with pytest.raises(ValueError, match="not divisible by pp"):
+            PipelineParallelTrainer(
+                vocab_size=V, num_layers=6, d_model=D, num_heads=H,
+                seq_len=T, topo=topo,
+            )
+        tr = PipelineParallelTrainer(
+            vocab_size=V, num_layers=L, d_model=D, num_heads=H, seq_len=T,
+            topo=topo, n_micro=4,
+        )
+        state = tr.init_state(jax.random.key(0))
+        x, y = _data()
+        with pytest.raises(ValueError, match="n_micro"):
+            tr.step(state, x[:6], y[:6])
+        long_x = np.zeros((B, T * 2), np.int32)
+        with pytest.raises(ValueError, match="position"):
+            tr.step(state, long_x, long_x)
+        mpit_tpu.finalize()
+        topo = mpit_tpu.init()
+        with pytest.raises(ValueError, match="second axis is 'pp'"):
+            PipelineParallelTrainer(
+                vocab_size=V, num_layers=L, d_model=D, num_heads=H,
+                seq_len=T, topo=topo,
+            )
+        mpit_tpu.finalize()
