@@ -17,3 +17,8 @@ from mpit_tpu.ops.ring_attention import (  # noqa: F401
     make_ring_attention,
     ring_attention,
 )
+from mpit_tpu.ops.moe import (  # noqa: F401
+    init_moe_params,
+    moe_ffn,
+    moe_ffn_dense_reference,
+)

@@ -185,29 +185,21 @@ class PipelineParallelTrainer:
             perm = [(i, (i + 1) % S) for i in range(S)]
             zero = jnp.zeros_like(h_mb[0])
 
-            def tick(carry, t_idx):
-                prev_out, outbuf = carry
+            def tick(prev_out, t_idx):
                 recv = lax.ppermute(prev_out, "pp", perm)
                 my_mb = lax.dynamic_index_in_dim(
                     h_mb, jnp.clip(t_idx, 0, M - 1), 0, keepdims=False
                 )
                 inp = jnp.where(s == 0, my_mb, recv)
                 out = stage(params["blocks"], inp)
-                out_idx = jnp.clip(t_idx - (S - 1), 0, M - 1)
-                valid = (t_idx >= S - 1) & (t_idx - (S - 1) < M)
-                cur = lax.dynamic_index_in_dim(
-                    outbuf, out_idx, 0, keepdims=False
-                )
-                outbuf = lax.dynamic_update_index_in_dim(
-                    outbuf, jnp.where(valid, out, cur), out_idx, 0
-                )
-                return (out, outbuf), None
+                return out, out
 
-            (_, outbuf), _ = lax.scan(
-                tick,
-                (zero, jnp.zeros_like(h_mb)),
-                jnp.arange(M + S - 1),
-            )
+            # the last stage emits microbatch i at tick S-1+i: a STATIC
+            # slice of the stacked scan outputs selects exactly the valid
+            # window (carrying an output buffer through the scan instead
+            # would make backward residuals quadratic in M)
+            _, ys = lax.scan(tick, zero, jnp.arange(M + S - 1))
+            outbuf = ys[S - 1 : S - 1 + M]
             # only the LAST stage's buffer holds the pipeline output; the
             # head runs there alone so its params have one grad owner too
             h_out = outbuf.reshape(b, t, -1)

@@ -8,7 +8,6 @@ params, for every (dp, pp) factorization and microbatch count.
 import jax
 import jax.numpy as jnp
 import numpy as np
-import optax  # noqa: F401  (parity with sibling test imports)
 import pytest
 
 import mpit_tpu
