@@ -39,3 +39,45 @@ def pytest_report_header(config):
     import jax
 
     return f"mpit_tpu test mesh: {jax.device_count()} virtual CPU devices"
+
+
+# -- shared MoE test helpers (used by test_moe.py and test_properties.py) ----
+
+def run_moe_sharded(topo, params, h, capacity_factor):
+    """moe_ffn under shard_map on ``topo``: experts sharded, router
+    replicated, batch sharded on the worker axis."""
+    import jax
+    from jax.sharding import PartitionSpec as P
+
+    from mpit_tpu.ops import moe_ffn
+
+    axis = topo.worker_axis
+    spec = {k: (P() if k == "router" else P(axis)) for k in params}
+    fn = jax.jit(jax.shard_map(
+        lambda p, x: moe_ffn(
+            p, x, axis=axis, capacity_factor=capacity_factor
+        ),
+        mesh=topo.mesh, in_specs=(spec, P(axis)), out_specs=P(axis),
+        check_vma=False,
+    ))
+    import numpy as np
+
+    return np.asarray(fn(params, h))
+
+
+def moe_dense_per_shard(params, h, capacity_factor, ep):
+    """The dense reference applied shard-by-shard with the same local
+    token count — the ONE definition of the per-shard overflow contract."""
+    import jax.numpy as jnp
+    import numpy as np
+
+    from mpit_tpu.ops import moe_ffn_dense_reference
+
+    per = len(h) // ep
+    return np.concatenate([
+        np.asarray(moe_ffn_dense_reference(
+            params, jnp.asarray(h[i * per : (i + 1) * per]),
+            capacity_factor=capacity_factor,
+        ))
+        for i in range(ep)
+    ])

@@ -12,8 +12,9 @@ import numpy as np
 import pytest
 
 import mpit_tpu
+from conftest import moe_dense_per_shard, run_moe_sharded
 from jax.sharding import PartitionSpec as P
-from mpit_tpu.ops import init_moe_params, moe_ffn, moe_ffn_dense_reference
+from mpit_tpu.ops import init_moe_params, moe_ffn
 
 EP, E, D, F = 8, 16, 16, 32
 B, T = 8, 12  # one batch row per device
@@ -37,31 +38,11 @@ def _setup(seed=0):
     return params, h
 
 
-def _sharded(topo, params, h, capacity_factor):
-    axis = topo.worker_axis
-    shard_spec = {
-        "router": P(),
-        "w_up": P(axis), "b_up": P(axis),
-        "w_down": P(axis), "b_down": P(axis),
-    }
-
-    fn = jax.jit(jax.shard_map(
-        lambda p, x: moe_ffn(
-            p, x, axis=axis, capacity_factor=capacity_factor
-        ),
-        mesh=topo.mesh,
-        in_specs=(shard_spec, P(axis)),
-        out_specs=P(axis),
-        check_vma=False,
-    ))
-    return np.asarray(fn(params, h))
-
-
 class TestMoE:
     def test_matches_per_token_expert_choice_ample_capacity(self, topo):
         """No drops: every token must get exactly gate * its expert's FFN."""
         params, h = _setup()
-        got = _sharded(topo, params, h, capacity_factor=float(E))
+        got = run_moe_sharded(topo, params, h, float(E))
         # direct per-token computation, no capacity machinery at all
         h2 = h.reshape(-1, D)
         logits = h2 @ np.asarray(params["router"])
@@ -88,18 +69,11 @@ class TestMoE:
         reference run shard-by-shard with the same local token count."""
         params, h = _setup(seed=1)
         cf = 0.5  # forces drops
-        got = _sharded(topo, params, h, capacity_factor=cf)
-        per = B // EP
-        want = np.concatenate([
-            np.asarray(moe_ffn_dense_reference(
-                params, jnp.asarray(h[i * per : (i + 1) * per]),
-                capacity_factor=cf,
-            ))
-            for i in range(EP)
-        ])
+        got = run_moe_sharded(topo, params, h, cf)
+        want = moe_dense_per_shard(params, h, cf, EP)
         np.testing.assert_allclose(got, want, rtol=2e-4, atol=2e-4)
         # and drops actually happened (otherwise the test proves nothing)
-        ample = _sharded(topo, params, h, capacity_factor=float(E))
+        ample = run_moe_sharded(topo, params, h, float(E))
         assert not np.allclose(got, ample)
 
     def test_gradients_flow_to_local_experts(self, topo):
