@@ -34,6 +34,9 @@ class Block(nn.Module):
     d_ff: int
     compute_dtype: Any
     seq_axis: Optional[str]
+    moe_experts: int = 0
+    moe_axis: Optional[str] = None
+    moe_capacity_factor: float = 2.0
 
     @nn.compact
     def __call__(self, x):
@@ -51,10 +54,73 @@ class Block(nn.Module):
         att = att.reshape(*att.shape[:2], self.d_model)
         x = x + nn.Dense(self.d_model, use_bias=False, dtype=dt)(att)
         y = nn.LayerNorm(dtype=dt)(x)
-        y = nn.Dense(self.d_ff, dtype=dt)(y)
-        y = nn.gelu(y)
-        x = x + nn.Dense(self.d_model, dtype=dt)(y)
+        if self.moe_experts:
+            x = x + self._moe(y)
+        else:
+            y = nn.Dense(self.d_ff, dtype=dt)(y)
+            y = nn.gelu(y)
+            x = x + nn.Dense(self.d_model, dtype=dt)(y)
         return x
+
+    def _moe(self, y):
+        """GShard MoE FFN replacing the dense MLP (``mpit_tpu.ops.moe``).
+
+        Param names carry the ``moe_`` prefix — the expert-parallel
+        trainer's sharding rules key on it (experts shard over
+        ``moe_axis``, the router stays replicated). Outside shard_map
+        (``moe_axis=None``) the dense reference computes the same
+        function on all experts locally.
+        """
+        from mpit_tpu.ops.moe import moe_ffn, moe_ffn_dense_reference
+
+        e, dm, f = self.moe_experts, self.d_model, self.d_ff
+        # flax validates declared param shapes on APPLY too, so inside
+        # shard_map the expert leaves must be declared with their LOCAL
+        # shard shape (axis size is static there); init runs on the dense
+        # clone (moe_axis=None) and produces the global (e, ...) leaves
+        # that the trainer's P(axis) in-specs then shard to exactly this
+        e_l = e
+        if self.moe_axis is not None:
+            world = jax.lax.axis_size(self.moe_axis)
+            if e % world:
+                raise ValueError(
+                    f"moe_experts={e} not divisible by the {world}-wide "
+                    f"{self.moe_axis!r} axis"
+                )
+            e_l = e // world
+        init = nn.initializers.lecun_normal()
+        # the expert dim is a BATCH axis for initialization — plain lecun
+        # on (E, d_in, d_out) would count E into fan_in and start every
+        # expert sqrt(E) too small
+        expert_init = nn.initializers.variance_scaling(
+            1.0, "fan_in", "truncated_normal", in_axis=-2, out_axis=-1,
+            batch_axis=(0,),
+        )
+        params = {
+            "router": self.param("moe_router", init, (dm, e), jnp.float32),
+            "w_up": self.param(
+                "moe_w_up", expert_init, (e_l, dm, f), jnp.float32
+            ),
+            "b_up": self.param(
+                "moe_b_up", nn.initializers.zeros_init(), (e_l, f),
+                jnp.float32,
+            ),
+            "w_down": self.param(
+                "moe_w_down", expert_init, (e_l, f, dm), jnp.float32
+            ),
+            "b_down": self.param(
+                "moe_b_down", nn.initializers.zeros_init(), (e_l, dm),
+                jnp.float32,
+            ),
+        }
+        if self.moe_axis is not None:
+            return moe_ffn(
+                params, y, axis=self.moe_axis,
+                capacity_factor=self.moe_capacity_factor,
+            )
+        return moe_ffn_dense_reference(
+            params, y, capacity_factor=self.moe_capacity_factor
+        )
 
 
 class TransformerLM(nn.Module):
@@ -79,6 +145,12 @@ class TransformerLM(nn.Module):
     # drops from O(layers) to O(1) blocks for ~1/3 more FLOPs — the
     # standard jax.checkpoint trade to fit longer T or bigger B in HBM
     remat: bool = False
+    # mixture-of-experts FFN: moe_experts > 0 replaces every block's MLP
+    # with a top-1-routed MoE (ops/moe.py); moe_axis names the mesh axis
+    # experts shard over (None = all experts local / dense reference)
+    moe_experts: int = 0
+    moe_axis: Optional[str] = None
+    moe_capacity_factor: float = 2.0
 
     @nn.compact
     def __call__(self, tokens):
@@ -117,6 +189,9 @@ class TransformerLM(nn.Module):
                 d_ff=self.d_ff or 4 * self.d_model,
                 compute_dtype=dt,
                 seq_axis=self.seq_axis,
+                moe_experts=self.moe_experts,
+                moe_axis=self.moe_axis,
+                moe_capacity_factor=self.moe_capacity_factor,
                 name=f"Block_{i}",
             )(x)
         x = nn.LayerNorm(dtype=dt)(x)

@@ -22,3 +22,4 @@ from mpit_tpu.parallel.ps_trainer import AsyncPSTrainer  # noqa: F401
 from mpit_tpu.parallel.seq import SeqParallelTrainer  # noqa: F401
 from mpit_tpu.parallel.tensor import TensorParallelTrainer  # noqa: F401
 from mpit_tpu.parallel.pipeline import PipelineParallelTrainer  # noqa: F401
+from mpit_tpu.parallel.moe import MoEParallelTrainer  # noqa: F401
