@@ -202,7 +202,6 @@ def _stage_and_time(
         if hasattr(trainer, "data_sharding")
         else topo.worker_sharding()
     )
-    step = trainer._step if is_sync else trainer._round
     x_tr = cast_input_dtype(x_tr, input_dtype)
     staged = []
     for _ in range(8):
@@ -219,6 +218,9 @@ def _stage_and_time(
         )
 
     state = trainer.init_state(jax.random.key(0), x_tr[:2])
+    # grab the compiled step AFTER init_state: some trainers (MoE) build
+    # it lazily from the state template
+    step = trainer._step if is_sync else trainer._round
     flops_per_sample = _model_flops_per_sample(
         trainer, state, x_tr[:gb], y_tr[:gb]
     )
@@ -457,13 +459,13 @@ def bench_preset(
     # all devices execute every step; on the 2-D seq-sync mesh that is
     # dp*sp chips, not just the worker-axis extent
     gb = pwb * topo.num_workers
-    is_sync = cfg.resolved_algo() in ("sync", "seq-sync")
+    is_sync = cfg.resolved_algo() in ("sync", "seq-sync", "moe-sync")
     tau = 1 if is_sync else cfg.tau
     cfg = dataclasses.replace(
         cfg, train_size=tau * gb * 2, image_size=min(cfg.image_size, image_cap)
     )
     x_tr, y_tr, *_rest, _meta = _load_dataset(cfg)
-    model = _build_model(cfg, _meta)
+    model = _build_model(cfg, _meta, worker_axis=topo.worker_axis)
     opt = optax.sgd(cfg.lr, momentum=cfg.momentum)
     trainer = build_trainer(cfg, model, opt, topo)
     res = _stage_and_time(

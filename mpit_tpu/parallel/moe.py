@@ -194,6 +194,31 @@ class MoEParallelTrainer:
         common.bound_cpu_dispatch(self.topo, metrics)
         return state, metrics
 
+    def fit(
+        self,
+        batches,
+        state,
+        epochs: int = 1,
+        log_every: int = 0,
+        start_epoch: int = 0,
+        skip_steps: int = 0,
+        on_step=None,
+        prefetch: int = 2,
+    ):
+        """Epoch loop — the shared :func:`common.synced_fit_loop` with the
+        worker-axis batch sharding."""
+        if self._step is None:
+            self._build(state)
+        w = self.topo.num_workers
+        return common.synced_fit_loop(
+            self.topo, self._step, batches, state,
+            sharding=self.topo.worker_sharding(),
+            check=lambda x: common.check_global_batch(len(x), w),
+            log_tag="moe-sync",
+            epochs=epochs, log_every=log_every, start_epoch=start_epoch,
+            skip_steps=skip_steps, on_step=on_step, prefetch=prefetch,
+        )
+
     def evaluate(self, state, x, y, batch: int = 512):
         """Token-level accuracy and mean loss."""
         if self._eval is None:

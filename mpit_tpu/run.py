@@ -74,10 +74,15 @@ def _ptb_windows(cfg: TrainConfig):
     )
 
 
-def _build_model(cfg: TrainConfig, meta: dict):
+def _build_model(cfg: TrainConfig, meta: dict, worker_axis: str = None):
+    from mpit_tpu.comm.topology import WORKER_AXIS
     from mpit_tpu.models import REMAT_MODELS, STEM_MODELS, get_model
 
+    if worker_axis is None:
+        worker_axis = WORKER_AXIS
+
     name = cfg.model.lower()  # the registry lowercases; match it
+    algo = cfg.resolved_algo()
     if cfg.remat and name not in REMAT_MODELS:
         import warnings
 
@@ -86,15 +91,34 @@ def _build_model(cfg: TrainConfig, meta: dict):
             f"{cfg.model!r} runs without it",
             stacklevel=2,
         )
+    if cfg.moe_experts and not (name == "transformer" and algo == "moe-sync"):
+        import warnings
+
+        warnings.warn(
+            f"moe_experts={cfg.moe_experts} only applies with "
+            f"model='transformer' and algo='moe-sync'; model={cfg.model!r} "
+            f"algo={cfg.algo!r} runs without experts",
+            stacklevel=2,
+        )
     if name == "transformer":
         return get_model(
             cfg.model,
             vocab_size=meta.get("vocab_size", 10_000),
             max_len=max(cfg.seq_len, 32),
             # seq-sync applies the model inside shard_map with the sequence
-            # sharded on the mesh's "sp" axis (ring attention)
-            seq_axis="sp" if cfg.resolved_algo() == "seq-sync" else None,
+            # sharded on the mesh's "sp" axis (ring attention); moe-sync
+            # shards experts over the worker axis
+            seq_axis="sp" if algo == "seq-sync" else None,
             remat=cfg.remat,
+            **(
+                {
+                    "moe_experts": cfg.moe_experts,
+                    "moe_axis": worker_axis,
+                    "moe_capacity_factor": cfg.moe_capacity_factor,
+                }
+                if algo == "moe-sync"
+                else {}
+            ),
         )
     if name in ("lstm", "lstm_lm", "ptb_lstm"):
         return get_model(cfg.model, vocab_size=meta.get("vocab_size", 10_000))
@@ -145,6 +169,15 @@ def build_trainer(cfg: TrainConfig, model, opt, topo):
         return DataParallelTrainer(model, opt, topo)
     if algo == "seq-sync":
         return SeqParallelTrainer(model, opt, topo)
+    if algo == "moe-sync":
+        from mpit_tpu.parallel import MoEParallelTrainer
+
+        if not cfg.moe_experts:
+            raise ValueError(
+                "algo='moe-sync' needs --moe-experts > 0 (and model="
+                "transformer)"
+            )
+        return MoEParallelTrainer(model, opt, topo)
     raise ValueError(f"unknown algo {cfg.algo!r}")
 
 
@@ -213,7 +246,7 @@ def run(cfg: TrainConfig) -> dict:
     # staging win is per-step HBM/transfer traffic, which eval doesn't pay
     x_tr = cast_input_dtype(x_tr, cfg.input_dtype)
     is_seq = cfg.dataset == "ptb"
-    model = _build_model(cfg, meta)
+    model = _build_model(cfg, meta, worker_axis=topo.worker_axis)
     opt = optax.sgd(cfg.lr, momentum=cfg.momentum)
 
     log = MetricsLogger(path=cfg.metrics_path, tag=cfg.algo, echo=False)
@@ -240,7 +273,7 @@ def run(cfg: TrainConfig) -> dict:
             results["resumed_from"] = step
 
     batches = Batches(x_tr, y_tr, global_batch=gb, seed=cfg.seed)
-    is_sync = cfg.resolved_algo() in ("sync", "seq-sync")
+    is_sync = cfg.resolved_algo() in ("sync", "seq-sync", "moe-sync")
     tau = 1 if is_sync else cfg.tau
     units_per_epoch = batches.steps_per_epoch() // tau
     if units_per_epoch == 0:
@@ -295,9 +328,9 @@ def run(cfg: TrainConfig) -> dict:
         results["eval_loss"] = eval_loss
     else:
         acc = trainer.evaluate(state, x_te, y_te)
-    if is_seq and cfg.resolved_algo() != "seq-sync":
-        # eval counts correct *tokens* per window; the seq-sync trainer
-        # already normalizes per token itself
+    if is_seq and cfg.resolved_algo() not in ("seq-sync", "moe-sync"):
+        # eval counts correct *tokens* per window; the seq-sync and
+        # moe-sync trainers already normalize per token themselves
         acc = acc / cfg.seq_len
     results.update(
         accuracy=acc,

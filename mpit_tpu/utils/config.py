@@ -22,10 +22,12 @@ class TrainConfig:
     preset: Optional[str] = None  # one of PRESETS, or None for flag-driven
     model: str = "lenet"
     dataset: str = "mnist"
-    # easgd | eamsgd | downpour | sync | seq-sync | ps-easgd | ps-eamsgd |
-    # ps-downpour (eamsgd = EASGD with momentum in the local optimizer, the
-    # paper's momentum variant — the alias asserts momentum > 0; seq-sync =
-    # sync DP over a 2-D dp x sp mesh with sequence-parallel ring attention,
+    # easgd | eamsgd | downpour | sync | seq-sync | moe-sync | ps-easgd |
+    # ps-eamsgd | ps-downpour (eamsgd = EASGD with momentum in the local
+    # optimizer, the paper's momentum variant — the alias asserts
+    # momentum > 0; seq-sync = sync DP over a 2-D dp x sp mesh with
+    # sequence-parallel ring attention; moe-sync = sync DP with the
+    # transformer's MoE experts sharded over the worker axis — both
     # transformer only)
     algo: str = "easgd"
     # optimization (reference conf table: lr, τ, α — SURVEY.md §5)
@@ -64,6 +66,10 @@ class TrainConfig:
     # seq-sync only: sequence-parallel extent (devices per ring; the mesh is
     # (num_devices // sp) x sp — batch axis "dp", sequence axis "sp")
     sp: int = 1
+    # moe-sync only: expert count (sharded over the worker axis; must be
+    # divisible by it) and the GShard capacity factor
+    moe_experts: int = 0
+    moe_capacity_factor: float = 2.0
     # image models (ImageNet-shaped configs; smaller for CPU-mesh smoke runs)
     image_size: int = 224
     # plumbing

@@ -57,6 +57,21 @@ class TestPresets:
         assert r["trained_units"] == 1
         assert 0.0 <= r["accuracy"] <= 1.0
 
+    def test_moe_sync_transformer(self):
+        # expert-parallel MoE LM end to end through the driver: experts
+        # shard over the 8-device worker axis
+        r = run(_cfg("ptb-transformer-seq", algo="moe-sync",
+                     moe_experts=16, moe_capacity_factor=8.0,
+                     train_size=32, global_batch=8, seq_len=32, epochs=1))
+        assert r["trained_units"] == 4
+        assert 0.0 <= r["accuracy"] <= 1.0 and "eval_loss" in r
+        assert r["workers"] == 8
+
+    def test_moe_sync_requires_experts(self):
+        with pytest.raises(ValueError, match="moe-experts"):
+            run(_cfg("ptb-transformer-seq", algo="moe-sync",
+                     train_size=32, global_batch=8, seq_len=32, epochs=1))
+
     def test_remat_trains_and_warns_on_unsupported_model(self):
         r = run(_cfg("ptb-transformer-seq", train_size=32, global_batch=8,
                      seq_len=32, sp=2, epochs=1, remat=True))
