@@ -37,6 +37,7 @@ class Block(nn.Module):
     moe_experts: int = 0
     moe_axis: Optional[str] = None
     moe_capacity_factor: float = 2.0
+    moe_top_k: int = 1
 
     @nn.compact
     def __call__(self, x):
@@ -70,6 +71,11 @@ class Block(nn.Module):
         ``moe_axis``, the router stays replicated). Outside shard_map
         (``moe_axis=None``) the dense reference computes the same
         function on all experts locally.
+
+        Routing-quality stats (balance loss, router z-loss, drop
+        fraction) are sown into the ``moe_losses`` collection — a no-op
+        unless the caller applies with ``mutable=["moe_losses"]``, so
+        plain ``apply`` paths are untouched.
         """
         from mpit_tpu.ops.moe import moe_ffn, moe_ffn_dense_reference
 
@@ -114,13 +120,35 @@ class Block(nn.Module):
             ),
         }
         if self.moe_axis is not None:
-            return moe_ffn(
+            out, aux = moe_ffn(
                 params, y, axis=self.moe_axis,
                 capacity_factor=self.moe_capacity_factor,
+                top_k=self.moe_top_k, with_aux=True,
             )
-        return moe_ffn_dense_reference(
-            params, y, capacity_factor=self.moe_capacity_factor
-        )
+        else:
+            out, aux = moe_ffn_dense_reference(
+                params, y, capacity_factor=self.moe_capacity_factor,
+                top_k=self.moe_top_k, with_aux=True,
+            )
+        for name, val in aux.items():
+            self.sow("moe_losses", name, val)
+        return out
+
+
+def aggregate_moe_losses(collection: dict) -> dict:
+    """Mean each sown MoE stat over the blocks that sowed it.
+
+    ``collection`` is the ``moe_losses`` mutable returned by
+    ``model.apply(..., mutable=["moe_losses"])``:
+    ``{"Block_i": {name: (scalar,), ...}, ...}`` → ``{name: scalar}``.
+    """
+    per_name: dict = {}
+    for block_vals in collection.values():
+        for name, vals in block_vals.items():
+            per_name.setdefault(name, []).extend(vals)
+    return {
+        name: sum(vals) / len(vals) for name, vals in per_name.items()
+    }
 
 
 class TransformerLM(nn.Module):
@@ -146,11 +174,17 @@ class TransformerLM(nn.Module):
     # standard jax.checkpoint trade to fit longer T or bigger B in HBM
     remat: bool = False
     # mixture-of-experts FFN: moe_experts > 0 replaces every block's MLP
-    # with a top-1-routed MoE (ops/moe.py); moe_axis names the mesh axis
-    # experts shard over (None = all experts local / dense reference)
+    # with a top-k-routed MoE (ops/moe.py); moe_axis names the mesh axis
+    # experts shard over (None = all experts local / dense reference);
+    # moe_balance_weight/moe_zloss_weight scale the auxiliary
+    # load-balance and router z losses the MoE trainer adds to the CE
+    # objective (0.0 = off; the stats are sown either way)
     moe_experts: int = 0
     moe_axis: Optional[str] = None
     moe_capacity_factor: float = 2.0
+    moe_top_k: int = 1
+    moe_balance_weight: float = 0.0
+    moe_zloss_weight: float = 0.0
 
     @nn.compact
     def __call__(self, tokens):
@@ -192,6 +226,7 @@ class TransformerLM(nn.Module):
                 moe_experts=self.moe_experts,
                 moe_axis=self.moe_axis,
                 moe_capacity_factor=self.moe_capacity_factor,
+                moe_top_k=self.moe_top_k,
                 name=f"Block_{i}",
             )(x)
         x = nn.LayerNorm(dtype=dt)(x)

@@ -6,14 +6,18 @@ and a shard of the token batch — and tokens travel to their expert's
 device and back with ``lax.all_to_all``, the TPU collective built for
 exactly this exchange.
 
-Algorithm (Mesh-TensorFlow / GShard, top-1 routing with capacity):
+Algorithm (Mesh-TensorFlow / GShard, top-k routing with capacity):
 
-1. router scores each LOCAL token over all ``E`` experts; top-1 expert +
-   softmax gate per token;
+1. router scores each LOCAL token over all ``E`` experts; top-k experts +
+   softmax gates per token (k=1 keeps the raw top-1 probability as the
+   gate — the Switch rule; k>1 renormalizes the selected gates to sum to
+   one — the GShard rule);
 2. per (expert, capacity-slot) one-hot **dispatch** mask and gate-weighted
    **combine** tensor are built locally — tokens beyond an expert's
    capacity ``C`` are dropped (the standard overflow rule; capacity_factor
-   sizes ``C``);
+   sizes ``C``). Queueing is choice-major: every token's FIRST choice
+   claims its slot before any token's second choice (GShard's priority
+   rule — overflow sheds the lower-priority assignments first);
 3. ``einsum`` with the dispatch mask packs tokens into an ``(E, C, D)``
    buffer; ``all_to_all`` over ep regroups it so each device holds its own
    experts' slots from EVERY peer: ``(E/ep, ep·C, D)``;
@@ -56,24 +60,68 @@ def _expert_ffn(w_up, b_up, w_down, b_down, x):
     return jax.nn.gelu(x @ w_up + b_up) @ w_down + b_down
 
 
-def _routing(h2, router, num_experts: int, capacity: int):
-    """(tokens, D) → dispatch (T, E, C) one-hot and combine (T, E, C)."""
-    logits = h2 @ router
-    probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)
-    expert = jnp.argmax(probs, axis=-1)
-    gate = jnp.take_along_axis(probs, expert[:, None], axis=-1)[:, 0]
-    onehot = jax.nn.one_hot(expert, num_experts, dtype=jnp.float32)
-    # position of each token within its expert's queue (arrival order);
-    # non-selected columns end up at -1 and never pass the kept mask
-    position = jnp.cumsum(onehot, axis=0) * onehot - 1.0
-    kept = (position < capacity) & (onehot > 0)
-    # exactly one kept column per surviving token -> the sum IS its slot;
-    # dropped tokens sum to 0 but their kept mask zeroes the dispatch row
+def _routing(h2, router, num_experts: int, capacity: int, top_k: int = 1):
+    """(tokens, D) → dispatch (T, E, C) one-hot, combine (T, E, C), and
+    LOCAL routing statistics (for the balance/z losses and drop metric)."""
+    if not 1 <= top_k <= num_experts:
+        raise ValueError(
+            f"top_k={top_k} must be in [1, num_experts={num_experts}]"
+        )
+    t = h2.shape[0]
+    logits = (h2 @ router).astype(jnp.float32)
+    probs = jax.nn.softmax(logits, axis=-1)
+    gate_vals, expert_idx = lax.top_k(probs, top_k)  # (T, k), distinct
+    if top_k > 1:
+        # GShard: selected gates renormalize to sum to one; the k=1 path
+        # keeps the raw probability (Switch) so adding top-k changed no
+        # existing top-1 numerics
+        gate_vals = gate_vals / gate_vals.sum(-1, keepdims=True)
+    # choice-major queueing: flatten (choice, token) so every first
+    # choice claims its capacity slot before any second choice
+    flat_oh = jax.nn.one_hot(
+        expert_idx.T.reshape(-1), num_experts, dtype=jnp.float32
+    )  # (k·T, E)
+    # position of each assignment within its expert's queue; non-selected
+    # columns end up at -1 and never pass the kept mask
+    position = jnp.cumsum(flat_oh, axis=0) * flat_oh - 1.0
+    kept = (position < capacity) & (flat_oh > 0)
+    # exactly one kept column per surviving assignment -> the sum IS its
+    # slot; dropped rows sum to 0 but their kept mask zeroes the dispatch
     slot = jnp.where(kept, position, 0.0).sum(-1).astype(jnp.int32)
     pos_oh = jax.nn.one_hot(slot, capacity, dtype=jnp.float32)
-    dispatch = kept.astype(jnp.float32)[:, :, None] * pos_oh[:, None, :]
-    combine = gate[:, None, None] * dispatch
-    return dispatch, combine
+    disp_choice = (
+        kept.astype(jnp.float32)[:, :, None] * pos_oh[:, None, :]
+    ).reshape(top_k, t, num_experts, capacity)
+    dispatch = disp_choice.sum(0)
+    combine = jnp.einsum("kt,ktec->tec", gate_vals.T, disp_choice)
+    stats = {
+        # first-choice density (the GShard/Switch balance-loss f term;
+        # constant w.r.t. the router — only p carries gradient)
+        "f": jax.nn.one_hot(
+            expert_idx[:, 0], num_experts, dtype=jnp.float32
+        ).mean(0),
+        "p": probs.mean(0),
+        "z": jnp.mean(
+            jax.scipy.special.logsumexp(logits, axis=-1) ** 2
+        ),
+        "dropped": 1.0 - kept.sum() / (top_k * t),
+    }
+    return dispatch, combine, stats
+
+
+def _aux_from_stats(f, p, z, dropped, num_experts: int) -> dict:
+    """Balance/z losses from (possibly axis-averaged) routing stats.
+
+    ``balance`` is the Switch/GShard auxiliary load-balance loss
+    ``E · Σ_e f_e · p_e`` — exactly 1.0 under perfectly uniform routing,
+    larger the more the router concentrates. ``f`` is non-differentiable
+    (argmax density), so the gradient pushes ``p`` away from hot experts.
+    """
+    return {
+        "balance": num_experts * jnp.dot(f, p),
+        "zloss": z,
+        "dropped_frac": dropped,
+    }
 
 
 def moe_ffn(
@@ -81,13 +129,25 @@ def moe_ffn(
     h: jax.Array,
     axis: str = "ep",
     capacity_factor: float = 2.0,
-) -> jax.Array:
+    top_k: int = 1,
+    with_aux: bool = False,
+) -> "jax.Array | tuple[jax.Array, dict]":
     """Expert-parallel MoE FFN inside ``shard_map``.
 
     ``h``: the LOCAL (b, t, D) activation block (batch sharded on
     ``axis``). ``params["w_up"]/...`` carry the LOCAL expert shard
     (leading dim E/ep); ``params["router"]`` is replicated and scores all
-    E experts. Returns the same shape as ``h``.
+    E experts. Returns the same shape as ``h`` (plus an aux dict of
+    ``balance``/``zloss``/``dropped_frac`` scalars when ``with_aux`` —
+    each already ``pmean``-ed over ``axis``, so every device holds the
+    GLOBAL value and the losses are exactly mesh-width-invariant).
+
+    Capacity caveat: ``C`` is computed from the LOCAL token count, so the
+    per-expert capacity — not just arrival order — depends on the ep
+    extent. Under tight ``capacity_factor`` the set of dropped tokens is
+    therefore NOT invariant to mesh width; only the ample-capacity
+    (no-drop) regime is. The dense reference applies the same per-shard
+    rule only when given the same local token count.
     """
     ep = lax.axis_size(axis)
     b, t, d = h.shape
@@ -103,8 +163,8 @@ def moe_ffn(
     capacity = int(np.ceil(tokens * capacity_factor / num_experts))
     h2 = h.reshape(tokens, d)
 
-    dispatch, combine = _routing(
-        h2, params["router"], num_experts, capacity
+    dispatch, combine, stats = _routing(
+        h2, params["router"], num_experts, capacity, top_k=top_k
     )
     # pack: (E, C, D) buffer of this device's tokens, by expert and slot
     buf = jnp.einsum("tec,td->ecd", dispatch, h2.astype(jnp.float32))
@@ -124,25 +184,39 @@ def moe_ffn(
     out = lax.all_to_all(out, axis, 0, 0, tiled=False)
     out = out.reshape(num_experts, capacity, d)
     res = jnp.einsum("tec,ecd->td", combine, out)
-    return res.reshape(b, t, d).astype(h.dtype)
+    res = res.reshape(b, t, d).astype(h.dtype)
+    if not with_aux:
+        return res
+    # global stats: equal shard sizes make the pmean of local means exact
+    # (one pytree pmean -> one fused all-reduce)
+    g = lax.pmean(stats, axis)
+    aux = _aux_from_stats(
+        g["f"], g["p"], g["z"], g["dropped"], num_experts
+    )
+    return res, aux
 
 
 def moe_ffn_dense_reference(
-    params_full: dict, h: jax.Array, capacity_factor: float = 2.0
-) -> jax.Array:
+    params_full: dict,
+    h: jax.Array,
+    capacity_factor: float = 2.0,
+    top_k: int = 1,
+    with_aux: bool = False,
+) -> "jax.Array | tuple[jax.Array, dict]":
     """Unsharded ground truth: route each token, run its expert directly.
 
     ``params_full`` carries ALL experts (leading dim E). Implements the
     identical capacity/overflow rule so the equivalence is exact even when
-    tokens drop.
+    tokens drop (given the same local token count — see the capacity
+    caveat on :func:`moe_ffn`).
     """
     b, t, d = h.shape
     num_experts = params_full["w_up"].shape[0]
     tokens = b * t
     capacity = int(np.ceil(tokens * capacity_factor / num_experts))
     h2 = h.reshape(tokens, d)
-    dispatch, combine = _routing(
-        h2, params_full["router"], num_experts, capacity
+    dispatch, combine, stats = _routing(
+        h2, params_full["router"], num_experts, capacity, top_k=top_k
     )
     buf = jnp.einsum("tec,td->ecd", dispatch, h2.astype(jnp.float32))
     out = jax.vmap(_expert_ffn)(
@@ -150,4 +224,10 @@ def moe_ffn_dense_reference(
         params_full["b_down"], buf,
     )
     res = jnp.einsum("tec,ecd->td", combine, out)
-    return res.reshape(b, t, d).astype(h.dtype)
+    res = res.reshape(b, t, d).astype(h.dtype)
+    if not with_aux:
+        return res
+    aux = _aux_from_stats(
+        stats["f"], stats["p"], stats["z"], stats["dropped"], num_experts
+    )
+    return res, aux

@@ -88,13 +88,35 @@ class MoEParallelTrainer:
                 f"moe_experts={model.moe_experts} not divisible by "
                 f"{w} workers"
             )
-        self.loss_fn = common.default_loss_fn(model.apply)
+        from mpit_tpu.models.transformer import aggregate_moe_losses
+
+        w_bal = float(getattr(model, "moe_balance_weight", 0.0))
+        w_z = float(getattr(model, "moe_zloss_weight", 0.0))
+
+        def loss_fn(params, x, y):
+            """CE + weighted aux losses; aux stats reported either way.
+
+            The sown stats come out of the op already pmean-ed over the
+            worker axis, so the aux terms are identical on every device —
+            the local-grad-then-reduce accounting below stays exact (see
+            the module docstring)."""
+            logits, mut = model.apply(
+                {"params": params}, x, mutable=["moe_losses"]
+            )
+            aux = aggregate_moe_losses(mut["moe_losses"])
+            loss = common.cross_entropy_loss(logits, y)
+            loss = loss + w_bal * aux["balance"] + w_z * aux["zloss"]
+            return loss, aux
+
+        self.loss_fn = loss_fn
 
         def spec_of(path, _):
             return P(axis) if _is_expert_leaf(path) else P()
 
         def train_step(state: common.TrainState, x, y):
-            loss, grads = jax.value_and_grad(self.loss_fn)(state.params, x, y)
+            (loss, aux), grads = jax.value_and_grad(
+                self.loss_fn, has_aux=True
+            )(state.params, x, y)
             # expert leaves: the all_to_all transpose already delivered
             # every device's contribution (scaled W x, see module doc);
             # replicated leaves: average the local terms
@@ -108,11 +130,15 @@ class MoEParallelTrainer:
                 grads, state.opt_state, state.params
             )
             params = optax.apply_updates(state.params, updates)
+            metrics = {"loss": loss}
+            metrics.update(
+                (f"moe_{k}", v) for k, v in aux.items()
+            )
             return (
                 common.TrainState(
                     params=params, opt_state=opt_state, step=state.step + 1
                 ),
-                {"loss": loss},
+                metrics,
             )
 
         # per-leaf specs: the SAME rule tree for state-in and state-out

@@ -115,6 +115,9 @@ def _build_model(cfg: TrainConfig, meta: dict, worker_axis: str = None):
                     "moe_experts": cfg.moe_experts,
                     "moe_axis": worker_axis,
                     "moe_capacity_factor": cfg.moe_capacity_factor,
+                    "moe_top_k": cfg.moe_top_k,
+                    "moe_balance_weight": cfg.moe_balance_weight,
+                    "moe_zloss_weight": cfg.moe_zloss_weight,
                 }
                 if algo == "moe-sync"
                 else {}

@@ -70,6 +70,12 @@ class TrainConfig:
     # divisible by it) and the GShard capacity factor
     moe_experts: int = 0
     moe_capacity_factor: float = 2.0
+    # routing fidelity: top-k expert choice (1 = Switch, 2 = GShard),
+    # auxiliary load-balance loss weight (GShard uses ~1e-2) and router
+    # z-loss weight (ST-MoE uses ~1e-3); 0.0 = off
+    moe_top_k: int = 1
+    moe_balance_weight: float = 0.0
+    moe_zloss_weight: float = 0.0
     # image models (ImageNet-shaped configs; smaller for CPU-mesh smoke runs)
     image_size: int = 224
     # plumbing

@@ -43,7 +43,7 @@ def pytest_report_header(config):
 
 # -- shared MoE test helpers (used by test_moe.py and test_properties.py) ----
 
-def run_moe_sharded(topo, params, h, capacity_factor):
+def run_moe_sharded(topo, params, h, capacity_factor, top_k=1):
     """moe_ffn under shard_map on ``topo``: experts sharded, router
     replicated, batch sharded on the worker axis."""
     import jax
@@ -55,7 +55,7 @@ def run_moe_sharded(topo, params, h, capacity_factor):
     spec = {k: (P() if k == "router" else P(axis)) for k in params}
     fn = jax.jit(jax.shard_map(
         lambda p, x: moe_ffn(
-            p, x, axis=axis, capacity_factor=capacity_factor
+            p, x, axis=axis, capacity_factor=capacity_factor, top_k=top_k
         ),
         mesh=topo.mesh, in_specs=(spec, P(axis)), out_specs=P(axis),
         check_vma=False,
@@ -65,7 +65,7 @@ def run_moe_sharded(topo, params, h, capacity_factor):
     return np.asarray(fn(params, h))
 
 
-def moe_dense_per_shard(params, h, capacity_factor, ep):
+def moe_dense_per_shard(params, h, capacity_factor, ep, top_k=1):
     """The dense reference applied shard-by-shard with the same local
     token count — the ONE definition of the per-shard overflow contract."""
     import jax.numpy as jnp
@@ -77,7 +77,7 @@ def moe_dense_per_shard(params, h, capacity_factor, ep):
     return np.concatenate([
         np.asarray(moe_ffn_dense_reference(
             params, jnp.asarray(h[i * per : (i + 1) * per]),
-            capacity_factor=capacity_factor,
+            capacity_factor=capacity_factor, top_k=top_k,
         ))
         for i in range(ep)
     ])

@@ -76,6 +76,104 @@ class TestMoE:
         ample = run_moe_sharded(topo, params, h, float(E))
         assert not np.allclose(got, ample)
 
+    def test_top2_matches_per_token_ample_capacity(self, topo):
+        """Top-2: every token gets its two experts' outputs mixed by the
+        renormalized gates (the GShard rule)."""
+        params, h = _setup(seed=3)
+        got = run_moe_sharded(topo, params, h, float(E), top_k=2)
+        h2 = h.reshape(-1, D)
+        probs = np.asarray(
+            jax.nn.softmax(h2 @ np.asarray(params["router"]), axis=-1)
+        )
+        want = np.zeros_like(h2)
+        for i in range(len(h2)):
+            idx = np.argsort(-probs[i])[:2]
+            g = probs[i][idx] / probs[i][idx].sum()
+            for gw, ex in zip(g, idx):
+                want[i] += gw * np.asarray(
+                    jax.nn.gelu(
+                        h2[i] @ params["w_up"][ex] + params["b_up"][ex]
+                    )
+                    @ params["w_down"][ex]
+                    + params["b_down"][ex]
+                )
+        np.testing.assert_allclose(
+            got, want.reshape(B, T, D), rtol=2e-4, atol=2e-4
+        )
+
+    def test_top2_matches_dense_reference_with_drops(self, topo):
+        """Tight capacity, top-2: the sharded op equals the dense
+        reference per shard — including the choice-major priority rule
+        (first choices claim slots before any second choice)."""
+        params, h = _setup(seed=4)
+        cf = 0.75  # tight enough that second choices overflow
+        got = run_moe_sharded(topo, params, h, cf, top_k=2)
+        want = moe_dense_per_shard(params, h, cf, EP, top_k=2)
+        np.testing.assert_allclose(got, want, rtol=2e-4, atol=2e-4)
+        ample = run_moe_sharded(topo, params, h, float(E), top_k=2)
+        assert not np.allclose(got, ample)
+
+    def test_aux_sharded_matches_dense_global(self, topo):
+        """The pmean-ed sharded aux equals the dense aux on the full
+        batch (ample capacity so the drop stat agrees too)."""
+        from jax.sharding import PartitionSpec as P
+
+        from mpit_tpu.ops import moe_ffn, moe_ffn_dense_reference
+
+        params, h = _setup(seed=5)
+        axis = topo.worker_axis
+        spec = {k: (P() if k == "router" else P(axis)) for k in params}
+        fn = jax.jit(jax.shard_map(
+            lambda p, x: moe_ffn(
+                p, x, axis=axis, capacity_factor=float(E), top_k=2,
+                with_aux=True,
+            )[1],
+            mesh=topo.mesh, in_specs=(spec, P(axis)), out_specs=P(),
+            check_vma=False,
+        ))
+        got = {k: float(v) for k, v in fn(params, h).items()}
+        _, want = moe_ffn_dense_reference(
+            jax.tree.map(jnp.asarray, params), jnp.asarray(h),
+            capacity_factor=float(E), top_k=2, with_aux=True,
+        )
+        for k in got:
+            np.testing.assert_allclose(
+                got[k], float(want[k]), rtol=1e-5, atol=1e-6, err_msg=k
+            )
+
+    def test_balance_loss_detects_and_fixes_skew(self, topo):
+        """A router collapsed onto one expert scores a high balance loss
+        and drops tokens; descending the balance loss alone re-spreads
+        the routing and recovers the dropped tokens."""
+        from mpit_tpu.ops.moe import moe_ffn_dense_reference
+
+        params, h = _setup(seed=6)
+        params = dict(params)
+        skewed = np.asarray(params["router"]).copy()
+        skewed[:, 0] = 5.0  # every token's top choice becomes expert 0
+        params["router"] = jnp.asarray(skewed)
+        cf = 1.5
+
+        def aux_of(p):
+            return moe_ffn_dense_reference(
+                p, jnp.asarray(h), capacity_factor=cf, top_k=1,
+                with_aux=True,
+            )[1]
+
+        before = aux_of(params)
+        assert float(before["balance"]) > 2.0  # uniform scores 1.0
+        assert float(before["dropped_frac"]) > 0.3
+
+        grad_fn = jax.jit(jax.grad(
+            lambda r: aux_of({**params, "router": r})["balance"]
+        ))
+        r = params["router"]
+        for _ in range(250):
+            r = r - 2.0 * grad_fn(r)
+        after = aux_of({**params, "router": r})
+        assert float(after["balance"]) < float(before["balance"]) * 0.6
+        assert float(after["dropped_frac"]) < float(before["dropped_frac"])
+
     def test_gradients_flow_to_local_experts(self, topo):
         """grad through the all_to_all pair lands on the expert weights."""
         params, h = _setup(seed=2)
@@ -112,7 +210,7 @@ class TestMoE:
 class TestMoETrainer:
     """MoEParallelTrainer: the op made load-bearing in a trainable LM."""
 
-    def _trainer(self, topo, experts=16, cf=16.0):
+    def _trainer(self, topo, experts=16, cf=16.0, **model_kw):
         import optax
 
         from mpit_tpu.models.transformer import TransformerLM
@@ -122,7 +220,7 @@ class TestMoETrainer:
             vocab_size=31, num_layers=2, d_model=32, num_heads=4,
             max_len=16, compute_dtype=jnp.float32,
             moe_experts=experts, moe_axis=topo.worker_axis,
-            moe_capacity_factor=cf,
+            moe_capacity_factor=cf, **model_kw,
         )
         return MoEParallelTrainer(
             model, optax.sgd(0.1, momentum=0.9), topo, donate_state=False
@@ -160,6 +258,55 @@ class TestMoETrainer:
             ),
             results[8][1], results[1][1],
         )
+
+    def test_w_invariance_top2_with_aux_losses(self):
+        """Top-2 routing with balance + z losses in the objective is
+        still exactly mesh-width-invariant (the aux stats are pmean-ed
+        inside the op, so W=8 and W=1 optimize the identical loss)."""
+        results = {}
+        for w in (8, 1):
+            mpit_tpu.finalize()
+            topo = mpit_tpu.init(num_workers=w)
+            tr = self._trainer(
+                topo, moe_top_k=2, moe_balance_weight=0.02,
+                moe_zloss_weight=1e-3,
+            )
+            x, y = self._tokens(seed=3)
+            state = tr.init_state(jax.random.key(0), x[: max(8 // w, 1)])
+            losses = []
+            for _ in range(3):
+                state, m = tr.step(state, x, y)
+                losses.append(
+                    (float(m["loss"]), float(m["moe_balance"]))
+                )
+            results[w] = (
+                losses,
+                jax.tree.map(np.asarray, jax.device_get(state.params)),
+            )
+            mpit_tpu.finalize()
+        np.testing.assert_allclose(
+            results[8][0], results[1][0], rtol=1e-4, atol=1e-5
+        )
+        jax.tree.map(
+            lambda a, b: np.testing.assert_allclose(
+                a, b, rtol=3e-4, atol=3e-4
+            ),
+            results[8][1], results[1][1],
+        )
+
+    def test_aux_metrics_reported(self):
+        """Every step reports the routing-quality stats, weighted into
+        the objective or not."""
+        mpit_tpu.finalize()
+        topo = mpit_tpu.init()
+        tr = self._trainer(topo)
+        x, y = self._tokens()
+        state = tr.init_state(jax.random.key(0), x[:1])
+        _, m = tr.step(state, x, y)
+        assert {"moe_balance", "moe_zloss", "moe_dropped_frac"} <= set(m)
+        assert float(m["moe_balance"]) >= 1.0 - 1e-3
+        assert 0.0 <= float(m["moe_dropped_frac"]) <= 1.0
+        mpit_tpu.finalize()
 
     def test_converges(self):
         mpit_tpu.finalize()
