@@ -52,6 +52,63 @@ def default_loss_fn(apply_fn: Callable) -> Callable:
     return loss_fn
 
 
+def assert_elementwise_optimizer(
+    optimizer: optax.GradientTransformation, context: str
+) -> None:
+    """Reject optimizers whose per-leaf update depends on OTHER leaves.
+
+    Trainers that run ``optimizer.update`` inside ``shard_map`` on
+    device-varying gradients (expert-parallel MoE) silently desynchronize
+    replicated leaves under cross-leaf transforms: ``clip_by_global_norm``
+    computes a different norm on every device, so the replicated leaves
+    receive different updates and the replicas drift — no error, just
+    corruption. (Trainers that pmean gradients before the update, and the
+    GSPMD tensor-parallel trainer whose update runs under jit where XLA
+    inserts the cross-device norm collectives itself, are NOT subject.)
+
+    Detection is behavioral, not by name: probe the optimizer with
+    gradient trees differing only in leaf ``b`` — once scaled (large
+    magnitudes, so realistic global-norm thresholds trip) and once with
+    ``b`` poisoned to NaN (so all-finite gates like
+    ``optax.apply_if_finite`` trip) — and reject if leaf ``a``'s update
+    changes. Elementwise transforms (sgd, momentum, adam, adamw,
+    per-leaf clip, ...) pass bitwise. Best-effort by nature: coupling
+    that activates only beyond the probed magnitudes (say a clip
+    threshold above 4e8) still slips through, and optimizers the probe
+    cannot run (e.g. ``optax.masked`` bound to the real param
+    structure) are let through — the hazard stays documented on the
+    trainer either way.
+    """
+    probe = {
+        "a": jnp.full((2,), 1e8, jnp.float32),
+        "b": jnp.full((2,), 1e8, jnp.float32),
+    }
+    try:
+        st = optimizer.init(probe)
+        u1, _ = optimizer.update(dict(probe), st, probe)
+        u2, _ = optimizer.update(
+            {"a": probe["a"], "b": probe["b"] * 3.0}, st, probe
+        )
+        u3, _ = optimizer.update(
+            {"a": probe["a"], "b": jnp.full((2,), jnp.nan)}, st, probe
+        )
+    except Exception:
+        return
+    ua = np.asarray(u1["a"])
+    if not (
+        np.array_equal(ua, np.asarray(u2["a"]))
+        and np.array_equal(ua, np.asarray(u3["a"]))
+    ):
+        raise ValueError(
+            f"{context} requires an ELEMENTWISE optimizer: this one's "
+            "update for a leaf depends on other leaves' gradients "
+            "(global-norm clipping?), which silently desynchronizes "
+            "replicated parameters when the update runs on "
+            "device-varying gradients inside shard_map. Use per-leaf "
+            "clipping (optax.clip, optax.clip_by_block_rms) instead."
+        )
+
+
 def check_global_batch(global_batch: int, num_workers: int) -> int:
     if global_batch % num_workers != 0:
         raise ValueError(

@@ -58,7 +58,9 @@ class MoEParallelTrainer:
     on that leaf. Cross-leaf transforms (``clip_by_global_norm``,
     ``global_norm``-based schedules) would compute a different scalar per
     device and silently desynchronize the replicated leaves; use per-leaf
-    clipping (``clip``, ``clip_by_block_rms``) instead.
+    clipping (``clip``, ``clip_by_block_rms``) instead. The constructor
+    probes the optimizer behaviorally and REJECTS cross-leaf transforms
+    (:func:`common.assert_elementwise_optimizer`).
     """
 
     def __init__(
@@ -70,6 +72,7 @@ class MoEParallelTrainer:
     ):
         self.model = model
         self.optimizer = optimizer
+        common.assert_elementwise_optimizer(optimizer, "MoEParallelTrainer")
         self.topo = topo if topo is not None else _current_topology()
         mesh = self.topo.mesh
         axis = self.topo.worker_axis

@@ -49,13 +49,30 @@ _TP_RULES = (
 )
 
 
-def _spec_for_path(path) -> P:
+def _path_keys(path) -> list:
     keys = [getattr(p, "key", getattr(p, "name", None)) for p in path]
-    keys = [k for k in keys if isinstance(k, str)]
-    for module_part, leaf, spec in _TP_RULES:
+    return [k for k in keys if isinstance(k, str)]
+
+
+def _spec_for_path(path) -> "tuple[P, Optional[int]]":
+    """(spec, index of the matching rule) — (P(), None) when unmatched."""
+    keys = _path_keys(path)
+    for i, (module_part, leaf, spec) in enumerate(_TP_RULES):
         if leaf in keys[-1:] and any(module_part in k for k in keys[:-1]):
-            return spec
-    return P()
+            return spec, i
+    return P(), None
+
+
+def _is_block_dense_kernel(keys: list) -> bool:
+    """A Dense kernel inside a transformer Block — the leaves tensor
+    parallelism exists to shard. One of these matching NO rule means the
+    model drifted from the rule table (renamed/added Dense), and
+    silently replicating it would quietly lose tp — hard-fail instead."""
+    return (
+        keys[-1:] == ["kernel"]
+        and any(k.startswith("Block") for k in keys[:-1])
+        and any("Dense" in k for k in keys[:-1])
+    )
 
 
 class TensorParallelTrainer:
@@ -72,6 +89,12 @@ class TensorParallelTrainer:
     The step function contains NO collectives — they come from the
     sharding annotations alone. Requires ``d_model % tp == 0``,
     ``num_heads % tp == 0`` and ``d_ff % tp == 0``.
+
+    Cross-leaf optimizers (``clip_by_global_norm`` etc.) are SAFE here,
+    unlike in the shard_map MoE trainer: ``optimizer.update`` runs under
+    jit on globally-sharded gradients, so the partitioner inserts the
+    cross-device collectives the global norm needs — every replica sees
+    the same scalar.
     """
 
     def __init__(
@@ -97,6 +120,13 @@ class TensorParallelTrainer:
                 "tensor parallelism uses the dense-attention model "
                 "(seq_axis=None); ring attention shards the sequence, "
                 "not the weights"
+            )
+        if getattr(model, "moe_experts", 0):
+            raise ValueError(
+                "TensorParallelTrainer has no sharding rules for MoE "
+                "expert weights (moe_* leaves would silently stay "
+                "replicated, losing expert parallelism); use "
+                "MoEParallelTrainer for moe_experts > 0"
             )
         tp = int(mesh.shape["tp"])
         d_model = getattr(model, "d_model", tp)
@@ -149,11 +179,45 @@ class TensorParallelTrainer:
         return int(self.topo.mesh.shape["tp"])
 
     def state_sharding(self, state):
-        """NamedSharding pytree for a TrainState under the Megatron rules."""
+        """NamedSharding pytree for a TrainState under the Megatron rules.
+
+        Strict by construction: every Dense kernel inside a Block must
+        match a rule, and every rule must match at least one leaf —
+        renaming or adding a layer raises here instead of silently
+        falling back to replicated (losing tensor parallelism with no
+        error)."""
         mesh = self.topo.mesh
-        return jax.tree_util.tree_map_with_path(
-            lambda path, _: NamedSharding(mesh, _spec_for_path(path)), state
-        )
+        matched: set = set()
+        unmatched: list = []
+
+        def assign(path, _):
+            spec, idx = _spec_for_path(path)
+            if idx is not None:
+                matched.add(idx)
+            else:
+                keys = _path_keys(path)
+                if _is_block_dense_kernel(keys):
+                    unmatched.append("/".join(keys))
+            return NamedSharding(mesh, spec)
+
+        tree = jax.tree_util.tree_map_with_path(assign, state)
+        if unmatched:
+            raise ValueError(
+                "tensor-parallel rules cover Dense_0..Dense_3 inside each "
+                f"Block, but these Dense kernels matched no rule: "
+                f"{sorted(set(unmatched))}. The model's block structure "
+                "drifted from _TP_RULES — update the rule table rather "
+                "than silently replicating these weights."
+            )
+        missing = set(range(len(_TP_RULES))) - matched
+        if missing:
+            raise ValueError(
+                "tensor-parallel rules matched no parameter at all for: "
+                f"{[_TP_RULES[i][:2] for i in sorted(missing)]} — the "
+                "model's layer names drifted from _TP_RULES; fix the "
+                "table or the model."
+            )
+        return tree
 
     def data_sharding(self) -> NamedSharding:
         """(B, T) token batches shard over dp, sequence replicated."""

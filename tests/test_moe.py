@@ -329,6 +329,51 @@ class TestMoETrainer:
         assert wup.sharding.spec[0] == topo.worker_axis
         mpit_tpu.finalize()
 
+    def test_cross_leaf_optimizer_rejected(self):
+        """clip_by_global_norm couples leaves through the global norm —
+        inside shard_map on device-varying expert grads that silently
+        desynchronizes replicated params, so the constructor refuses it.
+        Per-leaf clipping composes fine."""
+        import optax
+
+        from mpit_tpu.models.transformer import TransformerLM
+        from mpit_tpu.parallel import MoEParallelTrainer
+
+        mpit_tpu.finalize()
+        topo = mpit_tpu.init()
+        model = TransformerLM(
+            vocab_size=31, max_len=16, moe_experts=16,
+            moe_axis=topo.worker_axis,
+        )
+        with pytest.raises(ValueError, match="ELEMENTWISE"):
+            MoEParallelTrainer(
+                model,
+                optax.chain(
+                    optax.clip_by_global_norm(1.0), optax.sgd(0.1)
+                ),
+                topo,
+            )
+        # conditionally-coupled transforms are caught too: apply_if_finite
+        # skips the update for ALL leaves when ANY leaf goes non-finite
+        with pytest.raises(ValueError, match="ELEMENTWISE"):
+            MoEParallelTrainer(
+                model, optax.apply_if_finite(optax.sgd(0.1), 5), topo
+            )
+        # and a global-norm threshold well above the old probe magnitude
+        with pytest.raises(ValueError, match="ELEMENTWISE"):
+            MoEParallelTrainer(
+                model,
+                optax.chain(
+                    optax.clip_by_global_norm(5e4), optax.sgd(0.1)
+                ),
+                topo,
+            )
+        # per-leaf clip and adam pass the probe
+        MoEParallelTrainer(
+            model, optax.chain(optax.clip(1.0), optax.adam(1e-3)), topo
+        )
+        mpit_tpu.finalize()
+
     def test_validation(self):
         import optax
 

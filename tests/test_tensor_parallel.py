@@ -103,6 +103,40 @@ class TestTensorParallel:
                                    atol=1e-5)
         mpit_tpu.finalize()
 
+    def test_rule_drift_raises_instead_of_replicating(self):
+        """A Dense kernel the rule table doesn't know (renamed/added
+        layer) and a rule that matches nothing both hard-fail —
+        the failure mode used to be silent replication."""
+        mpit_tpu.finalize()
+        topo = mpit_tpu.init(axis_names=("dp", "tp"), mesh_shape=(2, 4))
+        tr = TensorParallelTrainer(
+            _model(), optax.sgd(0.1), topo, donate_state=False
+        )
+        arr = jnp.zeros((8, 8))
+        with pytest.raises(ValueError, match="matched no rule"):
+            tr.state_sharding(
+                {"params": {"Block_0": {"Dense_9": {"kernel": arr}}}}
+            )
+        # all-LayerNorm tree: every rule goes unmatched
+        with pytest.raises(ValueError, match="matched no parameter"):
+            tr.state_sharding(
+                {"params": {"Block_0": {"LayerNorm_0": {"scale": arr}}}}
+            )
+        mpit_tpu.finalize()
+
+    def test_moe_model_rejected(self):
+        """moe_* leaves match no tp rule; the constructor refuses the
+        model instead of silently replicating every expert."""
+        mpit_tpu.finalize()
+        topo = mpit_tpu.init(axis_names=("dp", "tp"), mesh_shape=(2, 4))
+        moe = TransformerLM(
+            vocab_size=V, num_layers=2, d_model=32, num_heads=8,
+            max_len=T, moe_experts=8,
+        )
+        with pytest.raises(ValueError, match="MoEParallelTrainer"):
+            TensorParallelTrainer(moe, optax.sgd(0.1), topo)
+        mpit_tpu.finalize()
+
     def test_validation(self):
         mpit_tpu.finalize()
         topo = mpit_tpu.init()
