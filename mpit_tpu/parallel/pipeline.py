@@ -13,10 +13,15 @@ The backward pass is NOT hand-written: ``jax.grad`` transposes the whole
 scan-of-ppermute program (the transpose of a ppermute is the reverse
 ppermute), so gradients flow backward through the pipeline automatically.
 
-Everything here is pure jax (no flax): the model is a dict of arrays with
-the block stack as stacked leaves — exactly the layout pipelining wants —
-and the optimizer is a manual SGD+momentum so its state tree mirrors the
-param tree (same shard_map specs apply to both).
+The block itself is the ONE definition from
+:class:`mpit_tpu.models.transformer.Block` (run in f32): the pipeline
+stores its params as stacked leaves — per-layer flax param trees with a
+leading layer dim, exactly the layout pipelining wants — initializes them
+by vmapping ``Block.init`` over layer keys, and applies them by scanning
+``Block.apply``. Only the embedding/position/final-norm/tied-head "rest"
+is plain arrays here, and its norm is flax's ``nn.LayerNorm`` applied
+functionally. The optimizer is a manual SGD+momentum so its state tree
+mirrors the param tree (same shard_map specs apply to both).
 
 Boundary ownership keeps replicated params consistent: the embedding's
 input side contributes only on stage 0, the final norm and the tied
@@ -32,74 +37,152 @@ from __future__ import annotations
 
 from typing import Optional
 
+import flax.linen as nn
 import jax
 import jax.numpy as jnp
-import numpy as np
 from jax import lax
 from jax.sharding import NamedSharding, PartitionSpec as P
 
 from mpit_tpu.comm.topology import topology as _current_topology
 from mpit_tpu.comm.topology import Topology
-from mpit_tpu.ops.ring_attention import dense_attention
+from mpit_tpu.models.transformer import Block
 from mpit_tpu.parallel.common import bound_cpu_dispatch
 
 
-def _layer_norm(x, scale, bias, eps=1e-6):
-    x32 = x.astype(jnp.float32)
-    mu = x32.mean(-1, keepdims=True)
-    var = ((x32 - mu) ** 2).mean(-1, keepdims=True)
-    return ((x32 - mu) * lax.rsqrt(var + eps)).astype(x.dtype) * scale + bias
+def _block_module(d_model: int, num_heads: int, d_ff: int) -> Block:
+    """The shared transformer block, pinned to f32 dense attention."""
+    return Block(
+        d_model=d_model, num_heads=num_heads, d_ff=d_ff,
+        compute_dtype=jnp.float32, seq_axis=None,
+    )
 
 
-def block_fn(p, h, num_heads: int):
-    """One pre-LN transformer block from stacked-leaf params ``p`` (a dict
-    of per-layer arrays WITHOUT the leading layer dim)."""
-    b, t, d = h.shape
-    y = _layer_norm(h, p["ln1_s"], p["ln1_b"])
-    qkv = y @ p["qkv_w"]
-    q, k, v = jnp.split(qkv, 3, axis=-1)
-    split = lambda a: a.reshape(b, t, num_heads, d // num_heads)
-    att = dense_attention(split(q), split(k), split(v), causal=True)
-    h = h + att.reshape(b, t, d) @ p["attn_o"]
-    y = _layer_norm(h, p["ln2_s"], p["ln2_b"])
-    y = jax.nn.gelu(y @ p["mlp_up"] + p["mlp_up_b"])
-    return h + y @ p["mlp_down"] + p["mlp_down_b"]
+def _apply_blocks(block: Block, stacked, h):
+    """Scan ``Block.apply`` over stacked (L, ...) flax param leaves."""
+    return lax.scan(
+        lambda c, p: (block.apply({"params": p}, c), None), h, stacked
+    )[0]
+
+
+def _final_norm(x, scale, bias):
+    """flax LayerNorm applied functionally (no second norm definition)."""
+    return nn.LayerNorm().apply(
+        {"params": {"scale": scale, "bias": bias}}, x
+    )
 
 
 def init_params(
     rng, vocab_size: int, num_layers: int, d_model: int, d_ff: int,
-    max_len: int,
+    max_len: int, num_heads: int = 4,
 ) -> dict:
-    """{"blocks": stacked (L, ...) leaves, "rest": embed/pos/final-norm}."""
-    k = iter(jax.random.split(rng, 8))
-    dist = lambda key, *s: (jax.random.normal(key, s) / np.sqrt(s[-2])
-                            ).astype(jnp.float32)
-    L, D, F = num_layers, d_model, d_ff
-    blocks = {
-        "qkv_w": dist(next(k), L, D, 3 * D),
-        "attn_o": dist(next(k), L, D, D),
-        "mlp_up": dist(next(k), L, D, F),
-        "mlp_up_b": jnp.zeros((L, F)),
-        "mlp_down": dist(next(k), L, F, D),
-        "mlp_down_b": jnp.zeros((L, D)),
-        "ln1_s": jnp.ones((L, D)), "ln1_b": jnp.zeros((L, D)),
-        "ln2_s": jnp.ones((L, D)), "ln2_b": jnp.zeros((L, D)),
-    }
+    """{"blocks": stacked (L, ...) flax Block leaves, "rest":
+    embed/pos/final-norm} — blocks initialized by the shared Block's own
+    initializers, vmapped over per-layer keys."""
+    blk = _block_module(d_model, num_heads, d_ff)
+    k_blocks, k_embed, k_pos = jax.random.split(rng, 3)
+    dummy = jnp.zeros((1, 1, d_model), jnp.float32)
+    blocks = jax.vmap(lambda k: blk.init(k, dummy)["params"])(
+        jax.random.split(k_blocks, num_layers)
+    )
     rest = {
-        "embed": jax.random.normal(next(k), (vocab_size, D)) * 0.02,
-        "pos": jax.random.normal(next(k), (max_len, D)) * 0.02,
-        "lnf_s": jnp.ones((D,)), "lnf_b": jnp.zeros((D,)),
+        "embed": jax.random.normal(k_embed, (vocab_size, d_model)) * 0.02,
+        "pos": jax.random.normal(k_pos, (max_len, d_model)) * 0.02,
+        "lnf_s": jnp.ones((d_model,)), "lnf_b": jnp.zeros((d_model,)),
     }
     return {"blocks": blocks, "rest": rest}
 
 
+def schedule_1f1b(n_micro: int, stages: int) -> dict:
+    """Static 1F1B timetable for ``n_micro`` microbatches over ``stages``.
+
+    Greedy simulation with the 1F1B priority (run a backward whenever one
+    is ready, else the next forward): per (tick, stage) an op code
+    (0 idle / 1 fwd / 2 bwd) and microbatch index, plus arrival tables
+    saying which microbatch's boundary activation (from stage−1) or
+    cotangent (from stage+1) lands at the start of each tick. A unit run
+    at tick ``t`` arrives at its neighbor at ``t+1`` (one ppermute hop).
+
+    Properties (asserted by tests): the span is ``2(M+S−1)`` ticks — the
+    same bubble as GPipe's forward+transposed-backward — and every stage
+    holds at most ``min(S, M)`` microbatches in flight (early stages run
+    one ahead of the textbook ``S−s`` because each boundary hop costs a
+    ppermute tick), which is the schedule's actual win: saved
+    activations stay O(S), not O(M).
+    """
+    M, S = n_micro, stages
+    f_done = [[-1] * M for _ in range(S)]
+    b_done = [[-1] * M for _ in range(S)]
+    nf = [0] * S  # next forward microbatch per stage
+    nb = [0] * S  # next backward microbatch per stage (1F1B runs in order)
+    inflight_max = [0] * S
+    op_rows, mb_rows = [], []
+    t, total_b = 0, 0
+    while total_b < S * M:
+        if t > 4 * (M + S) + 8:
+            raise AssertionError("1F1B schedule failed to converge")
+        row = []
+        for s in range(S):
+            op, mb = 0, 0
+            bi, fi = nb[s], nf[s]
+            b_ready = bi < M and (
+                0 <= f_done[s][bi] < t
+                if s == S - 1
+                else 0 <= b_done[s + 1][bi] < t
+            )
+            f_ready = fi < M and (fi - nb[s]) < S and (
+                s == 0 or 0 <= f_done[s - 1][fi] < t
+            )
+            if b_ready:
+                op, mb = 2, bi
+            elif f_ready:
+                op, mb = 1, fi
+            row.append((op, mb))
+        for s, (op, mb) in enumerate(row):  # commit synchronously
+            if op == 1:
+                f_done[s][mb] = t
+                nf[s] += 1
+                inflight_max[s] = max(inflight_max[s], nf[s] - nb[s])
+            elif op == 2:
+                b_done[s][mb] = t
+                nb[s] += 1
+                total_b += 1
+        op_rows.append([op for op, _ in row])
+        mb_rows.append([mb for _, mb in row])
+        t += 1
+    import numpy as np
+
+    T = t
+    arr_act = -np.ones((T, S), np.int32)
+    arr_ct = -np.ones((T, S), np.int32)
+    for s in range(S):
+        for i in range(M):
+            if s + 1 < S and f_done[s][i] + 1 < T:
+                arr_act[f_done[s][i] + 1, s + 1] = i
+            if s - 1 >= 0 and b_done[s][i] + 1 < T:
+                arr_ct[b_done[s][i] + 1, s - 1] = i
+    return {
+        "op": np.asarray(op_rows, np.int32),
+        "mb": np.asarray(mb_rows, np.int32),
+        "arr_act": arr_act,
+        "arr_ct": arr_ct,
+        "ticks": T,
+        "max_inflight": inflight_max,
+    }
+
+
 def reference_apply(params, x, num_heads: int):
-    """Unpipelined ground truth: the same function, all layers in order."""
+    """Unpipelined ground truth: the same function, all layers in order.
+
+    ``d_model``/``d_ff`` are read off the param shapes, so the signature
+    matches the old pure-jax one.
+    """
+    blocks = params["blocks"]
+    d_model = blocks["Dense_0"]["kernel"].shape[1]
+    d_ff = blocks["Dense_2"]["kernel"].shape[-1]
+    blk = _block_module(d_model, num_heads, d_ff)
     h = params["rest"]["embed"][x] + params["rest"]["pos"][: x.shape[1]]
-    h = lax.scan(
-        lambda c, p: (block_fn(p, c, num_heads), None), h, params["blocks"]
-    )[0]
-    h = _layer_norm(h, params["rest"]["lnf_s"], params["rest"]["lnf_b"])
+    h = _apply_blocks(blk, blocks, h)
+    h = _final_norm(h, params["rest"]["lnf_s"], params["rest"]["lnf_b"])
     return h @ params["rest"]["embed"].T
 
 
@@ -133,6 +216,7 @@ class PipelineParallelTrainer:
         n_micro: int = 4,
         lr: float = 0.1,
         momentum: float = 0.9,
+        schedule: str = "gpipe",
     ):
         self.topo = topo if topo is not None else _current_topology()
         mesh = self.topo.mesh
@@ -159,10 +243,15 @@ class PipelineParallelTrainer:
         self.seq_len = seq_len
         self.n_micro = n_micro
         self.lr, self.momentum = lr, momentum
+        if schedule not in ("gpipe", "1f1b"):
+            raise ValueError(
+                f"schedule={schedule!r} must be 'gpipe' or '1f1b'"
+            )
+        self.schedule = schedule
         dp_axis = mesh.axis_names[0]
 
         spec = {"blocks": P("pp"), "rest": P()}
-        heads = num_heads
+        blk = _block_module(d_model, num_heads, self.d_ff)
         M, S = n_micro, self.pp
 
         def forward(params, x):
@@ -178,9 +267,7 @@ class PipelineParallelTrainer:
             h_mb = h.reshape(M, mb, t, -1)
 
             def stage(blocks, inp):
-                return lax.scan(
-                    lambda c, p: (block_fn(p, c, heads), None), inp, blocks
-                )[0]
+                return _apply_blocks(blk, blocks, inp)
 
             perm = [(i, (i + 1) % S) for i in range(S)]
             zero = jnp.zeros_like(h_mb[0])
@@ -203,7 +290,7 @@ class PipelineParallelTrainer:
             # only the LAST stage's buffer holds the pipeline output; the
             # head runs there alone so its params have one grad owner too
             h_out = outbuf.reshape(b, t, -1)
-            h_out = _layer_norm(h_out, rest["lnf_s"], rest["lnf_b"])
+            h_out = _final_norm(h_out, rest["lnf_s"], rest["lnf_b"])
             logits = h_out @ rest["embed"].T
             return jnp.where(s == S - 1, logits, 0.0)
 
@@ -218,9 +305,171 @@ class PipelineParallelTrainer:
             ce = -jnp.take_along_axis(logp, y[..., None], axis=-1).mean()
             return jnp.where(s == S - 1, ce, 0.0)
 
+        K = num_layers // S  # layers per stage (the local block shard)
+
+        def loss_and_grads_1f1b(params, x, y):
+            """1F1B: forwards and backwards explicitly interleaved on one
+            tick timeline (schedule_1f1b), instead of a forward scan that
+            autodiff transposes afterwards (GPipe).
+
+            Same span — 2(M+S−1) ticks vs GPipe's (M+S−1) forward plus an
+            equally long transposed backward — but the saved state is an
+            S-slot ring of per-layer block INPUTS (backward recomputes
+            each block before transposing it, remat-style), so peak
+            activation memory is O(S·K) block inputs instead of autodiff
+            GPipe's O((M+S−1)·K) per-tick internals.
+            """
+            tabs = schedule_1f1b(M, S)
+            t_op = jnp.asarray(tabs["op"])
+            t_mb = jnp.asarray(tabs["mb"])
+            t_aa = jnp.asarray(tabs["arr_act"])
+            t_ac = jnp.asarray(tabs["arr_ct"])
+            s = lax.axis_index("pp")
+            rest, blocks = params["rest"], params["blocks"]
+            b, t_len = x.shape
+            mb = b // M
+            h = rest["embed"][x] + rest["pos"][:t_len]
+            h = jnp.where(s == 0, h, 0.0)
+            h_mb = h.reshape(M, mb, t_len, d_model)
+            x_mb = x.reshape(M, mb, t_len)
+            y_mb = y.reshape(M, mb, t_len)
+            perm_fwd = [(i, (i + 1) % S) for i in range(S)]
+            perm_bwd = [((i + 1) % S, i) for i in range(S)]
+
+            def head_loss(rest_in, h_out, y_i):
+                """Per-microbatch tail: final norm, tied head, CE — the
+                full-batch mean is the mean of per-microbatch means."""
+                h2 = _final_norm(h_out, rest_in["lnf_s"], rest_in["lnf_b"])
+                logits = (h2 @ rest_in["embed"].T).astype(jnp.float32)
+                logp = jax.nn.log_softmax(logits, axis=-1)
+                ce = -jnp.take_along_axis(logp, y_i[..., None], -1).mean()
+                return ce / M
+
+            R = min(S, M)  # ring slots: the in-flight bound, never M
+
+            def store(buf, idx, val):
+                """Predicated ring write: buf[idx % R] = val when
+                idx >= 0. Slot reuse is safe by the in-flight cap: the
+                producer of item i+R cannot have run before item i's
+                consumer finished (schedule_1f1b's capacity rule)."""
+                upd = lax.dynamic_update_index_in_dim(
+                    buf, val, jnp.remainder(jnp.maximum(idx, 0), R), 0
+                )
+                return jnp.where(idx >= 0, upd, buf)
+
+            def fetch(buf, idx):
+                return lax.dynamic_index_in_dim(
+                    buf, jnp.remainder(idx, R), 0, False
+                )
+
+            zero_act = jnp.zeros((mb, t_len, d_model), jnp.float32)
+            carry0 = {
+                "pf": zero_act,  # last fwd output (sent down-pipe)
+                "pb": zero_act,  # last bwd input-cotangent (sent up-pipe)
+                # boundary rings — O(S) like everything else in the carry
+                "act": jnp.zeros((R, mb, t_len, d_model), jnp.float32),
+                "ct": jnp.zeros((R, mb, t_len, d_model), jnp.float32),
+                # per-layer block inputs + stage output, R in-flight slots
+                "ring": jnp.zeros(
+                    (R, K + 1, mb, t_len, d_model), jnp.float32
+                ),
+                "gb": jax.tree.map(jnp.zeros_like, blocks),
+                "gr": jax.tree.map(jnp.zeros_like, rest),
+                "loss": jnp.float32(0.0),
+            }
+
+            def tick(c, tk):
+                recv_a = lax.ppermute(c["pf"], "pp", perm_fwd)
+                recv_c = lax.ppermute(c["pb"], "pp", perm_bwd)
+                c = {
+                    **c,
+                    "act": store(c["act"], t_aa[tk, s], recv_a),
+                    "ct": store(c["ct"], t_ac[tk, s], recv_c),
+                }
+                i = t_mb[tk, s]
+
+                def fwd(c):
+                    inp = jnp.where(
+                        s == 0,
+                        lax.dynamic_index_in_dim(h_mb, i, 0, False),
+                        fetch(c["act"], i),
+                    )
+
+                    def f(cc, p):
+                        return blk.apply({"params": p}, cc), cc
+
+                    out, saved = lax.scan(f, inp, blocks)
+                    entry = jnp.concatenate([saved, out[None]], 0)
+                    ring = lax.dynamic_update_index_in_dim(
+                        c["ring"], entry, jnp.remainder(i, R), 0
+                    )
+                    return {**c, "ring": ring, "pf": out}
+
+                def bwd(c):
+                    entry = lax.dynamic_index_in_dim(
+                        c["ring"], jnp.remainder(i, R), 0, False
+                    )
+                    out = entry[K]
+                    y_i = lax.dynamic_index_in_dim(y_mb, i, 0, False)
+                    loss_i, head_vjp = jax.vjp(
+                        lambda r, o: head_loss(r, o, y_i), rest, out
+                    )
+                    g_head, ct_last = head_vjp(jnp.float32(1.0))
+                    last = s == S - 1
+                    ct_out = jnp.where(last, ct_last, fetch(c["ct"], i))
+
+                    def bstep(cc, xs):
+                        p_j, in_j = xs
+                        _, vjp = jax.vjp(
+                            lambda p, xx: blk.apply({"params": p}, xx),
+                            p_j, in_j,
+                        )
+                        gp, gx = vjp(cc)
+                        return gx, gp
+
+                    # recompute-and-transpose each block, last to first
+                    ct_in, g_blocks = lax.scan(
+                        bstep, ct_out, (blocks, entry[:K]), reverse=True
+                    )
+                    # stage 0 closes the loop through its embedding +
+                    # position lookup immediately (per microbatch), so
+                    # no O(M) cotangent buffer survives the scan
+                    x_i = lax.dynamic_index_in_dim(x_mb, i, 0, False)
+                    _, evjp = jax.vjp(
+                        lambda r: r["embed"][x_i] + r["pos"][:t_len], rest
+                    )
+                    (g_emb,) = evjp(jnp.where(s == 0, ct_in, 0.0))
+                    return {
+                        **c,
+                        "gb": jax.tree.map(
+                            lambda a, g: a + g, c["gb"], g_blocks
+                        ),
+                        "gr": jax.tree.map(
+                            lambda a, gh, ge: a
+                            + jnp.where(last, gh, 0.0)
+                            + ge,
+                            c["gr"], g_head, g_emb,
+                        ),
+                        "pb": ct_in,
+                        "loss": c["loss"] + jnp.where(last, loss_i, 0.0),
+                    }
+
+                return lax.switch(
+                    t_op[tk, s], [lambda c: c, fwd, bwd], c
+                ), None
+
+            c = lax.scan(tick, carry0, jnp.arange(tabs["ticks"]))[0]
+            return c["loss"], {"blocks": c["gb"], "rest": c["gr"]}
+
+        if schedule == "1f1b":
+            loss_and_grads = loss_and_grads_1f1b
+        else:
+            def loss_and_grads(params, x, y):
+                return jax.value_and_grad(loss_fn)(params, x, y)
+
         def train_step(state, x, y):
             params, mom = state["params"], state["momentum"]
-            loss, grads = jax.value_and_grad(loss_fn)(params, x, y)
+            loss, grads = loss_and_grads(params, x, y)
             # the head stage owns the loss; psum makes it world-visible
             loss = lax.psum(loss, "pp")
             # single-owner replicated grads -> identical everywhere
@@ -250,10 +499,23 @@ class PipelineParallelTrainer:
             )
         )
 
+    @property
+    def ticks(self) -> int:
+        """Pipeline-timeline span of one step, in schedule ticks.
+
+        GPipe: the forward scan is ``M+S−1`` ticks and autodiff appends a
+        transposed backward of the same length. 1F1B: one unified
+        timeline of ``2(M+S−1)`` ticks carrying both directions — equal
+        bubble, O(S) instead of O(M) saved microbatch activations.
+        """
+        if self.schedule == "1f1b":
+            return int(schedule_1f1b(self.n_micro, self.pp)["ticks"])
+        return self.n_micro + self.pp - 1
+
     def init_state(self, rng) -> dict:
         params = init_params(
             rng, self.vocab_size, self.num_layers, self.d_model,
-            self.d_ff, self.seq_len,
+            self.d_ff, self.seq_len, num_heads=self.num_heads,
         )
         state = {
             "params": params,

@@ -57,8 +57,10 @@ def _path_keys(path) -> list:
 def _spec_for_path(path) -> "tuple[P, Optional[int]]":
     """(spec, index of the matching rule) — (P(), None) when unmatched."""
     keys = _path_keys(path)
-    for i, (module_part, leaf, spec) in enumerate(_TP_RULES):
-        if leaf in keys[-1:] and any(module_part in k for k in keys[:-1]):
+    for i, (module_name, leaf, spec) in enumerate(_TP_RULES):
+        # exact segment equality: substring matching would let Dense_10
+        # silently take Dense_1's row sharding
+        if leaf in keys[-1:] and any(k == module_name for k in keys[:-1]):
             return spec, i
     return P(), None
 

@@ -34,12 +34,13 @@ def _ref_loss(params, x, y):
     )
 
 
-def _run(mesh_shape, n_micro, steps=3):
+def _run(mesh_shape, n_micro, steps=3, schedule="gpipe"):
     mpit_tpu.finalize()
     topo = mpit_tpu.init(axis_names=("dp", "pp"), mesh_shape=mesh_shape)
     tr = PipelineParallelTrainer(
         vocab_size=V, num_layers=L, d_model=D, num_heads=H, seq_len=T,
         topo=topo, n_micro=n_micro, lr=0.1, momentum=0.9,
+        schedule=schedule,
     )
     state = tr.init_state(jax.random.key(0))
     x, y = _data()
@@ -55,7 +56,8 @@ def _run(mesh_shape, n_micro, steps=3):
 class TestPipelineParallel:
     def test_first_loss_matches_unpipelined_reference(self):
         losses, _ = _run((1, 8), n_micro=4, steps=1)
-        params = init_params(jax.random.key(0), V, L, D, 4 * D, T)
+        params = init_params(jax.random.key(0), V, L, D, 4 * D, T,
+                             num_heads=H)
         x, y = _data()
         assert losses[0] == pytest.approx(_ref_loss(params, x, y), rel=1e-5)
 
@@ -66,6 +68,38 @@ class TestPipelineParallel:
             np.testing.assert_allclose(
                 losses, ref_losses, rtol=2e-5, atol=2e-6,
                 err_msg=f"mesh {shape} n_micro={m}",
+            )
+            jax.tree.map(
+                lambda a, b: np.testing.assert_allclose(
+                    a, b, rtol=2e-4, atol=2e-4
+                ),
+                params, ref_params,
+            )
+
+    def test_1f1b_schedule_properties(self):
+        """Span 2(M+S−1); in-flight bounded by min(S, M), not M —
+        the memory property that motivates 1F1B."""
+        from mpit_tpu.parallel.pipeline import schedule_1f1b
+
+        for m, s in ((4, 4), (8, 4), (2, 8), (8, 8), (1, 4)):
+            tabs = schedule_1f1b(m, s)
+            assert tabs["ticks"] == 2 * (m + s - 1), (m, s)
+            assert max(tabs["max_inflight"]) <= min(s, m), (m, s)
+            # every stage runs exactly m forwards and m backwards
+            op = tabs["op"]
+            assert (op == 1).sum(0).tolist() == [m] * s
+            assert (op == 2).sum(0).tolist() == [m] * s
+
+    def test_1f1b_matches_gpipe_trajectory(self):
+        """The schedule is pure bookkeeping: 1F1B must produce the same
+        losses and params as GPipe (and hence the unpipelined
+        reference) on every factorization."""
+        ref_losses, ref_params = _run((1, 8), n_micro=4)
+        for shape, m in (((1, 8), 4), ((2, 4), 4), ((4, 2), 2)):
+            losses, params = _run(shape, n_micro=m, schedule="1f1b")
+            np.testing.assert_allclose(
+                losses, ref_losses, rtol=2e-5, atol=2e-6,
+                err_msg=f"1f1b mesh {shape} n_micro={m}",
             )
             jax.tree.map(
                 lambda a, b: np.testing.assert_allclose(
