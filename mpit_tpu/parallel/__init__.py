@@ -23,3 +23,4 @@ from mpit_tpu.parallel.seq import SeqParallelTrainer  # noqa: F401
 from mpit_tpu.parallel.tensor import TensorParallelTrainer  # noqa: F401
 from mpit_tpu.parallel.pipeline import PipelineParallelTrainer  # noqa: F401
 from mpit_tpu.parallel.moe import MoEParallelTrainer  # noqa: F401
+from mpit_tpu.parallel.composed import ComposedParallelTrainer  # noqa: F401

@@ -1,0 +1,224 @@
+"""Composed parallelism: one 3-D ``(dp, tp, sp)`` mesh, one step.
+
+The parallelism axes stop being silos here: a single jit-compiled train
+step runs data parallelism (batch over ``dp``), Megatron tensor
+parallelism (the GSPMD shardings of ``parallel/tensor.py`` over ``tp``),
+and exact ring-attention sequence parallelism (``ops/ring_attention.py``
+over ``sp``) on the SAME :class:`~mpit_tpu.models.transformer.TransformerLM`.
+
+Design — partial-manual shard_map (the jax 0.9 ``axis_names`` mode):
+
+- the loss/grad region is manual over ``sp`` ONLY: the model runs with
+  ``seq_axis="sp"``, so its attention rotates K/V blocks around the sp
+  ring with ``lax.ppermute`` and positions are computed from the ring
+  rank — exactly the 2-D seq trainer's inner function;
+- ``dp`` and ``tp`` stay AUTO inside that same region: the partitioner
+  sees batch sharded over dp and weights sharded per the strict Megatron
+  rules (:func:`~mpit_tpu.parallel.tensor.tp_state_specs`) and inserts
+  the dp batch-mean and tp head/FFN collectives itself — no hand-written
+  dp/tp communication anywhere in this file;
+- gradients/loss are ``pmean``-ed over ``sp`` manually (the grad-locally
+  -then-reduce pattern every shard_map trainer here uses), and the
+  optimizer update runs OUTSIDE the manual region under plain GSPMD jit,
+  so cross-leaf transforms (global-norm clipping) stay safe exactly as
+  in the 2-D tp trainer.
+
+The math is mesh-factorization-invariant: any (dp, tp, sp) split of the
+same device count produces the same losses and updated params on the
+same global batch (tests/test_composed.py pins this against the 2-D
+trainers' trajectories too).
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Optional
+
+import jax
+import jax.numpy as jnp
+import optax
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from mpit_tpu.comm.topology import topology as _current_topology
+from mpit_tpu.comm.topology import Topology
+from mpit_tpu.parallel import common
+from mpit_tpu.parallel.tensor import check_tp_divisibility, tp_state_specs
+
+
+class ComposedParallelTrainer:
+    """dp × tp × sp training for :class:`TransformerLM`.
+
+    Usage::
+
+        topo = mpit_tpu.init(
+            axis_names=("dp", "tp", "sp"), mesh_shape=(2, 2, 2))
+        model = TransformerLM(vocab_size=V, seq_axis="sp")
+        trainer = ComposedParallelTrainer(model, optax.adam(3e-4), topo)
+        state = trainer.init_state(jax.random.key(0), x[:2, :T_local])
+        state, metrics = trainer.step(state, x_global, y_global)
+
+    Requires mesh axes named exactly ``("dp", "tp", "sp")``, a model with
+    ``seq_axis="sp"``, global batch divisible by dp, sequence length
+    divisible by sp, and the tp divisibility rules of the 2-D trainer.
+    """
+
+    def __init__(
+        self,
+        model,
+        optimizer: optax.GradientTransformation,
+        topo: Optional[Topology] = None,
+        loss_fn: Optional[Callable] = None,
+        donate_state: bool = True,
+    ):
+        self.model = model
+        self.optimizer = optimizer
+        self.topo = topo if topo is not None else _current_topology()
+        mesh = self.topo.mesh
+        if tuple(mesh.axis_names) != ("dp", "tp", "sp"):
+            raise ValueError(
+                "ComposedParallelTrainer needs a mesh with axes "
+                "('dp', 'tp', 'sp'), e.g. mpit_tpu.init(axis_names="
+                "('dp','tp','sp'), mesh_shape=(D, T, S)); got "
+                f"{mesh.axis_names}"
+            )
+        if getattr(model, "seq_axis", None) != "sp":
+            raise ValueError(
+                "the composed trainer shards the sequence: construct the "
+                "model with seq_axis='sp' "
+                f"(got {getattr(model, 'seq_axis', None)!r})"
+            )
+        if getattr(model, "moe_experts", 0):
+            raise ValueError(
+                "MoE models are not composed here; use MoEParallelTrainer"
+            )
+        check_tp_divisibility(model, int(mesh.shape["tp"]))
+        self.loss_fn = (
+            loss_fn
+            if loss_fn is not None
+            else common.default_loss_fn(model.apply)
+        )
+
+        # manual over sp only: in_specs name sp placements; dp/tp ride
+        # the arguments' own (auto) shardings through the region
+        grads_fn = jax.shard_map(
+            self._local_loss_grads,
+            mesh=mesh,
+            in_specs=(P(), P(None, "sp"), P(None, "sp")),
+            out_specs=(P(), P()),
+            axis_names=frozenset({"sp"}),
+            check_vma=False,
+        )
+
+        def train_step(state: common.TrainState, x, y):
+            loss, grads = grads_fn(state.params, x, y)
+            updates, opt_state = self.optimizer.update(
+                grads, state.opt_state, state.params
+            )
+            params = optax.apply_updates(state.params, updates)
+            return (
+                common.TrainState(
+                    params=params, opt_state=opt_state, step=state.step + 1
+                ),
+                {"loss": loss},
+            )
+
+        self._step = jax.jit(
+            train_step, donate_argnums=(0,) if donate_state else ()
+        )
+
+        def eval_step(params, x, y):
+            logits = self.model.apply({"params": params}, x)
+            correct = jnp.sum(jnp.argmax(logits, -1) == y)
+            loss_sum = optax.softmax_cross_entropy_with_integer_labels(
+                logits, y
+            ).sum()
+            return (
+                jax.lax.psum(correct, "sp"),
+                jax.lax.psum(loss_sum, "sp"),
+            )
+
+        self._eval = jax.jit(
+            jax.shard_map(
+                eval_step,
+                mesh=mesh,
+                in_specs=(P(), P(None, "sp"), P(None, "sp")),
+                out_specs=(P(), P()),
+                axis_names=frozenset({"sp"}),
+                check_vma=False,
+            )
+        )
+
+    def _local_loss_grads(self, params, x, y):
+        """Inside the manual-sp region: grad the LOCAL sequence-shard
+        loss, reduce over sp afterwards (differentiating through a psum
+        scales cotangents by the axis size — the repo-wide pattern)."""
+        loss, grads = jax.value_and_grad(self.loss_fn)(params, x, y)
+        return (
+            jax.lax.pmean(loss, "sp"),
+            jax.lax.pmean(grads, "sp"),
+        )
+
+    @property
+    def dp_size(self) -> int:
+        return int(self.topo.mesh.shape["dp"])
+
+    @property
+    def tp_size(self) -> int:
+        return int(self.topo.mesh.shape["tp"])
+
+    @property
+    def sp_size(self) -> int:
+        return int(self.topo.mesh.shape["sp"])
+
+    def state_sharding(self, state):
+        """Megatron tp shardings (strict), replicated over dp and sp."""
+        mesh = self.topo.mesh
+        return jax.tree.map(
+            lambda s: NamedSharding(mesh, s), tp_state_specs(state),
+            is_leaf=lambda v: isinstance(v, P),
+        )
+
+    def data_sharding(self) -> NamedSharding:
+        """(B, T) token batches: batch over dp, sequence over sp."""
+        return NamedSharding(self.topo.mesh, P("dp", "sp"))
+
+    def _check(self, x):
+        b, t = x.shape[:2]
+        if b % self.dp_size or t % self.sp_size:
+            raise ValueError(
+                f"global batch {b}x{t} not divisible by mesh "
+                f"(dp={self.dp_size}, sp={self.sp_size})"
+            )
+
+    def init_state(self, rng, sample_x) -> common.TrainState:
+        """``sample_x``: a LOCAL-shaped (b, T/sp) token block. Init runs
+        on the dense clone (seq_axis=None — shapes are identical), then
+        every leaf commits to its tp sharding once."""
+        dense = self.model.clone(seq_axis=None)
+        variables = dense.init(rng, jnp.asarray(sample_x))
+        state = common.TrainState.create(variables["params"], self.optimizer)
+        return jax.device_put(state, self.state_sharding(state))
+
+    def step(self, state, x_global, y_global):
+        """One composed step on a global (B, T) batch."""
+        self._check(x_global)
+        sharding = self.data_sharding()
+        # device_put straight from host to the sharded layout (asarray
+        # first would commit to one device, then reshard device-to-device)
+        x = jax.device_put(x_global, sharding)
+        y = jax.device_put(y_global, sharding)
+        state, metrics = self._step(state, x, y)
+        common.bound_cpu_dispatch(self.topo, metrics)
+        return state, metrics
+
+    def evaluate(self, state, x, y, batch: int = 512):
+        """Token-level accuracy and mean loss over a (N, T) eval set."""
+        if x.shape[1] % self.sp_size:
+            raise ValueError(
+                f"sequence length {x.shape[1]} not divisible by "
+                f"sp={self.sp_size}"
+            )
+        correct, loss_sum, n = common.batched_count_eval(
+            self._eval, state.params, x, y, batch, self.dp_size
+        )
+        tokens = n * x.shape[1]
+        return correct / tokens, loss_sum / tokens

@@ -328,9 +328,8 @@ class PipelineParallelTrainer:
             rest, blocks = params["rest"], params["blocks"]
             b, t_len = x.shape
             mb = b // M
-            h = rest["embed"][x] + rest["pos"][:t_len]
-            h = jnp.where(s == 0, h, 0.0)
-            h_mb = h.reshape(M, mb, t_len, d_model)
+            # tokens stay int32 (M, mb, t); each fwd/bwd unit embeds its
+            # own microbatch, so no O(M) f32 activation buffer exists
             x_mb = x.reshape(M, mb, t_len)
             y_mb = y.reshape(M, mb, t_len)
             perm_fwd = [(i, (i + 1) % S) for i in range(S)]
@@ -389,11 +388,9 @@ class PipelineParallelTrainer:
                 i = t_mb[tk, s]
 
                 def fwd(c):
-                    inp = jnp.where(
-                        s == 0,
-                        lax.dynamic_index_in_dim(h_mb, i, 0, False),
-                        fetch(c["act"], i),
-                    )
+                    x_i = lax.dynamic_index_in_dim(x_mb, i, 0, False)
+                    h_i = rest["embed"][x_i] + rest["pos"][:t_len]
+                    inp = jnp.where(s == 0, h_i, fetch(c["act"], i))
 
                     def f(cc, p):
                         return blk.apply({"params": p}, cc), cc
@@ -411,12 +408,28 @@ class PipelineParallelTrainer:
                     )
                     out = entry[K]
                     y_i = lax.dynamic_index_in_dim(y_mb, i, 0, False)
-                    loss_i, head_vjp = jax.vjp(
-                        lambda r, o: head_loss(r, o, y_i), rest, out
-                    )
-                    g_head, ct_last = head_vjp(jnp.float32(1.0))
                     last = s == S - 1
-                    ct_out = jnp.where(last, ct_last, fetch(c["ct"], i))
+
+                    # the head (final norm + tied vocab matmul + CE) and
+                    # its vjp run ONLY on the last stage — lax.cond is
+                    # legal here (no collectives inside the branches)
+                    def with_head(_):
+                        loss_i, head_vjp = jax.vjp(
+                            lambda r, o: head_loss(r, o, y_i), rest, out
+                        )
+                        g_head, ct_last = head_vjp(jnp.float32(1.0))
+                        return loss_i, g_head, ct_last
+
+                    def without_head(_):
+                        return (
+                            jnp.float32(0.0),
+                            jax.tree.map(jnp.zeros_like, rest),
+                            fetch(c["ct"], i),
+                        )
+
+                    loss_i, g_head, ct_out = lax.cond(
+                        last, with_head, without_head, None
+                    )
 
                     def bstep(cc, xs):
                         p_j, in_j = xs
@@ -445,13 +458,11 @@ class PipelineParallelTrainer:
                             lambda a, g: a + g, c["gb"], g_blocks
                         ),
                         "gr": jax.tree.map(
-                            lambda a, gh, ge: a
-                            + jnp.where(last, gh, 0.0)
-                            + ge,
+                            lambda a, gh, ge: a + gh + ge,
                             c["gr"], g_head, g_emb,
                         ),
                         "pb": ct_in,
-                        "loss": c["loss"] + jnp.where(last, loss_i, 0.0),
+                        "loss": c["loss"] + loss_i,
                     }
 
                 return lax.switch(

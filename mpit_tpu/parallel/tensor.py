@@ -77,6 +77,60 @@ def _is_block_dense_kernel(keys: list) -> bool:
     )
 
 
+def tp_state_specs(state):
+    """PartitionSpec pytree for a TrainState under the Megatron rules.
+
+    Strict by construction: every Dense kernel inside a Block must match
+    a rule, and every rule must match at least one leaf — renaming or
+    adding a layer raises here instead of silently falling back to
+    replicated (losing tensor parallelism with no error). Shared by the
+    2-D tp trainer and the composed dp×tp×sp trainer.
+    """
+    matched: set = set()
+    unmatched: list = []
+
+    def assign(path, _):
+        spec, idx = _spec_for_path(path)
+        if idx is not None:
+            matched.add(idx)
+        else:
+            keys = _path_keys(path)
+            if _is_block_dense_kernel(keys):
+                unmatched.append("/".join(keys))
+        return spec
+
+    tree = jax.tree_util.tree_map_with_path(assign, state)
+    if unmatched:
+        raise ValueError(
+            "tensor-parallel rules cover Dense_0..Dense_3 inside each "
+            f"Block, but these Dense kernels matched no rule: "
+            f"{sorted(set(unmatched))}. The model's block structure "
+            "drifted from _TP_RULES — update the rule table rather "
+            "than silently replicating these weights."
+        )
+    missing = set(range(len(_TP_RULES))) - matched
+    if missing:
+        raise ValueError(
+            "tensor-parallel rules matched no parameter at all for: "
+            f"{[_TP_RULES[i][:2] for i in sorted(missing)]} — the "
+            "model's layer names drifted from _TP_RULES; fix the "
+            "table or the model."
+        )
+    return tree
+
+
+def check_tp_divisibility(model, tp: int) -> None:
+    """d_model / num_heads / d_ff must all split across the tp axis."""
+    d_model = getattr(model, "d_model", tp)
+    for field, need in (
+        ("d_model", d_model),
+        ("num_heads", getattr(model, "num_heads", tp)),
+        ("d_ff", getattr(model, "d_ff", 0) or 4 * d_model),
+    ):
+        if need % tp:
+            raise ValueError(f"{field}={need} not divisible by tp={tp}")
+
+
 class TensorParallelTrainer:
     """dp × tp training for :class:`TransformerLM` (dense-attention mode).
 
@@ -130,15 +184,7 @@ class TensorParallelTrainer:
                 "replicated, losing expert parallelism); use "
                 "MoEParallelTrainer for moe_experts > 0"
             )
-        tp = int(mesh.shape["tp"])
-        d_model = getattr(model, "d_model", tp)
-        for field, need in (
-            ("d_model", d_model),
-            ("num_heads", getattr(model, "num_heads", tp)),
-            ("d_ff", getattr(model, "d_ff", 0) or 4 * d_model),
-        ):
-            if need % tp:
-                raise ValueError(f"{field}={need} not divisible by tp={tp}")
+        check_tp_divisibility(model, int(mesh.shape["tp"]))
         self.batch_axis = mesh.axis_names[0]
         self.loss_fn = (
             loss_fn
@@ -181,45 +227,13 @@ class TensorParallelTrainer:
         return int(self.topo.mesh.shape["tp"])
 
     def state_sharding(self, state):
-        """NamedSharding pytree for a TrainState under the Megatron rules.
-
-        Strict by construction: every Dense kernel inside a Block must
-        match a rule, and every rule must match at least one leaf —
-        renaming or adding a layer raises here instead of silently
-        falling back to replicated (losing tensor parallelism with no
-        error)."""
+        """NamedSharding pytree for a TrainState under the Megatron rules
+        (strict — see :func:`tp_state_specs`)."""
         mesh = self.topo.mesh
-        matched: set = set()
-        unmatched: list = []
-
-        def assign(path, _):
-            spec, idx = _spec_for_path(path)
-            if idx is not None:
-                matched.add(idx)
-            else:
-                keys = _path_keys(path)
-                if _is_block_dense_kernel(keys):
-                    unmatched.append("/".join(keys))
-            return NamedSharding(mesh, spec)
-
-        tree = jax.tree_util.tree_map_with_path(assign, state)
-        if unmatched:
-            raise ValueError(
-                "tensor-parallel rules cover Dense_0..Dense_3 inside each "
-                f"Block, but these Dense kernels matched no rule: "
-                f"{sorted(set(unmatched))}. The model's block structure "
-                "drifted from _TP_RULES — update the rule table rather "
-                "than silently replicating these weights."
-            )
-        missing = set(range(len(_TP_RULES))) - matched
-        if missing:
-            raise ValueError(
-                "tensor-parallel rules matched no parameter at all for: "
-                f"{[_TP_RULES[i][:2] for i in sorted(missing)]} — the "
-                "model's layer names drifted from _TP_RULES; fix the "
-                "table or the model."
-            )
-        return tree
+        return jax.tree.map(
+            lambda s: NamedSharding(mesh, s), tp_state_specs(state),
+            is_leaf=lambda v: isinstance(v, P),
+        )
 
     def data_sharding(self) -> NamedSharding:
         """(B, T) token batches shard over dp, sequence replicated."""
