@@ -339,9 +339,10 @@ def bench_ps_literal(
 
     Unlike the collective presets this measures the HOST-ASYNC path: the
     wall clock covers the whole concurrent run (client threads, tagged
-    messages, server dispatch), and every client's per-step loss is
-    host-fetched by the trainer, so the timing cannot be a dispatch-rate
-    artifact. A short untimed run first warms the shared jitted local step
+    messages, server dispatch), and client losses are host-fetched in one
+    batched transfer at every τ exchange (the exchange itself proves
+    completion; fetching EVERY step timed the device round-trip instead
+    of the system). A short untimed run first warms the shared jitted local step
     (one compiled function for all clients), so the timed leg measures
     steady state like the other presets; smoke mode shrinks the per-client
     batch too (XLA-CPU conv compile time explodes with batch size)."""

@@ -100,6 +100,47 @@ def _read_cifar10_bin(paths: list[str]) -> tuple[np.ndarray, np.ndarray]:
     return np.concatenate(xs), np.concatenate(ys)
 
 
+def has_real_dataset(name: str) -> bool:
+    """True iff the matching loader would read REAL files (not the
+    synthetic fallback). The conditions here restate each loader's own
+    file checks exactly — keep them in lockstep when editing a loader
+    (scripts/acceptance.py gates real-data acceptance runs on this).
+    """
+    if name not in ("mnist", "cifar10", "ptb", "imagenet"):
+        raise ValueError(f"unknown dataset {name!r}")
+    d = _data_dir()
+    if not d:
+        return False
+    if name == "mnist":
+        return all(
+            _find(d, n)
+            for n in (
+                "train-images-idx3-ubyte", "train-labels-idx1-ubyte",
+                "t10k-images-idx3-ubyte", "t10k-labels-idx1-ubyte",
+            )
+        )
+    if name == "cifar10":
+        for sub in ("", "cifar-10-batches-bin"):
+            base = os.path.join(d, sub) if sub else d
+            if (
+                all(
+                    _find(base, f"data_batch_{i}.bin")
+                    for i in range(1, 6)
+                )
+                and _find(base, "test_batch.bin")
+            ):
+                return True
+        return os.path.exists(os.path.join(d, "cifar10.npz"))
+    if name == "ptb":
+        return os.path.exists(
+            os.path.join(d, "ptb.train.txt")
+        ) and os.path.exists(os.path.join(d, "ptb.valid.txt"))
+    train = os.path.join(d, "imagenet", "train")
+    return os.path.isdir(train) and any(
+        os.path.isdir(os.path.join(train, e)) for e in os.listdir(train)
+    )
+
+
 def load_cifar10(synthetic_train: int = 8192, synthetic_test: int = 2048):
     """CIFAR-10 as (x_train, y_train, x_test, y_test), images (N,32,32,3)
     in [0,1]. Prefers the standard binary batches (``data_batch_1..5.bin``

@@ -388,9 +388,15 @@ class PipelineParallelTrainer:
                 i = t_mb[tk, s]
 
                 def fwd(c):
-                    x_i = lax.dynamic_index_in_dim(x_mb, i, 0, False)
-                    h_i = rest["embed"][x_i] + rest["pos"][:t_len]
-                    inp = jnp.where(s == 0, h_i, fetch(c["act"], i))
+                    # only stage 0 embeds; lax.cond skips the gather on
+                    # the other stages (jnp.where would run it anyway)
+                    def embed_in(_):
+                        x_i = lax.dynamic_index_in_dim(x_mb, i, 0, False)
+                        return rest["embed"][x_i] + rest["pos"][:t_len]
+
+                    inp = lax.cond(
+                        s == 0, embed_in, lambda _: fetch(c["act"], i), None
+                    )
 
                     def f(cc, p):
                         return blk.apply({"params": p}, cc), cc

@@ -61,7 +61,14 @@ def client_train_loop(
     """The pclient side of SURVEY.md §3(b): τ jit-compiled local steps, then
     push/pull per ``algo`` ("easgd" or "downpour"). Returns per-step losses.
     Does NOT send stop — the caller owns teardown (it may want a final
-    ``client.fetch()`` for evaluation first)."""
+    ``client.fetch()`` for evaluation first).
+
+    Loss scalars stay ON DEVICE between exchanges and are host-fetched in
+    one batched transfer at each τ boundary (where the param flatten
+    already forces completion) — a per-step ``float(loss)`` would stall
+    the XLA dispatch pipeline every step and, measured over a remote
+    device tunnel, time the round-trip rather than the training.
+    """
     import jax.numpy as jnp
 
     from mpit_tpu.utils.params import flatten_params
@@ -71,11 +78,19 @@ def client_train_loop(
     opt_state = optimizer.init(params)
     last_pull = np.asarray(flatten_params(params)[0])
     losses: list[float] = []
+    pending: list = []
+
+    def flush():
+        if pending:
+            losses.extend(np.asarray(jnp.stack(pending)).tolist())
+            pending.clear()
+
     for step in range(steps):
         idx = rng.integers(0, len(x), batch_size)
         params, opt_state, loss = local_step(params, opt_state, x[idx], y[idx])
-        losses.append(float(loss))
+        pending.append(loss)
         if (step + 1) % tau == 0:
+            flush()
             flat = np.asarray(flatten_params(params)[0])
             if algo == "easgd":
                 # fetch BEFORE push so the client's elastic move uses the
@@ -92,4 +107,5 @@ def client_train_loop(
                 flat = client.fetch()
                 last_pull = flat
             params = unflatten_params(spec, jnp.asarray(flat))
+    flush()  # steps % tau remainder
     return losses

@@ -276,3 +276,56 @@ class TestInputDtype:
 
         with pytest.raises(ValueError, match="unknown input dtype"):
             cast_input_dtype(np.zeros(2, np.float32), "fp8")
+
+
+class TestHasRealDataset:
+    """has_real_dataset must agree with the loaders' own file checks —
+    a partial file set (which the loader would silently replace with
+    synthetic data) must NOT count as real."""
+
+    def test_partial_ptb_is_not_real(self, tmp_path, monkeypatch):
+        from mpit_tpu.data.datasets import has_real_dataset
+
+        monkeypatch.setenv("MPIT_DATA_DIR", str(tmp_path))
+        assert not has_real_dataset("ptb")
+        (tmp_path / "ptb.train.txt").write_text("a b c\n")
+        assert not has_real_dataset("ptb")  # valid split missing
+        (tmp_path / "ptb.valid.txt").write_text("a b\n")
+        assert has_real_dataset("ptb")
+
+    def test_cifar_requires_all_batches_and_finds_subdir(
+        self, tmp_path, monkeypatch
+    ):
+        from mpit_tpu.data.datasets import has_real_dataset
+
+        monkeypatch.setenv("MPIT_DATA_DIR", str(tmp_path))
+        sub = tmp_path / "cifar-10-batches-bin"
+        sub.mkdir()
+        for i in range(1, 5):  # batch 5 missing
+            (sub / f"data_batch_{i}.bin").write_bytes(b"x")
+        (sub / "test_batch.bin").write_bytes(b"x")
+        assert not has_real_dataset("cifar10")
+        (sub / "data_batch_5.bin").write_bytes(b"x")
+        assert has_real_dataset("cifar10")  # tarball subdir layout
+
+    def test_mnist_requires_all_four_files(self, tmp_path, monkeypatch):
+        from mpit_tpu.data.datasets import has_real_dataset
+
+        monkeypatch.setenv("MPIT_DATA_DIR", str(tmp_path))
+        for n in (
+            "train-images-idx3-ubyte", "train-labels-idx1-ubyte",
+            "t10k-images-idx3-ubyte",
+        ):
+            (tmp_path / n).write_bytes(b"x")
+        assert not has_real_dataset("mnist")  # test labels missing
+        (tmp_path / "t10k-labels-idx1-ubyte").write_bytes(b"x")
+        assert has_real_dataset("mnist")
+
+    def test_unset_dir_and_unknown_name(self, monkeypatch):
+        from mpit_tpu.data.datasets import has_real_dataset
+
+        monkeypatch.delenv("MPIT_DATA_DIR", raising=False)
+        assert not has_real_dataset("mnist")
+        monkeypatch.setenv("MPIT_DATA_DIR", "/nonexistent-dir")
+        with pytest.raises(ValueError, match="unknown dataset"):
+            has_real_dataset("nope")
