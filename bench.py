@@ -151,7 +151,10 @@ def _model_flops_per_sample(trainer, state, x, y):
     import jax
 
     try:
-        params = state.center if hasattr(state, "center") else state.params
+        if isinstance(state, dict):  # pipeline trainer: dict state
+            params = state["params"]
+        else:
+            params = state.center if hasattr(state, "center") else state.params
         loss_fn = trainer.loss_fn
         model = getattr(trainer, "model", None)
         if model is not None and getattr(model, "seq_axis", None):
@@ -325,6 +328,9 @@ _PRESET_BENCH = {
     # beyond-parity long-context config (T=256 tokens/sample; sp=1 on one
     # chip — the ring is exercised by the CPU-mesh tests and dryrun)
     "ptb-transformer-seq": 64,
+    # beyond-parity pipeline config (pp=1 on one chip — microbatching and
+    # the schedule still run; multi-stage proven on the CPU mesh/dryrun)
+    "ptb-transformer-pp": 64,
 }
 # every benchmarkable preset (the staged collective ones above plus the
 # host-async literal-PS shape, which has its own harness)
@@ -443,12 +449,16 @@ def bench_preset(
         pwb, rounds, image_cap = 8, 3, 64
 
     mpit_tpu.finalize()
-    if cfg.resolved_algo() == "seq-sync":
+    from mpit_tpu.run import second_axis_for
+
+    second_axis = second_axis_for(cfg)
+    if cfg.resolved_algo() in second_axis:
+        ax, extent = second_axis[cfg.resolved_algo()]
         if num_workers is not None:  # honor a carved-down world here too
-            usable = (num_workers // cfg.sp) * cfg.sp
+            usable = (num_workers // extent) * extent
             topo = mpit_tpu.init(
-                axis_names=("dp", "sp"),
-                mesh_shape=(usable // cfg.sp, cfg.sp),
+                axis_names=("dp", ax),
+                mesh_shape=(usable // extent, extent),
                 num_workers=usable,
             )
         else:
@@ -460,7 +470,9 @@ def bench_preset(
     # all devices execute every step; on the 2-D seq-sync mesh that is
     # dp*sp chips, not just the worker-axis extent
     gb = pwb * topo.num_workers
-    is_sync = cfg.resolved_algo() in ("sync", "seq-sync", "moe-sync")
+    from mpit_tpu.run import SYNC_ALGOS
+
+    is_sync = cfg.resolved_algo() in SYNC_ALGOS
     tau = 1 if is_sync else cfg.tau
     cfg = dataclasses.replace(
         cfg, train_size=tau * gb * 2, image_size=min(cfg.image_size, image_cap)

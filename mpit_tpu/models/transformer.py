@@ -38,6 +38,10 @@ class Block(nn.Module):
     moe_axis: Optional[str] = None
     moe_capacity_factor: float = 2.0
     moe_top_k: int = 1
+    # single-device attention implementation: "xla" (fused dense),
+    # "flash" (pallas kernel on TPU, dense elsewhere), "flash_force"
+    # (pallas everywhere — interpret mode off TPU; tests)
+    attn_impl: str = "xla"
 
     @nn.compact
     def __call__(self, x):
@@ -50,6 +54,14 @@ class Block(nn.Module):
         q, k, v = split(q), split(k), split(v)
         if self.seq_axis is not None:
             att = ring_attention(q, k, v, self.seq_axis, causal=True)
+        elif self.attn_impl in ("flash", "flash_force"):
+            from mpit_tpu.ops.flash_attention import flash_attention
+
+            att = flash_attention(
+                q, k, v, causal=True,
+                use_pallas=True if self.attn_impl == "flash_force"
+                else None,
+            )
         else:
             att = dense_attention(q, k, v, causal=True)
         att = att.reshape(*att.shape[:2], self.d_model)
@@ -185,6 +197,8 @@ class TransformerLM(nn.Module):
     moe_top_k: int = 1
     moe_balance_weight: float = 0.0
     moe_zloss_weight: float = 0.0
+    # attention tiling for the dense (seq_axis=None) path — see Block
+    attn_impl: str = "xla"
 
     @nn.compact
     def __call__(self, tokens):
@@ -227,6 +241,7 @@ class TransformerLM(nn.Module):
                 moe_axis=self.moe_axis,
                 moe_capacity_factor=self.moe_capacity_factor,
                 moe_top_k=self.moe_top_k,
+                attn_impl=self.attn_impl,
                 name=f"Block_{i}",
             )(x)
         x = nn.LayerNorm(dtype=dt)(x)

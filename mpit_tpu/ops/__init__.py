@@ -1,17 +1,18 @@
-"""Custom TPU kernels (pallas) for the framework's hot elementwise ops.
+"""Custom TPU ops: pallas kernels + the sharded attention/MoE primitives.
 
 Scope note (honest engineering, not checkbox kernels): this framework's
 FLOPs live in model matmuls/convs (MXU via XLA) and its collectives live in
-`lax.psum` (ICI via XLA) — both already optimal. The remaining hot op is the
-EASGD elastic exchange: an HBM-bandwidth-bound elementwise pass over every
-parameter. XLA fuses it well; the pallas version here exists to (a) pin the
-fusion floor — one pass, two outputs, no intermediate materialization —
-regardless of what surrounds it in a larger program, and (b) be the seed for
-genuinely custom fused ops later. It is numerically identical to the XLA
-path (same ops, same order, no reductions) and flag-gated off by default.
+`lax.psum` (ICI via XLA) — both already optimal. The pallas kernels cover
+the two places a hand kernel can matter: the EASGD elastic exchange (an
+HBM-bandwidth-bound elementwise pass; XLA fuses it well — the kernel pins
+the fusion floor and measured SLOWER, so it is flag-gated off) and flash
+attention (VMEM-tiled scores for long single-device sequences — opt-in
+until its TPU measurement lands). Both are numerically identical to their
+XLA paths.
 """
 
 from mpit_tpu.ops.elastic import elastic_update, pallas_supported  # noqa: F401
+from mpit_tpu.ops.flash_attention import flash_attention  # noqa: F401
 from mpit_tpu.ops.ring_attention import (  # noqa: F401
     dense_attention,
     make_ring_attention,

@@ -1,0 +1,181 @@
+"""Flash attention as a pallas TPU kernel (single-device sequence).
+
+The within-device counterpart of ``ops/ring_attention.py``: the same
+online-softmax recipe, but tiled into VMEM by a pallas kernel so the
+(T, T) score matrix never round-trips HBM even on ONE device. XLA's
+fusion keeps scores in registers for small T; for long sequences it
+materializes (B, H, T, T) scores in HBM — this kernel caps that at a
+(block_q, block_k) tile in VMEM.
+
+Kernel structure (the canonical pallas flash shape,
+/opt/skills/guides/pallas_guide.md):
+
+- grid ``(B·H, T/block_q, T/block_k)`` — the k-block axis is innermost,
+  so for each (head, q-block) the kernel visits k-blocks sequentially,
+  carrying the online-softmax state (running max ``m``, normalizer
+  ``l``, output accumulator) in VMEM scratch that persists across the
+  innermost grid steps;
+- scratch initializes at ``j == 0``, the output block writes once at
+  the last ``j`` (revisiting one output block across sequential grid
+  steps is the standard TPU accumulation pattern);
+- causal masking uses GLOBAL positions from the block indices, and a
+  fully-masked (block entirely above the diagonal) k-block skips its
+  matmuls via ``pl.when``;
+- scores/statistics accumulate in f32 regardless of input dtype (bf16
+  inputs hit the MXU as bf16 — the recipe shared with ring attention).
+  ``m``/``l`` live lane-broadcast in (block_q, 128) scratch (the TPU
+  f32 tile's lane width).
+
+`interpret=True` runs the same kernel on CPU (the correctness tests);
+the public wrapper falls back to plain XLA dense attention when pallas
+cannot run natively and a kernel wasn't explicitly requested. Default
+OFF in the model (``attn_impl="xla"``) until the TPU measurement lands —
+the elastic-update kernel taught us XLA's fusion can beat a pallas
+kernel (ops/elastic.py's 2.7× finding), so the switch stays
+evidence-gated.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from mpit_tpu.ops.elastic import pallas_supported
+from mpit_tpu.ops.ring_attention import dense_attention
+
+_NEG_INF = float("-inf")
+_LANE = 128
+
+
+def _kernel(
+    q_ref, k_ref, v_ref, o_ref, m_scr, l_scr, acc_scr,
+    *, scale, causal, block_q, block_k, n_k,
+):
+    i = pl.program_id(1)
+    j = pl.program_id(2)
+
+    @pl.when(j == 0)
+    def _init():
+        m_scr[:] = jnp.full_like(m_scr[:], _NEG_INF)
+        l_scr[:] = jnp.zeros_like(l_scr[:])
+        acc_scr[:] = jnp.zeros_like(acc_scr[:])
+
+    # causal: a k-block strictly above the q-block's last row contributes
+    # nothing — skip its matmuls entirely
+    needed = (
+        j * block_k <= i * block_q + block_q - 1 if causal else j >= 0
+    )
+
+    @pl.when(needed)
+    def _update():
+        q = q_ref[0]  # (block_q, D)
+        k = k_ref[0]  # (block_k, D)
+        v = v_ref[0]
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        ) * scale  # (block_q, block_k)
+        if causal:
+            q_pos = i * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0
+            )
+            k_pos = j * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1
+            )
+            s = jnp.where(k_pos <= q_pos, s, _NEG_INF)
+        m_prev = m_scr[:][:, :1]  # (block_q, 1) of the broadcast store
+        l_prev = l_scr[:][:, :1]
+        block_max = jnp.max(s, axis=-1, keepdims=True)
+        m_new = jnp.maximum(m_prev, block_max)
+        # a still-fully-masked row has m = -inf; exp(s - m) would be nan —
+        # substitute 0, every term it touches is exp(-inf - 0) = 0
+        safe_m = jnp.where(jnp.isneginf(m_new), 0.0, m_new)
+        p = jnp.exp(s - safe_m)
+        corr = jnp.where(
+            jnp.isneginf(m_prev), 0.0, jnp.exp(m_prev - safe_m)
+        )
+        l_new = l_prev * corr + jnp.sum(p, axis=-1, keepdims=True)
+        acc_new = acc_scr[:] * corr + jax.lax.dot_general(
+            p, v.astype(jnp.float32), (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        m_scr[:] = jnp.broadcast_to(m_new, m_scr.shape)
+        l_scr[:] = jnp.broadcast_to(l_new, l_scr.shape)
+        acc_scr[:] = acc_new
+
+    @pl.when(j == n_k - 1)
+    def _finalize():
+        l = l_scr[:][:, :1]
+        out = acc_scr[:] / jnp.maximum(l, jnp.finfo(jnp.float32).tiny)
+        o_ref[0] = out.astype(o_ref.dtype)
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("causal", "block_q", "block_k", "interpret"),
+)
+def _flash_pallas(q, k, v, causal, block_q, block_k, interpret):
+    b, t, h, d = q.shape
+    scale = 1.0 / (d ** 0.5)
+    to2d = lambda a: a.transpose(0, 2, 1, 3).reshape(b * h, t, d)
+    q2, k2, v2 = to2d(q), to2d(k), to2d(v)
+    n_q, n_k = t // block_q, t // block_k
+
+    q_spec = pl.BlockSpec((1, block_q, d), lambda bh, i, j: (bh, i, 0))
+    kv_spec = pl.BlockSpec((1, block_k, d), lambda bh, i, j: (bh, j, 0))
+    out = pl.pallas_call(
+        functools.partial(
+            _kernel, scale=scale, causal=causal,
+            block_q=block_q, block_k=block_k, n_k=n_k,
+        ),
+        grid=(b * h, n_q, n_k),
+        in_specs=[q_spec, kv_spec, kv_spec],
+        out_specs=pl.BlockSpec(
+            (1, block_q, d), lambda bh, i, j: (bh, i, 0)
+        ),
+        out_shape=jax.ShapeDtypeStruct((b * h, t, d), q.dtype),
+        scratch_shapes=[
+            pltpu.VMEM((block_q, _LANE), jnp.float32),  # running max m
+            pltpu.VMEM((block_q, _LANE), jnp.float32),  # normalizer l
+            pltpu.VMEM((block_q, d), jnp.float32),      # output acc
+        ],
+        interpret=interpret,
+    )(q2, k2, v2)
+    return out.reshape(b, h, t, d).transpose(0, 2, 1, 3)
+
+
+def flash_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    causal: bool = False,
+    block_q: int = 128,
+    block_k: int = 128,
+    use_pallas=None,
+) -> jax.Array:
+    """Tiled exact attention, ``(B, T, H, D) -> (B, T, H, D)``.
+
+    ``use_pallas``: True = require the kernel (interpret mode off TPU),
+    False = XLA dense attention, None = kernel on TPU, XLA elsewhere.
+    Falls back to dense whenever ``T`` does not tile cleanly — blocks
+    clamp to ``T`` for short sequences, but a clamped block must still
+    be sublane-aligned (a multiple of 8) and divide ``T`` — exactness
+    and compilable tiles are never traded for the kernel.
+    """
+    if use_pallas is None:
+        use_pallas = pallas_supported()
+    t = q.shape[1]
+    block_q = min(block_q, t)
+    block_k = min(block_k, t)
+    tiles = (
+        t % block_q == 0 and t % block_k == 0
+        and block_q % 8 == 0 and block_k % 8 == 0
+    )
+    if not use_pallas or not tiles:
+        return dense_attention(q, k, v, causal=causal)
+    interpret = not pallas_supported()
+    return _flash_pallas(q, k, v, causal, block_q, block_k, interpret)

@@ -10,6 +10,14 @@
   parameter-server fidelity mode (SURVEY.md §2 comps. 3-4, §5 item (ii)).
 - :mod:`mpit_tpu.parallel.seq`      — sequence-parallel training over a 2-D
   (batch × sequence) mesh with ring attention (beyond-parity extension).
+- :mod:`mpit_tpu.parallel.tensor`   — GSPMD Megatron tensor parallelism
+  (dp × tp; strict sharding rules).
+- :mod:`mpit_tpu.parallel.pipeline` — pipeline parallelism (dp × pp;
+  GPipe and 1F1B schedules, shared transformer Block).
+- :mod:`mpit_tpu.parallel.moe`      — expert-parallel MoE training
+  (top-k GShard routing, balance/z losses, all_to_all dispatch).
+- :mod:`mpit_tpu.parallel.composed` — one dp × tp × sp step (partial-
+  manual shard_map: manual ring-attention sp, GSPMD dp/tp).
 """
 
 from mpit_tpu.parallel.common import TrainState, cross_entropy_loss  # noqa: F401

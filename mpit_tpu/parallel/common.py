@@ -147,8 +147,10 @@ def synced_fit_loop(
     metrics = None
     steps = 0
     # one host fetch up front so log lines can number steps across resume
-    # without a per-step device round-trip
-    base_step = int(state.step) if log_every else 0
+    # without a per-step device round-trip (the pipeline trainer's state
+    # is a dict, not a TrainState)
+    step_leaf = state["step"] if isinstance(state, dict) else state.step
+    base_step = int(step_leaf) if log_every else 0
 
     def step_batches(e, to_skip):
         for x, y in batches.epoch(e):

@@ -515,6 +515,44 @@ class PipelineParallelTrainer:
                 check_vma=False,
             )
         )
+        self._dp_axis = dp_axis
+
+        def eval_step(params, x, y):
+            """Global (correct-token count, CE sum): the pipelined
+            forward's logits exist only on the last stage — other
+            stages' zeros are masked OUT of the counts, then psum
+            makes the result world-visible."""
+            s = lax.axis_index("pp")
+            logits = forward(params, x).astype(jnp.float32)
+            correct = jnp.sum(jnp.argmax(logits, -1) == y)
+            logp = jax.nn.log_softmax(logits, axis=-1)
+            ce_sum = -jnp.take_along_axis(logp, y[..., None], -1).sum()
+            correct = jnp.where(s == S - 1, correct, 0)
+            ce_sum = jnp.where(s == S - 1, ce_sum, 0.0)
+            correct = lax.psum(lax.psum(correct, "pp"), dp_axis)
+            ce_sum = lax.psum(lax.psum(ce_sum, "pp"), dp_axis)
+            return correct, ce_sum
+
+        self._eval = jax.jit(
+            jax.shard_map(
+                eval_step,
+                mesh=mesh,
+                in_specs=(spec, P(dp_axis), P(dp_axis)),
+                out_specs=(P(), P()),
+                check_vma=False,
+            )
+        )
+
+        # unpipelined per-sample loss on the same params — the bench's
+        # analytic FLOP counter traces this (host-side, never compiled)
+        def _flat_loss(params, x, y):
+            logits = reference_apply(params, x, num_heads).astype(
+                jnp.float32
+            )
+            logp = jax.nn.log_softmax(logits, axis=-1)
+            return -jnp.take_along_axis(logp, y[..., None], -1).mean()
+
+        self.loss_fn = _flat_loss
 
     @property
     def ticks(self) -> int:
@@ -529,7 +567,9 @@ class PipelineParallelTrainer:
             return int(schedule_1f1b(self.n_micro, self.pp)["ticks"])
         return self.n_micro + self.pp - 1
 
-    def init_state(self, rng) -> dict:
+    def init_state(self, rng, sample_x=None) -> dict:
+        """``sample_x`` is accepted (and ignored — shapes come from the
+        constructor) so every trainer shares one init_state signature."""
         params = init_params(
             rng, self.vocab_size, self.num_layers, self.d_model,
             self.d_ff, self.seq_len, num_heads=self.num_heads,
@@ -558,21 +598,69 @@ class PipelineParallelTrainer:
         }
         return jax.device_put(state, shardings)
 
-    def step(self, state, x_global, y_global):
-        """One pipelined step on a global (B, T) batch."""
-        b = len(x_global)
+    def data_sharding(self) -> NamedSharding:
+        """(B, T) token batches shard over dp; every pp rank sees the
+        full sequence of its dp shard."""
+        return NamedSharding(self.topo.mesh, P(self._dp_axis))
+
+    def _check(self, x):
+        b = len(x)
         if b % self.dp or (b // self.dp) % self.n_micro:
             raise ValueError(
                 f"global batch {b} must split into dp={self.dp} shards of "
                 f"a multiple of n_micro={self.n_micro}"
             )
-        if x_global.shape[1] > self.seq_len:
+        if x.shape[1] > self.seq_len:
             raise ValueError(
-                f"sequence of {x_global.shape[1]} exceeds the position "
+                f"sequence of {x.shape[1]} exceeds the position "
                 f"table (seq_len={self.seq_len})"
             )
+
+    def step(self, state, x_global, y_global):
+        """One pipelined step on a global (B, T) batch."""
+        self._check(x_global)
         state, metrics = self._step(
             state, jnp.asarray(x_global), jnp.asarray(y_global)
         )
         bound_cpu_dispatch(self.topo, metrics)
         return state, metrics
+
+    def fit(
+        self,
+        batches,
+        state,
+        epochs: int = 1,
+        log_every: int = 0,
+        start_epoch: int = 0,
+        skip_steps: int = 0,
+        on_step=None,
+        prefetch: int = 2,
+    ):
+        """Epoch loop — the shared :func:`common.synced_fit_loop` with
+        the dp-only batch sharding."""
+        from mpit_tpu.parallel.common import synced_fit_loop
+
+        return synced_fit_loop(
+            self.topo, self._step, batches, state,
+            sharding=self.data_sharding(),
+            check=self._check,
+            log_tag=f"pp-{self.schedule}",
+            epochs=epochs, log_every=log_every, start_epoch=start_epoch,
+            skip_steps=skip_steps, on_step=on_step, prefetch=prefetch,
+        )
+
+    def evaluate(self, state, x, y, batch: int = 512):
+        """Token-level accuracy and mean loss over a (N, T) eval set."""
+        from mpit_tpu.parallel.common import batched_count_eval
+
+        if x.shape[1] > self.seq_len:
+            raise ValueError(
+                f"sequence of {x.shape[1]} exceeds the position "
+                f"table (seq_len={self.seq_len})"
+            )
+        correct, loss_sum, n = batched_count_eval(
+            self._eval, state["params"], x, y, batch,
+            self.dp * self.n_micro,
+        )
+        tokens = n * x.shape[1]
+        return correct / tokens, loss_sum / tokens

@@ -104,12 +104,14 @@ def _build_model(cfg: TrainConfig, meta: dict, worker_axis: str = None):
         return get_model(
             cfg.model,
             vocab_size=meta.get("vocab_size", 10_000),
+            num_layers=cfg.layers,
             max_len=max(cfg.seq_len, 32),
             # seq-sync applies the model inside shard_map with the sequence
             # sharded on the mesh's "sp" axis (ring attention); moe-sync
             # shards experts over the worker axis
             seq_axis="sp" if algo == "seq-sync" else None,
             remat=cfg.remat,
+            attn_impl=cfg.attn_impl,
             **(
                 {
                     "moe_experts": cfg.moe_experts,
@@ -133,6 +135,17 @@ def _build_model(cfg: TrainConfig, meta: dict, worker_axis: str = None):
     if name in REMAT_MODELS:
         kwargs["remat"] = cfg.remat
     return get_model(cfg.model, **kwargs)
+
+
+# the per-step (no τ-round) algos — ONE copy; bench.py imports these so
+# its mesh/τ handling can never drift from the driver's
+SYNC_ALGOS = ("sync", "seq-sync", "moe-sync", "pp-sync")
+
+
+def second_axis_for(cfg: TrainConfig) -> dict:
+    """algo -> (second mesh-axis name, configured extent) for the 2-D
+    mesh algos; the ONE copy bench.py and _world_for share."""
+    return {"seq-sync": ("sp", cfg.sp), "pp-sync": ("pp", cfg.pp)}
 
 
 def build_trainer(cfg: TrainConfig, model, opt, topo):
@@ -181,6 +194,43 @@ def build_trainer(cfg: TrainConfig, model, opt, topo):
                 "transformer)"
             )
         return MoEParallelTrainer(model, opt, topo)
+    if algo == "pp-sync":
+        from mpit_tpu.parallel import PipelineParallelTrainer
+
+        if cfg.model.lower() != "transformer":
+            raise ValueError(
+                "algo='pp-sync' is transformer-only (the pipeline stages "
+                f"a transformer layer stack); got model={cfg.model!r}"
+            )
+        ignored = [
+            f for f, on in (("attn_impl", cfg.attn_impl != "xla"),
+                            ("remat", cfg.remat)) if on
+        ]
+        if ignored:
+            import warnings
+
+            warnings.warn(
+                f"pp-sync builds its own f32 dense-attention pipeline "
+                f"model; {ignored} do not apply and are ignored",
+                stacklevel=2,
+            )
+        # the pipeline builds its own stacked-leaf params; shapes come
+        # off the flax model so one --model transformer config drives
+        # every trainer. Its optimizer is the built-in SGD+momentum —
+        # the same rule run() builds for everyone (cfg.lr/cfg.momentum).
+        return PipelineParallelTrainer(
+            vocab_size=model.vocab_size,
+            num_layers=model.num_layers,
+            d_model=model.d_model,
+            num_heads=model.num_heads,
+            seq_len=model.max_len,
+            d_ff=model.d_ff,
+            topo=topo,
+            n_micro=cfg.n_micro,
+            lr=cfg.lr,
+            momentum=cfg.momentum,
+            schedule=cfg.pp_schedule,
+        )
     raise ValueError(f"unknown algo {cfg.algo!r}")
 
 
@@ -197,25 +247,28 @@ def _world_for(cfg: TrainConfig):
     from mpit_tpu.comm.topology import topology as current_topology
 
     algo = cfg.resolved_algo()
+    second_axis = second_axis_for(cfg)
     if is_initialized():
         cur = current_topology()
         names = cur.mesh.axis_names
         shape = cur.mesh.devices.shape
-        if algo == "seq-sync":
-            fits = names[:2] == ("dp", "sp") and shape[1] == cfg.sp
+        if algo in second_axis:
+            ax, extent = second_axis[algo]
+            fits = names[:2] == ("dp", ax) and shape[1] == extent
         else:
             fits = all(n == 1 for n in shape[1:])
         if fits:
             return cur
         mpit_tpu.finalize()
-    if algo == "seq-sync":
+    if algo in second_axis:
+        ax, extent = second_axis[algo]
         n = len(jax.devices())
-        if n % cfg.sp:
+        if n % extent:
             raise ValueError(
-                f"sp={cfg.sp} does not divide the {n} available devices"
+                f"{ax}={extent} does not divide the {n} available devices"
             )
         return mpit_tpu.init(
-            axis_names=("dp", "sp"), mesh_shape=(n // cfg.sp, cfg.sp)
+            axis_names=("dp", ax), mesh_shape=(n // extent, extent)
         )
     return mpit_tpu.init()
 
@@ -276,7 +329,7 @@ def run(cfg: TrainConfig) -> dict:
             results["resumed_from"] = step
 
     batches = Batches(x_tr, y_tr, global_batch=gb, seed=cfg.seed)
-    is_sync = cfg.resolved_algo() in ("sync", "seq-sync", "moe-sync")
+    is_sync = cfg.resolved_algo() in SYNC_ALGOS
     tau = 1 if is_sync else cfg.tau
     units_per_epoch = batches.steps_per_epoch() // tau
     if units_per_epoch == 0:
@@ -331,9 +384,11 @@ def run(cfg: TrainConfig) -> dict:
         results["eval_loss"] = eval_loss
     else:
         acc = trainer.evaluate(state, x_te, y_te)
-    if is_seq and cfg.resolved_algo() not in ("seq-sync", "moe-sync"):
-        # eval counts correct *tokens* per window; the seq-sync and
-        # moe-sync trainers already normalize per token themselves
+    if is_seq and cfg.resolved_algo() not in (
+        "seq-sync", "moe-sync", "pp-sync"
+    ):
+        # eval counts correct *tokens* per window; the seq/moe/pp-sync
+        # trainers already normalize per token themselves
         acc = acc / cfg.seq_len
     results.update(
         accuracy=acc,

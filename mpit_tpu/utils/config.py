@@ -22,13 +22,14 @@ class TrainConfig:
     preset: Optional[str] = None  # one of PRESETS, or None for flag-driven
     model: str = "lenet"
     dataset: str = "mnist"
-    # easgd | eamsgd | downpour | sync | seq-sync | moe-sync | ps-easgd |
-    # ps-eamsgd | ps-downpour (eamsgd = EASGD with momentum in the local
-    # optimizer, the paper's momentum variant — the alias asserts
-    # momentum > 0; seq-sync = sync DP over a 2-D dp x sp mesh with
-    # sequence-parallel ring attention; moe-sync = sync DP with the
-    # transformer's MoE experts sharded over the worker axis — both
-    # transformer only)
+    # easgd | eamsgd | downpour | sync | seq-sync | moe-sync | pp-sync |
+    # ps-easgd | ps-eamsgd | ps-downpour (eamsgd = EASGD with momentum in
+    # the local optimizer, the paper's momentum variant — the alias
+    # asserts momentum > 0; seq-sync = sync DP over a 2-D dp x sp mesh
+    # with sequence-parallel ring attention; moe-sync = sync DP with the
+    # transformer's MoE experts sharded over the worker axis; pp-sync =
+    # pipeline parallelism over a dp x pp mesh, --pp-schedule gpipe|1f1b
+    # — all three transformer only)
     algo: str = "easgd"
     # optimization (reference conf table: lr, τ, α — SURVEY.md §5)
     lr: float = 0.05
@@ -66,6 +67,17 @@ class TrainConfig:
     # seq-sync only: sequence-parallel extent (devices per ring; the mesh is
     # (num_devices // sp) x sp — batch axis "dp", sequence axis "sp")
     sp: int = 1
+    # pp-sync only: pipeline extent (stages; mesh (num_devices // pp) x pp),
+    # microbatches per step, and the schedule (gpipe | 1f1b)
+    pp: int = 2
+    n_micro: int = 4
+    pp_schedule: str = "gpipe"
+    # transformer depth (pp-sync needs layers % pp == 0)
+    layers: int = 2
+    # transformer dense-attention implementation: "xla" (fused dense) or
+    # "flash" (pallas tiled kernel on TPU; dense elsewhere) — the kernel
+    # stays opt-in until its TPU measurement lands (ops/flash_attention)
+    attn_impl: str = "xla"
     # moe-sync only: expert count (sharded over the worker axis; must be
     # divisible by it) and the GShard capacity factor
     moe_experts: int = 0
@@ -203,5 +215,13 @@ PRESETS: dict[str, dict] = {
         model="transformer", dataset="ptb", algo="seq-sync",
         lr=0.001, momentum=0.9, global_batch=32, epochs=1,
         seq_len=256, sp=1,
+    ),
+    # beyond-parity pipeline config: transformer over a dp x pp mesh
+    # (pp=1 on one chip — staging/microbatching still exercised; the
+    # multi-stage path is proven on the CPU mesh and in the dryrun)
+    "ptb-transformer-pp": dict(
+        model="transformer", dataset="ptb", algo="pp-sync",
+        lr=0.001, momentum=0.9, global_batch=32, epochs=1,
+        seq_len=256, pp=1, n_micro=4, layers=2,
     ),
 }

@@ -57,6 +57,23 @@ class TestPresets:
         assert r["trained_units"] == 1
         assert 0.0 <= r["accuracy"] <= 1.0
 
+    def test_pp_sync_transformer(self):
+        # pipeline-parallel transformer end to end through the driver:
+        # dp x pp mesh, both schedules
+        for sched in ("gpipe", "1f1b"):
+            r = run(_cfg("ptb-transformer-pp", pp=4, layers=4, n_micro=2,
+                         train_size=64, global_batch=16, seq_len=32,
+                         epochs=1, pp_schedule=sched))
+            assert r["trained_units"] == 4, sched
+            assert 0.0 <= r["accuracy"] <= 1.0 and "eval_loss" in r
+            # batch shards over dp=2 of the (2, 4) mesh
+            assert r["workers"] == 2, sched
+
+    def test_pp_sync_rejects_non_transformer(self):
+        with pytest.raises(ValueError, match="transformer-only"):
+            run(_cfg("ptb-transformer-pp", model="lenet", dataset="mnist",
+                     train_size=32, global_batch=8, epochs=1))
+
     def test_moe_sync_transformer(self):
         # expert-parallel MoE LM end to end through the driver: experts
         # shard over the 8-device worker axis
