@@ -35,11 +35,13 @@ copies.
 
 from __future__ import annotations
 
+import functools
 from typing import Optional
 
 import flax.linen as nn
 import jax
 import jax.numpy as jnp
+import numpy as np
 from jax import lax
 from jax.sharding import NamedSharding, PartitionSpec as P
 
@@ -109,62 +111,153 @@ def schedule_1f1b(n_micro: int, stages: int) -> dict:
     ppermute tick), which is the schedule's actual win: saved
     activations stay O(S), not O(M).
     """
-    M, S = n_micro, stages
-    f_done = [[-1] * M for _ in range(S)]
-    b_done = [[-1] * M for _ in range(S)]
-    nf = [0] * S  # next forward microbatch per stage
-    nb = [0] * S  # next backward microbatch per stage (1F1B runs in order)
-    inflight_max = [0] * S
-    op_rows, mb_rows = [], []
-    t, total_b = 0, 0
-    while total_b < S * M:
-        if t > 4 * (M + S) + 8:
-            raise AssertionError("1F1B schedule failed to converge")
-        row = []
-        for s in range(S):
-            op, mb = 0, 0
-            bi, fi = nb[s], nf[s]
-            b_ready = bi < M and (
-                0 <= f_done[s][bi] < t
-                if s == S - 1
-                else 0 <= b_done[s + 1][bi] < t
-            )
-            f_ready = fi < M and (fi - nb[s]) < S and (
-                s == 0 or 0 <= f_done[s - 1][fi] < t
-            )
-            if b_ready:
-                op, mb = 2, bi
-            elif f_ready:
-                op, mb = 1, fi
-            row.append((op, mb))
-        for s, (op, mb) in enumerate(row):  # commit synchronously
-            if op == 1:
-                f_done[s][mb] = t
-                nf[s] += 1
-                inflight_max[s] = max(inflight_max[s], nf[s] - nb[s])
-            elif op == 2:
-                b_done[s][mb] = t
-                nb[s] += 1
-                total_b += 1
-        op_rows.append([op for op, _ in row])
-        mb_rows.append([mb for _, mb in row])
-        t += 1
-    import numpy as np
-
-    T = t
-    arr_act = -np.ones((T, S), np.int32)
-    arr_ct = -np.ones((T, S), np.int32)
-    for s in range(S):
-        for i in range(M):
-            if s + 1 < S and f_done[s][i] + 1 < T:
-                arr_act[f_done[s][i] + 1, s + 1] = i
-            if s - 1 >= 0 and b_done[s][i] + 1 < T:
-                arr_ct[b_done[s][i] + 1, s - 1] = i
+    tabs = schedule_pipeline(n_micro, stages, virtual=1)
+    # v=1: drop the (all-zero) chunk columns for the original interface
     return {
-        "op": np.asarray(op_rows, np.int32),
-        "mb": np.asarray(mb_rows, np.int32),
-        "arr_act": arr_act,
-        "arr_ct": arr_ct,
+        "op": tabs["op"],
+        "mb": tabs["mb"],
+        "arr_act": tabs["arr_act_mb"],
+        "arr_ct": tabs["arr_ct_mb"],
+        "ticks": tabs["ticks"],
+        "max_inflight": tabs["max_inflight"],
+    }
+
+
+# forward-unit orderings tried by the interleaved scheduler; the
+# min-span table wins (all are valid — they only reorder ready work)
+_F_POLICIES = (
+    lambda c, i, S: (i, c),            # microbatch-major
+    lambda c, i, S: (c, i),            # chunk-major
+    lambda c, i, S: (i // S, c, i),    # Megatron grouping: S-microbatch
+                                       # blocks cycling through chunks
+)
+
+
+def schedule_pipeline(n_micro: int, stages: int, virtual: int = 1) -> dict:
+    """Static interleaved-1F1B timetable: ``virtual`` chunks per device.
+
+    Global chunk ``c`` (0..v·S) lives on device ``c % S`` as local chunk
+    ``c // S`` and holds ``L/(v·S)`` consecutive layers; activations hop
+    chunk ``c → c+1``, which is always ONE forward ring hop (cotangents
+    the reverse), so the communication pattern is identical to plain
+    1F1B — only the timetable changes. Each tick a device runs one unit
+    (fwd or bwd of one (chunk, microbatch)); a unit's output arrives at
+    its neighbor the next tick.
+
+    The greedy simulation prefers a ready backward, then tries each
+    forward ordering in ``_F_POLICIES`` and keeps the shortest-span
+    table. Why interleaving wins: a unit is ``1/v`` of a device's
+    per-microbatch work, so the (S−1)-deep fill/drain skew costs
+    ``(S−1)/v`` device-work units instead of ``S−1`` — the Megatron
+    virtual-pipeline argument. ``virtual=1`` reproduces plain 1F1B
+    exactly.
+
+    Results are cached per (M, S, v) — treat the tables as read-only.
+    With one chunk per device every policy picks the same unit, so v=1
+    skips the policy search.
+    """
+    return _schedule_cached(n_micro, stages, virtual)
+
+
+@functools.lru_cache(maxsize=64)
+def _schedule_cached(n_micro: int, stages: int, virtual: int) -> dict:
+    M, S, v = n_micro, stages, virtual
+    C = v * S  # total chunks
+
+    def simulate(f_key):
+        f_done = [[-1] * M for _ in range(C)]
+        b_done = [[-1] * M for _ in range(C)]
+        nf = [0] * C
+        nb = [0] * C
+        inflight_max = [0] * S
+        rows = []  # per tick: per device (op, c_local, mb)
+        t, total_b = 0, 0
+        ring = min(S, M)
+        while total_b < C * M:
+            if t > 6 * v * (M + S) + 16:
+                raise AssertionError("pipeline schedule failed to converge")
+            row = []
+            for s in range(S):
+                chunks = [cl * S + s for cl in range(v)]
+                pick = (0, 0, 0)
+                b_ready = [
+                    (c, nb[c]) for c in chunks
+                    if nb[c] < M and (
+                        0 <= f_done[c][nb[c]] < t if c == C - 1
+                        else 0 <= b_done[c + 1][nb[c]] < t
+                    )
+                ]
+                if b_ready:
+                    # drain-first: the highest chunk's backward unblocks
+                    # the longest dependency chain
+                    c, i = max(b_ready, key=lambda ci: ci[0])
+                    pick = (2, c // S, i)
+                else:
+                    f_ready = [
+                        (c, nf[c]) for c in chunks
+                        if nf[c] < M and (nf[c] - nb[c]) < ring and (
+                            c == 0 or 0 <= f_done[c - 1][nf[c]] < t
+                        )
+                    ]
+                    if f_ready:
+                        c, i = min(
+                            f_ready, key=lambda ci: f_key(ci[0], ci[1], S)
+                        )
+                        pick = (1, c // S, i)
+                row.append(pick)
+            for s, (op, cl, mb) in enumerate(row):
+                c = cl * S + s
+                if op == 1:
+                    f_done[c][mb] = t
+                    nf[c] += 1
+                    inflight_max[s] = max(
+                        inflight_max[s],
+                        sum(nf[x] - nb[x] for x in range(s, C, S)),
+                    )
+                elif op == 2:
+                    b_done[c][mb] = t
+                    nb[c] += 1
+                    total_b += 1
+            rows.append(row)
+            t += 1
+        return t, rows, f_done, b_done, inflight_max
+
+    best = None
+    for key in (_F_POLICIES if v > 1 else _F_POLICIES[:1]):
+        result = simulate(key)
+        if best is None or result[0] < best[0]:
+            best = result
+    T, rows, f_done, b_done, inflight_max = best
+
+    op = np.zeros((T, S), np.int32)
+    chunk = np.zeros((T, S), np.int32)
+    mb = np.zeros((T, S), np.int32)
+    for t, row in enumerate(rows):
+        for s, (o, cl, i) in enumerate(row):
+            op[t, s], chunk[t, s], mb[t, s] = o, cl, i
+    # arrivals: (local chunk, mb) landing at each (tick, device); -1 none
+    arr_act_c = -np.ones((T, S), np.int32)
+    arr_act_mb = -np.ones((T, S), np.int32)
+    arr_ct_c = -np.ones((T, S), np.int32)
+    arr_ct_mb = -np.ones((T, S), np.int32)
+    for c in range(C):
+        for i in range(M):
+            if c + 1 < C and 0 <= f_done[c][i] and f_done[c][i] + 1 < T:
+                td, dev = f_done[c][i] + 1, (c + 1) % S
+                arr_act_c[td, dev] = (c + 1) // S
+                arr_act_mb[td, dev] = i
+            if c - 1 >= 0 and 0 <= b_done[c][i] and b_done[c][i] + 1 < T:
+                td, dev = b_done[c][i] + 1, (c - 1) % S
+                arr_ct_c[td, dev] = (c - 1) // S
+                arr_ct_mb[td, dev] = i
+    return {
+        "op": op,
+        "chunk": chunk,
+        "mb": mb,
+        "arr_act_c": arr_act_c,
+        "arr_act_mb": arr_act_mb,
+        "arr_ct_c": arr_ct_c,
+        "arr_ct_mb": arr_ct_mb,
         "ticks": T,
         "max_inflight": inflight_max,
     }
@@ -217,6 +310,7 @@ class PipelineParallelTrainer:
         lr: float = 0.1,
         momentum: float = 0.9,
         schedule: str = "gpipe",
+        virtual: int = 2,
     ):
         self.topo = topo if topo is not None else _current_topology()
         mesh = self.topo.mesh
@@ -243,11 +337,35 @@ class PipelineParallelTrainer:
         self.seq_len = seq_len
         self.n_micro = n_micro
         self.lr, self.momentum = lr, momentum
-        if schedule not in ("gpipe", "1f1b"):
+        if schedule not in ("gpipe", "1f1b", "interleaved"):
             raise ValueError(
-                f"schedule={schedule!r} must be 'gpipe' or '1f1b'"
+                f"schedule={schedule!r} must be 'gpipe', '1f1b', or "
+                "'interleaved'"
             )
         self.schedule = schedule
+        # virtual chunks per device (Megatron virtual pipeline) — only
+        # the interleaved schedule uses more than one
+        self.virtual = virtual if schedule == "interleaved" else 1
+        if self.virtual < 1:
+            raise ValueError(f"virtual={virtual} must be >= 1")
+        if num_layers % (self.pp * self.virtual):
+            raise ValueError(
+                f"num_layers={num_layers} not divisible by "
+                f"pp x virtual = {self.pp}x{self.virtual}"
+            )
+        # storage permutation: stacked layer row r of the (L, ...) leaves
+        # must hold the layer device r//K's local chunks cover — under
+        # interleaving device s owns chunks {s, s+S, ...}, which are NOT
+        # contiguous global layers. Identity for gpipe/1f1b.
+        Kc_ = num_layers // (self.pp * self.virtual)
+        self._perm = np.array([
+            (cl * self.pp + s_) * Kc_ + j
+            for s_ in range(self.pp)
+            for cl in range(self.virtual)
+            for j in range(Kc_)
+        ])
+        self._inv_perm = np.argsort(self._perm)
+        self._permuted = self.virtual > 1
         dp_axis = mesh.axis_names[0]
 
         spec = {"blocks": P("pp"), "rest": P()}
@@ -307,25 +425,45 @@ class PipelineParallelTrainer:
 
         K = num_layers // S  # layers per stage (the local block shard)
 
-        def loss_and_grads_1f1b(params, x, y):
-            """1F1B: forwards and backwards explicitly interleaved on one
-            tick timeline (schedule_1f1b), instead of a forward scan that
-            autodiff transposes afterwards (GPipe).
+        v = self.virtual
+        Kc = K // v  # layers per chunk
 
-            Same span — 2(M+S−1) ticks vs GPipe's (M+S−1) forward plus an
-            equally long transposed backward — but the saved state is an
-            S-slot ring of per-layer block INPUTS (backward recomputes
-            each block before transposing it, remat-style), so peak
-            activation memory is O(S·K) block inputs instead of autodiff
+        def loss_and_grads_1f1b(params, x, y):
+            """1F1B / interleaved: forwards and backwards explicitly
+            scheduled on one tick timeline (schedule_pipeline), instead
+            of a forward scan that autodiff transposes afterwards
+            (GPipe).
+
+            v=1 (schedule="1f1b"): same 2(M+S−1)-tick span as GPipe, but
+            the saved state is an R-slot ring of per-layer block INPUTS
+            (backward recomputes each block before transposing it,
+            remat-style) — O(S·K) activation memory instead of autodiff
             GPipe's O((M+S−1)·K) per-tick internals.
+
+            v>1 (schedule="interleaved"): each device holds v virtual
+            chunks (Megatron virtual pipeline; params stored chunk-
+            permuted so P("pp") hands each device its chunks). A tick is
+            1/v of a stage's work, so the (S−1)-deep fill/drain skew
+            shrinks by v — wins in the bubble-dominated regime (M ≲ S);
+            for M ≫ S the extra hop latency per chunk boundary eats the
+            gain (measured in schedule_pipeline's simulator, asserted in
+            tests).
             """
-            tabs = schedule_1f1b(M, S)
+            tabs = schedule_pipeline(M, S, v)
             t_op = jnp.asarray(tabs["op"])
+            t_cl = jnp.asarray(tabs["chunk"])
             t_mb = jnp.asarray(tabs["mb"])
-            t_aa = jnp.asarray(tabs["arr_act"])
-            t_ac = jnp.asarray(tabs["arr_ct"])
+            t_aa_c = jnp.asarray(tabs["arr_act_c"])
+            t_aa_m = jnp.asarray(tabs["arr_act_mb"])
+            t_ac_c = jnp.asarray(tabs["arr_ct_c"])
+            t_ac_m = jnp.asarray(tabs["arr_ct_mb"])
             s = lax.axis_index("pp")
             rest, blocks = params["rest"], params["blocks"]
+            # local (K, ...) leaves viewed as v chunks of Kc layers (the
+            # storage permutation makes these the right GLOBAL chunks)
+            blocks_v = jax.tree.map(
+                lambda a: a.reshape(v, Kc, *a.shape[1:]), blocks
+            )
             b, t_len = x.shape
             mb = b // M
             # tokens stay int32 (M, mb, t); each fwd/bwd unit embeds its
@@ -344,35 +482,42 @@ class PipelineParallelTrainer:
                 ce = -jnp.take_along_axis(logp, y_i[..., None], -1).mean()
                 return ce / M
 
-            R = min(S, M)  # ring slots: the in-flight bound, never M
+            R = min(S, M)  # ring slots per chunk: the in-flight bound
 
-            def store(buf, idx, val):
-                """Predicated ring write: buf[idx % R] = val when
-                idx >= 0. Slot reuse is safe by the in-flight cap: the
-                producer of item i+R cannot have run before item i's
-                consumer finished (schedule_1f1b's capacity rule)."""
-                upd = lax.dynamic_update_index_in_dim(
-                    buf, val, jnp.remainder(jnp.maximum(idx, 0), R), 0
+            def store(buf, cl, idx, val):
+                """Predicated ring write: buf[cl, idx % R] = val when
+                idx >= 0. Slot reuse is safe by the per-chunk in-flight
+                cap: the producer of item i+R cannot have run before
+                item i's consumer finished (schedule_pipeline's
+                capacity rule, chained chunk-to-chunk)."""
+                slot = jnp.remainder(jnp.maximum(idx, 0), R)
+                upd = lax.dynamic_update_slice(
+                    buf, val[None, None],
+                    (jnp.maximum(cl, 0), slot)
+                    + (0,) * (buf.ndim - 2),
                 )
                 return jnp.where(idx >= 0, upd, buf)
 
-            def fetch(buf, idx):
-                return lax.dynamic_index_in_dim(
-                    buf, jnp.remainder(idx, R), 0, False
+            def fetch(buf, cl, idx):
+                got = lax.dynamic_slice(
+                    buf,
+                    (cl, jnp.remainder(idx, R)) + (0,) * (buf.ndim - 2),
+                    (1, 1) + buf.shape[2:],
                 )
+                return got.reshape(buf.shape[2:])
 
             zero_act = jnp.zeros((mb, t_len, d_model), jnp.float32)
             carry0 = {
                 "pf": zero_act,  # last fwd output (sent down-pipe)
                 "pb": zero_act,  # last bwd input-cotangent (sent up-pipe)
-                # boundary rings — O(S) like everything else in the carry
-                "act": jnp.zeros((R, mb, t_len, d_model), jnp.float32),
-                "ct": jnp.zeros((R, mb, t_len, d_model), jnp.float32),
-                # per-layer block inputs + stage output, R in-flight slots
+                # boundary rings — O(v·S), never O(M)
+                "act": jnp.zeros((v, R, mb, t_len, d_model), jnp.float32),
+                "ct": jnp.zeros((v, R, mb, t_len, d_model), jnp.float32),
+                # per-layer chunk inputs + chunk output, R slots per chunk
                 "ring": jnp.zeros(
-                    (R, K + 1, mb, t_len, d_model), jnp.float32
+                    (v, R, Kc + 1, mb, t_len, d_model), jnp.float32
                 ),
-                "gb": jax.tree.map(jnp.zeros_like, blocks),
+                "gb": jax.tree.map(jnp.zeros_like, blocks_v),
                 "gr": jax.tree.map(jnp.zeros_like, rest),
                 "loss": jnp.float32(0.0),
             }
@@ -382,42 +527,50 @@ class PipelineParallelTrainer:
                 recv_c = lax.ppermute(c["pb"], "pp", perm_bwd)
                 c = {
                     **c,
-                    "act": store(c["act"], t_aa[tk, s], recv_a),
-                    "ct": store(c["ct"], t_ac[tk, s], recv_c),
+                    "act": store(
+                        c["act"], t_aa_c[tk, s], t_aa_m[tk, s], recv_a
+                    ),
+                    "ct": store(
+                        c["ct"], t_ac_c[tk, s], t_ac_m[tk, s], recv_c
+                    ),
                 }
+                cl = t_cl[tk, s]
                 i = t_mb[tk, s]
+                blk_c = jax.tree.map(
+                    lambda a: lax.dynamic_index_in_dim(a, cl, 0, False),
+                    blocks_v,
+                )
 
                 def fwd(c):
-                    # only stage 0 embeds; lax.cond skips the gather on
-                    # the other stages (jnp.where would run it anyway)
+                    # only global chunk 0 (device 0, local chunk 0)
+                    # embeds; lax.cond skips the gather elsewhere
                     def embed_in(_):
                         x_i = lax.dynamic_index_in_dim(x_mb, i, 0, False)
                         return rest["embed"][x_i] + rest["pos"][:t_len]
 
                     inp = lax.cond(
-                        s == 0, embed_in, lambda _: fetch(c["act"], i), None
+                        (s == 0) & (cl == 0),
+                        embed_in,
+                        lambda _: fetch(c["act"], cl, i),
+                        None,
                     )
 
                     def f(cc, p):
                         return blk.apply({"params": p}, cc), cc
 
-                    out, saved = lax.scan(f, inp, blocks)
+                    out, saved = lax.scan(f, inp, blk_c)
                     entry = jnp.concatenate([saved, out[None]], 0)
-                    ring = lax.dynamic_update_index_in_dim(
-                        c["ring"], entry, jnp.remainder(i, R), 0
-                    )
+                    ring = store(c["ring"], cl, i, entry)
                     return {**c, "ring": ring, "pf": out}
 
                 def bwd(c):
-                    entry = lax.dynamic_index_in_dim(
-                        c["ring"], jnp.remainder(i, R), 0, False
-                    )
-                    out = entry[K]
+                    entry = fetch(c["ring"], cl, i)
+                    out = entry[Kc]
                     y_i = lax.dynamic_index_in_dim(y_mb, i, 0, False)
-                    last = s == S - 1
+                    last = (s == S - 1) & (cl == v - 1)
 
                     # the head (final norm + tied vocab matmul + CE) and
-                    # its vjp run ONLY on the last stage — lax.cond is
+                    # its vjp run ONLY on the last chunk — lax.cond is
                     # legal here (no collectives inside the branches)
                     def with_head(_):
                         loss_i, head_vjp = jax.vjp(
@@ -430,7 +583,7 @@ class PipelineParallelTrainer:
                         return (
                             jnp.float32(0.0),
                             jax.tree.map(jnp.zeros_like, rest),
-                            fetch(c["ct"], i),
+                            fetch(c["ct"], cl, i),
                         )
 
                     loss_i, g_head, ct_out = lax.cond(
@@ -447,22 +600,30 @@ class PipelineParallelTrainer:
                         return gx, gp
 
                     # recompute-and-transpose each block, last to first
-                    ct_in, g_blocks = lax.scan(
-                        bstep, ct_out, (blocks, entry[:K]), reverse=True
+                    ct_in, g_chunk = lax.scan(
+                        bstep, ct_out, (blk_c, entry[:Kc]), reverse=True
                     )
-                    # stage 0 closes the loop through its embedding +
-                    # position lookup immediately (per microbatch), so
-                    # no O(M) cotangent buffer survives the scan
+                    # global chunk 0 closes the loop through its
+                    # embedding + position lookup immediately (per
+                    # microbatch) — no O(M) cotangent buffer
                     x_i = lax.dynamic_index_in_dim(x_mb, i, 0, False)
                     _, evjp = jax.vjp(
                         lambda r: r["embed"][x_i] + r["pos"][:t_len], rest
                     )
-                    (g_emb,) = evjp(jnp.where(s == 0, ct_in, 0.0))
+                    (g_emb,) = evjp(
+                        jnp.where((s == 0) & (cl == 0), ct_in, 0.0)
+                    )
+                    gb = jax.tree.map(
+                        lambda a, g: lax.dynamic_update_index_in_dim(
+                            a,
+                            lax.dynamic_index_in_dim(a, cl, 0, False) + g,
+                            cl, 0,
+                        ),
+                        c["gb"], g_chunk,
+                    )
                     return {
                         **c,
-                        "gb": jax.tree.map(
-                            lambda a, g: a + g, c["gb"], g_blocks
-                        ),
+                        "gb": gb,
                         "gr": jax.tree.map(
                             lambda a, gh, ge: a + gh + ge,
                             c["gr"], g_head, g_emb,
@@ -476,9 +637,12 @@ class PipelineParallelTrainer:
                 ), None
 
             c = lax.scan(tick, carry0, jnp.arange(tabs["ticks"]))[0]
-            return c["loss"], {"blocks": c["gb"], "rest": c["gr"]}
+            gb = jax.tree.map(
+                lambda a: a.reshape(v * Kc, *a.shape[2:]), c["gb"]
+            )
+            return c["loss"], {"blocks": gb, "rest": c["gr"]}
 
-        if schedule == "1f1b":
+        if schedule in ("1f1b", "interleaved"):
             loss_and_grads = loss_and_grads_1f1b
         else:
             def loss_and_grads(params, x, y):
@@ -518,20 +682,27 @@ class PipelineParallelTrainer:
         self._dp_axis = dp_axis
 
         def eval_step(params, x, y):
-            """Global (correct-token count, CE sum): the pipelined
-            forward's logits exist only on the last stage — other
-            stages' zeros are masked OUT of the counts, then psum
-            makes the result world-visible."""
-            s = lax.axis_index("pp")
-            logits = forward(params, x).astype(jnp.float32)
+            """Global (correct-token count, CE sum), schedule-agnostic:
+            all-gather the stage-sharded layer stack, undo the storage
+            permutation, and run the plain unpipelined forward on every
+            device (eval pays the gather, never the schedule). Results
+            are pp-replicated, so only dp needs a psum."""
+            blocks_full = jax.tree.map(
+                lambda a: lax.all_gather(a, "pp", tiled=True),
+                params["blocks"],
+            )
+            logits = reference_apply(
+                self._unpermute(
+                    {"blocks": blocks_full, "rest": params["rest"]}
+                ),
+                x, num_heads,
+            ).astype(jnp.float32)
             correct = jnp.sum(jnp.argmax(logits, -1) == y)
             logp = jax.nn.log_softmax(logits, axis=-1)
             ce_sum = -jnp.take_along_axis(logp, y[..., None], -1).sum()
-            correct = jnp.where(s == S - 1, correct, 0)
-            ce_sum = jnp.where(s == S - 1, ce_sum, 0.0)
-            correct = lax.psum(lax.psum(correct, "pp"), dp_axis)
-            ce_sum = lax.psum(lax.psum(ce_sum, "pp"), dp_axis)
-            return correct, ce_sum
+            return (
+                lax.psum(correct, dp_axis), lax.psum(ce_sum, dp_axis)
+            )
 
         self._eval = jax.jit(
             jax.shard_map(
@@ -544,15 +715,27 @@ class PipelineParallelTrainer:
         )
 
         # unpipelined per-sample loss on the same params — the bench's
-        # analytic FLOP counter traces this (host-side, never compiled)
+        # analytic FLOP counter traces this (host-side, never compiled);
+        # undoes the interleaved storage permutation first
         def _flat_loss(params, x, y):
-            logits = reference_apply(params, x, num_heads).astype(
-                jnp.float32
-            )
+            logits = reference_apply(
+                self._unpermute(params), x, num_heads
+            ).astype(jnp.float32)
             logp = jax.nn.log_softmax(logits, axis=-1)
             return -jnp.take_along_axis(logp, y[..., None], -1).mean()
 
         self.loss_fn = _flat_loss
+
+    def _unpermute(self, params: dict) -> dict:
+        """Params with blocks in GLOBAL layer order (no-op unless the
+        interleaved storage permutation is active)."""
+        if not self._permuted:
+            return params
+        inv = jnp.asarray(self._inv_perm)
+        return {
+            "blocks": jax.tree.map(lambda a: a[inv], params["blocks"]),
+            "rest": params["rest"],
+        }
 
     @property
     def ticks(self) -> int:
@@ -562,18 +745,37 @@ class PipelineParallelTrainer:
         transposed backward of the same length. 1F1B: one unified
         timeline of ``2(M+S−1)`` ticks carrying both directions — equal
         bubble, O(S) instead of O(M) saved microbatch activations.
+        Interleaved: ticks are CHUNK units, each ``1/virtual`` of a
+        stage's per-microbatch work — compare ``ticks / virtual`` against
+        the other schedules' stage-ticks.
         """
-        if self.schedule == "1f1b":
-            return int(schedule_1f1b(self.n_micro, self.pp)["ticks"])
+        if self.schedule in ("1f1b", "interleaved"):
+            return int(
+                schedule_pipeline(
+                    self.n_micro, self.pp, self.virtual
+                )["ticks"]
+            )
         return self.n_micro + self.pp - 1
 
     def init_state(self, rng, sample_x=None) -> dict:
         """``sample_x`` is accepted (and ignored — shapes come from the
-        constructor) so every trainer shares one init_state signature."""
+        constructor) so every trainer shares one init_state signature.
+
+        Interleaved: the globally-ordered stacked layers are row-permuted
+        into chunk storage order before sharding (checkpoints carry this
+        layout — restore with the same schedule/virtual config)."""
         params = init_params(
             rng, self.vocab_size, self.num_layers, self.d_model,
             self.d_ff, self.seq_len, num_heads=self.num_heads,
         )
+        if self._permuted:
+            perm = jnp.asarray(self._perm)
+            params = {
+                "blocks": jax.tree.map(
+                    lambda a: a[perm], params["blocks"]
+                ),
+                "rest": params["rest"],
+            }
         state = {
             "params": params,
             "momentum": jax.tree.map(jnp.zeros_like, params),

@@ -230,6 +230,7 @@ def build_trainer(cfg: TrainConfig, model, opt, topo):
             lr=cfg.lr,
             momentum=cfg.momentum,
             schedule=cfg.pp_schedule,
+            virtual=cfg.pp_virtual,
         )
     raise ValueError(f"unknown algo {cfg.algo!r}")
 
@@ -271,6 +272,53 @@ def _world_for(cfg: TrainConfig):
             axis_names=("dp", ax), mesh_shape=(n // extent, extent)
         )
     return mpit_tpu.init()
+
+
+def _check_resume_layout(cfg: TrainConfig) -> None:
+    """Refuse a resume whose checkpoint was written under a different
+    param LAYOUT. The pipeline stores its layer stack chunk-permuted
+    under interleaving, and a different pp extent re-shards the stack —
+    shapes match either way, so from_bytes would happily load layers in
+    the wrong order and train a silently-wrong model."""
+    import json as _json
+    import os as _os
+
+    from mpit_tpu.utils import latest_checkpoint
+
+    step = latest_checkpoint(cfg.ckpt_dir)
+    if step is None:
+        return
+    meta_path = _os.path.join(cfg.ckpt_dir, f"ckpt_{step:08d}.json")
+    if not _os.path.exists(meta_path):
+        return
+    saved = _json.loads(
+        _json.load(open(meta_path)).get("config", "{}")
+    )
+    if saved.get("algo") != cfg.algo:
+        return  # cross-algo restore fails on structure already
+    if cfg.algo != "pp-sync":
+        return
+    fields = ["pp", "layers", "pp_schedule"]
+    if "interleaved" in (saved.get("pp_schedule"), cfg.pp_schedule):
+        fields.append("pp_virtual")  # only interleaving reads it
+    mismatched = {
+        f: (saved.get(f), getattr(cfg, f))
+        for f in fields
+        if f in saved and saved.get(f) != getattr(cfg, f)
+    }
+    # interleaving is what permutes storage: gpipe and 1f1b share the
+    # identity layout, so flipping between those two is fine
+    if set(mismatched) == {"pp_schedule"} and "interleaved" not in (
+        saved.get("pp_schedule"), cfg.pp_schedule
+    ):
+        return
+    if mismatched:
+        raise ValueError(
+            f"resume layout mismatch: checkpoint in {cfg.ckpt_dir!r} was "
+            f"written with {mismatched} (saved, requested) — the pipeline "
+            "param layout depends on these; restore with the original "
+            "config or start fresh"
+        )
 
 
 def run(cfg: TrainConfig) -> dict:
@@ -320,6 +368,7 @@ def run(cfg: TrainConfig) -> dict:
 
     start_unit = 0
     if cfg.resume and cfg.ckpt_dir:
+        _check_resume_layout(cfg)
         template = state
         shardings = jax.tree.map(lambda a: a.sharding, template)
         state, step = restore_checkpoint(cfg.ckpt_dir, template,

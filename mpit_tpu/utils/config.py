@@ -28,8 +28,8 @@ class TrainConfig:
     # asserts momentum > 0; seq-sync = sync DP over a 2-D dp x sp mesh
     # with sequence-parallel ring attention; moe-sync = sync DP with the
     # transformer's MoE experts sharded over the worker axis; pp-sync =
-    # pipeline parallelism over a dp x pp mesh, --pp-schedule gpipe|1f1b
-    # — all three transformer only)
+    # pipeline parallelism over a dp x pp mesh, --pp-schedule
+    # gpipe|1f1b|interleaved — all three transformer only)
     algo: str = "easgd"
     # optimization (reference conf table: lr, τ, α — SURVEY.md §5)
     lr: float = 0.05
@@ -68,10 +68,13 @@ class TrainConfig:
     # (num_devices // sp) x sp — batch axis "dp", sequence axis "sp")
     sp: int = 1
     # pp-sync only: pipeline extent (stages; mesh (num_devices // pp) x pp),
-    # microbatches per step, and the schedule (gpipe | 1f1b)
+    # microbatches per step, the schedule (gpipe | 1f1b | interleaved),
+    # and virtual chunks per stage (interleaved only; layers must divide
+    # by pp x pp-virtual)
     pp: int = 2
     n_micro: int = 4
     pp_schedule: str = "gpipe"
+    pp_virtual: int = 2
     # transformer depth (pp-sync needs layers % pp == 0)
     layers: int = 2
     # transformer dense-attention implementation: "xla" (fused dense) or

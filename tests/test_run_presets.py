@@ -175,6 +175,43 @@ class TestDriverPlumbing:
         b = (tmp_path / "b" / "ckpt_00000004.msgpack").read_bytes()
         assert a == b, "resumed state diverged from uninterrupted state"
 
+    def test_pp_sync_resume_matches_uninterrupted(self, tmp_path):
+        """The pipeline trainer's dict state checkpoints and resumes
+        bit-identically through the same driver path as TrainState
+        trainers."""
+        base = _cfg("ptb-transformer-pp", pp=4, layers=4, n_micro=2,
+                    train_size=64, global_batch=16, seq_len=32)
+        straight = run(dataclasses.replace(
+            base, epochs=2, ckpt_dir=str(tmp_path / "a")))
+        run(dataclasses.replace(base, epochs=1,
+                                ckpt_dir=str(tmp_path / "b")))
+        resumed = run(dataclasses.replace(
+            base, epochs=2, ckpt_dir=str(tmp_path / "b"), resume=True))
+        assert resumed["resumed_from"] == 4
+        assert straight["last_checkpoint"] == resumed["last_checkpoint"]
+        a = (tmp_path / "a" / "ckpt_00000008.msgpack").read_bytes()
+        b = (tmp_path / "b" / "ckpt_00000008.msgpack").read_bytes()
+        assert a == b, "resumed pipeline state diverged"
+
+    def test_pp_sync_resume_layout_mismatch_rejected(self, tmp_path):
+        """A checkpoint written under the interleaved (chunk-permuted)
+        layout must refuse to load into a differently-laid-out trainer
+        instead of silently training layers in the wrong order."""
+        base = _cfg("ptb-transformer-pp", pp=4, layers=8, n_micro=2,
+                    pp_schedule="interleaved", train_size=32,
+                    global_batch=16, seq_len=32,
+                    ckpt_dir=str(tmp_path / "ck"))
+        run(dataclasses.replace(base, epochs=1))
+        with pytest.raises(ValueError, match="layout mismatch"):
+            run(dataclasses.replace(
+                base, resume=True, epochs=2, pp_schedule="1f1b"))
+        with pytest.raises(ValueError, match="layout mismatch"):
+            run(dataclasses.replace(
+                base, resume=True, epochs=2, pp_virtual=1))
+        # the original config resumes fine
+        r = run(dataclasses.replace(base, resume=True, epochs=2))
+        assert r["resumed_from"] == 2
+
     def test_profile_trace(self, tmp_path):
         cfg = _cfg("mnist-easgd", train_size=256, global_batch=64, epochs=1,
                    profile_dir=str(tmp_path / "tr"))
