@@ -682,27 +682,45 @@ class PipelineParallelTrainer:
         self._dp_axis = dp_axis
 
         def eval_step(params, x, y):
-            """Global (correct-token count, CE sum), schedule-agnostic:
-            all-gather the stage-sharded layer stack, undo the storage
-            permutation, and run the plain unpipelined forward on every
-            device (eval pays the gather, never the schedule). Results
-            are pp-replicated, so only dp needs a psum."""
-            blocks_full = jax.tree.map(
-                lambda a: lax.all_gather(a, "pp", tiled=True),
-                params["blocks"],
-            )
-            logits = reference_apply(
-                self._unpermute(
-                    {"blocks": blocks_full, "rest": params["rest"]}
-                ),
-                x, num_heads,
-            ).astype(jnp.float32)
+            """Global (correct-token count, CE sum).
+
+            Identity-layout schedules (gpipe/1f1b) evaluate through the
+            pipelined forward — per-device memory stays O(L/S) layers,
+            the reason pipeline parallelism exists; logits live only on
+            the last stage, so its counts are masked in and psum-ed.
+            The interleaved layout instead all-gathers the stack and
+            undoes the chunk permutation (eval pays the gather; the
+            pipelined forward assumes contiguous storage)."""
+            if self._permuted:
+                blocks_full = jax.tree.map(
+                    lambda a: lax.all_gather(a, "pp", tiled=True),
+                    params["blocks"],
+                )
+                logits = reference_apply(
+                    self._unpermute(
+                        {"blocks": blocks_full, "rest": params["rest"]}
+                    ),
+                    x, num_heads,
+                ).astype(jnp.float32)
+                correct = jnp.sum(jnp.argmax(logits, -1) == y)
+                logp = jax.nn.log_softmax(logits, axis=-1)
+                ce_sum = -jnp.take_along_axis(
+                    logp, y[..., None], -1
+                ).sum()
+                return (
+                    lax.psum(correct, dp_axis),
+                    lax.psum(ce_sum, dp_axis),
+                )
+            s = lax.axis_index("pp")
+            logits = forward(params, x).astype(jnp.float32)
             correct = jnp.sum(jnp.argmax(logits, -1) == y)
             logp = jax.nn.log_softmax(logits, axis=-1)
             ce_sum = -jnp.take_along_axis(logp, y[..., None], -1).sum()
-            return (
-                lax.psum(correct, dp_axis), lax.psum(ce_sum, dp_axis)
-            )
+            correct = jnp.where(s == S - 1, correct, 0)
+            ce_sum = jnp.where(s == S - 1, ce_sum, 0.0)
+            correct = lax.psum(lax.psum(correct, "pp"), dp_axis)
+            ce_sum = lax.psum(lax.psum(ce_sum, "pp"), dp_axis)
+            return correct, ce_sum
 
         self._eval = jax.jit(
             jax.shard_map(

@@ -298,16 +298,19 @@ def _check_resume_layout(cfg: TrainConfig) -> None:
         return  # cross-algo restore fails on structure already
     if cfg.algo != "pp-sync":
         return
-    fields = ["pp", "layers", "pp_schedule"]
+    # only interleaving permutes storage: under gpipe/1f1b the stacked
+    # layers are globally ordered, so a different pp extent re-shards
+    # soundly on restore and a gpipe<->1f1b flip is layout-identical.
+    # layers always matters (it changes the array shapes — fail clearly
+    # here, not inside from_bytes).
+    fields = ["layers", "pp_schedule"]
     if "interleaved" in (saved.get("pp_schedule"), cfg.pp_schedule):
-        fields.append("pp_virtual")  # only interleaving reads it
+        fields += ["pp", "pp_virtual"]
     mismatched = {
         f: (saved.get(f), getattr(cfg, f))
         for f in fields
         if f in saved and saved.get(f) != getattr(cfg, f)
     }
-    # interleaving is what permutes storage: gpipe and 1f1b share the
-    # identity layout, so flipping between those two is fine
     if set(mismatched) == {"pp_schedule"} and "interleaved" not in (
         saved.get("pp_schedule"), cfg.pp_schedule
     ):

@@ -212,6 +212,17 @@ class TestDriverPlumbing:
         r = run(dataclasses.replace(base, resume=True, epochs=2))
         assert r["resumed_from"] == 2
 
+    def test_pp_sync_gpipe_resume_allows_pp_change(self, tmp_path):
+        """Identity-layout schedules store globally-ordered layers, so
+        restoring onto a different pp extent just re-shards — the
+        layout guard must not false-reject it."""
+        base = _cfg("ptb-transformer-pp", pp=4, layers=8, n_micro=2,
+                    train_size=32, global_batch=16, seq_len=32,
+                    ckpt_dir=str(tmp_path / "ck"))
+        run(dataclasses.replace(base, epochs=1))
+        r = run(dataclasses.replace(base, resume=True, epochs=2, pp=2))
+        assert r["resumed_from"] == 2 and r["trained_units"] == 2
+
     def test_profile_trace(self, tmp_path):
         cfg = _cfg("mnist-easgd", train_size=256, global_batch=64, epochs=1,
                    profile_dir=str(tmp_path / "tr"))
