@@ -114,6 +114,38 @@ def _kernel(
         o_ref[0] = out.astype(o_ref.dtype)
 
 
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
+def _flash(q, k, v, causal, block_q, block_k, interpret):
+    """Differentiable wrapper: pallas forward, exact recompute backward.
+
+    ``pallas_call`` has no automatic VJP, so training through the kernel
+    needs one. The backward currently recomputes through
+    :func:`dense_attention`'s VJP — mathematically exact (the kernel
+    computes the identical function, proven by the equivalence tests),
+    but it materializes the (T, T) scores, so flash's memory saving
+    applies to the forward/inference path only for now; a pallas
+    backward kernel (the standard dq/dk/dv two-pass recipe) is the
+    follow-up once a TPU measurement justifies it.
+    """
+    return _flash_pallas(q, k, v, causal, block_q, block_k, interpret)
+
+
+def _flash_fwd(q, k, v, causal, block_q, block_k, interpret):
+    return _flash(q, k, v, causal, block_q, block_k, interpret), (q, k, v)
+
+
+def _flash_bwd(causal, block_q, block_k, interpret, res, ct):
+    q, k, v = res
+    _, vjp = jax.vjp(
+        lambda q_, k_, v_: dense_attention(q_, k_, v_, causal=causal),
+        q, k, v,
+    )
+    return vjp(ct)
+
+
+_flash.defvjp(_flash_fwd, _flash_bwd)
+
+
 @functools.partial(
     jax.jit,
     static_argnames=("causal", "block_q", "block_k", "interpret"),
@@ -161,6 +193,12 @@ def flash_attention(
 
     ``use_pallas``: True = require the kernel (interpret mode off TPU),
     False = XLA dense attention, None = kernel on TPU, XLA elsewhere.
+
+    TRAINING CAVEAT: the backward pass is an exact dense-attention
+    recompute (``pallas_call`` has no auto-VJP), so under ``jax.grad``
+    the (T, T) score matrix still materializes and the forward runs
+    twice — the kernel's VMEM tiling pays off for inference/eval today;
+    a pallas backward kernel is the follow-up.
     Falls back to dense whenever ``T`` does not tile cleanly — blocks
     clamp to ``T`` for short sequences, but a clamped block must still
     be sublane-aligned (a multiple of 8) and divide ``T`` — exactness
@@ -178,4 +216,4 @@ def flash_attention(
     if not use_pallas or not tiles:
         return dense_attention(q, k, v, causal=causal)
     interpret = not pallas_supported()
-    return _flash_pallas(q, k, v, causal, block_q, block_k, interpret)
+    return _flash(q, k, v, causal, block_q, block_k, interpret)
