@@ -39,10 +39,8 @@ class Block(nn.Module):
     moe_capacity_factor: float = 2.0
     moe_top_k: int = 1
     # single-device attention implementation: "xla" (fused dense),
-    # "flash" (pallas kernel on TPU, dense elsewhere), "flash_force"
-    # (pallas everywhere — interpret mode off TPU; tests). NOTE: flash's
-    # backward is currently a dense recompute (ops/flash_attention), so
-    # its memory win applies to forward/eval, not yet training
+    # "flash" (pallas kernels both directions on TPU, dense elsewhere),
+    # "flash_force" (pallas everywhere — interpret mode off TPU; tests)
     attn_impl: str = "xla"
 
     @nn.compact

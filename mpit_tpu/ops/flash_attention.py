@@ -51,8 +51,31 @@ _NEG_INF = float("-inf")
 _LANE = 128
 
 
+
+def _apply_causal(s, q_off, k_off, q_axis: int):
+    """Mask score tile entries where k_pos > q_pos (global positions);
+    ``q_axis`` names the tile dimension the query positions vary along
+    (0 in the q-major kernels, 1 in the transposed dK/dV kernel). The
+    ONE copy of the mask for forward and both backward kernels."""
+    q_pos = q_off + jax.lax.broadcasted_iota(jnp.int32, s.shape, q_axis)
+    k_pos = k_off + jax.lax.broadcasted_iota(
+        jnp.int32, s.shape, 1 - q_axis
+    )
+    return jnp.where(k_pos <= q_pos, s, _NEG_INF)
+
+
+def _to2d(a):
+    """(B, T, H, D) -> (B·H, T, D), the kernels' layout."""
+    b, t, h, d = a.shape
+    return a.transpose(0, 2, 1, 3).reshape(b * h, t, d)
+
+
+def _from2d(a, b: int, h: int, t: int, d: int):
+    return a.reshape(b, h, t, d).transpose(0, 2, 1, 3)
+
+
 def _kernel(
-    q_ref, k_ref, v_ref, o_ref, m_scr, l_scr, acc_scr,
+    q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr,
     *, scale, causal, block_q, block_k, n_k,
 ):
     i = pl.program_id(1)
@@ -80,13 +103,7 @@ def _kernel(
             preferred_element_type=jnp.float32,
         ) * scale  # (block_q, block_k)
         if causal:
-            q_pos = i * block_q + jax.lax.broadcasted_iota(
-                jnp.int32, (block_q, block_k), 0
-            )
-            k_pos = j * block_k + jax.lax.broadcasted_iota(
-                jnp.int32, (block_q, block_k), 1
-            )
-            s = jnp.where(k_pos <= q_pos, s, _NEG_INF)
+            s = _apply_causal(s, i * block_q, j * block_k, 0)
         m_prev = m_scr[:][:, :1]  # (block_q, 1) of the broadcast store
         l_prev = l_scr[:][:, :1]
         block_max = jnp.max(s, axis=-1, keepdims=True)
@@ -112,35 +129,215 @@ def _kernel(
         l = l_scr[:][:, :1]
         out = acc_scr[:] / jnp.maximum(l, jnp.finfo(jnp.float32).tiny)
         o_ref[0] = out.astype(o_ref.dtype)
+        m = m_scr[:][:, :1]
+        # log-sum-exp per query row: P_ij = exp(s_ij - lse_i) in the
+        # backward. A row with no unmasked key gets +inf (P row = 0).
+        lse = jnp.where(
+            l > 0.0, jnp.where(jnp.isneginf(m), 0.0, m) + jnp.log(
+                jnp.maximum(l, jnp.finfo(jnp.float32).tiny)
+            ),
+            jnp.inf,
+        )
+        lse_ref[0] = lse[:, 0]
+
+
+def _dq_kernel(
+    q_ref, k_ref, v_ref, do_ref, lse_ref, dd_ref, dq_ref, acc_scr,
+    *, scale, causal, block_q, block_k, n_k,
+):
+    """dQ_i = scale · Σ_j dS_ij K_j with dS = P ∘ (dP − D); grid
+    (B·H, q-block, k-block-innermost), accumulating in VMEM scratch."""
+    i = pl.program_id(1)
+    j = pl.program_id(2)
+
+    @pl.when(j == 0)
+    def _init():
+        acc_scr[:] = jnp.zeros_like(acc_scr[:])
+
+    needed = (
+        j * block_k <= i * block_q + block_q - 1 if causal else j >= 0
+    )
+
+    @pl.when(needed)
+    def _update():
+        q = q_ref[0]
+        k = k_ref[0]
+        v = v_ref[0]
+        do = do_ref[0].astype(jnp.float32)
+        lse = lse_ref[0][:, None]  # (bq, 1)
+        dd = dd_ref[0][:, None]
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        ) * scale
+        if causal:
+            s = _apply_causal(s, i * block_q, j * block_k, 0)
+        p = jnp.exp(s - lse)  # rows with lse=+inf go to 0
+        dp = jax.lax.dot_general(
+            do, v.astype(jnp.float32), (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        ds = p * (dp - dd)
+        acc_scr[:] += jax.lax.dot_general(
+            ds, k.astype(jnp.float32), (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        ) * scale
+
+    @pl.when(j == n_k - 1)
+    def _finalize():
+        dq_ref[0] = acc_scr[:].astype(dq_ref.dtype)
+
+
+def _dkv_kernel(
+    q_ref, k_ref, v_ref, do_ref, lse_ref, dd_ref, dk_ref, dv_ref,
+    dk_scr, dv_scr, *, scale, causal, block_q, block_k, n_q,
+):
+    """dK_j = scale · Σ_i dSᵀ_ji Q_i and dV_j = Σ_i Pᵀ_ji dO_i; grid
+    (B·H, k-block, q-block-innermost)."""
+    j = pl.program_id(1)
+    i = pl.program_id(2)
+
+    @pl.when(i == 0)
+    def _init():
+        dk_scr[:] = jnp.zeros_like(dk_scr[:])
+        dv_scr[:] = jnp.zeros_like(dv_scr[:])
+
+    # causal: a q-block entirely ABOVE this k-block contributes nothing
+    needed = (
+        i * block_q + block_q - 1 >= j * block_k if causal else i >= 0
+    )
+
+    @pl.when(needed)
+    def _update():
+        q = q_ref[0]
+        k = k_ref[0]
+        v = v_ref[0]
+        do = do_ref[0].astype(jnp.float32)
+        lse = lse_ref[0][None, :]  # (1, bq)
+        dd = dd_ref[0][None, :]
+        st = jax.lax.dot_general(
+            k, q, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        ) * scale  # (bk, bq) = sᵀ
+        if causal:
+            st = _apply_causal(st, i * block_q, j * block_k, 1)
+        pt = jnp.exp(st - lse)
+        dv_scr[:] += jax.lax.dot_general(
+            pt, do, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        dpt = jax.lax.dot_general(
+            v.astype(jnp.float32), do, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        dst = pt * (dpt - dd)
+        dk_scr[:] += jax.lax.dot_general(
+            dst, q.astype(jnp.float32), (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        ) * scale
+
+    @pl.when(i == n_q - 1)
+    def _finalize():
+        dk_ref[0] = dk_scr[:].astype(dk_ref.dtype)
+        dv_ref[0] = dv_scr[:].astype(dv_ref.dtype)
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("causal", "block_q", "block_k", "interpret"),
+)
+def _flash_pallas_bwd(q, k, v, out, lse, ct, causal, block_q, block_k,
+                      interpret):
+    b, t, h, d = q.shape
+    scale = 1.0 / (d ** 0.5)
+    q2, k2, v2 = _to2d(q), _to2d(k), _to2d(v)
+    do2 = _to2d(ct)
+    o2 = _to2d(out)
+    # D_i = Σ_d dO_id · O_id — cheap elementwise+reduce, XLA's job
+    dd = jnp.sum(
+        do2.astype(jnp.float32) * o2.astype(jnp.float32), -1
+    )  # (BH, T)
+    n_q, n_k = t // block_q, t // block_k
+
+    q_spec = lambda ax: pl.BlockSpec(
+        (1, block_q, d), lambda bh, a, b_: (bh, a if ax == 1 else b_, 0)
+    )
+    row_spec = lambda ax: pl.BlockSpec(
+        (1, block_q), lambda bh, a, b_: (bh, a if ax == 1 else b_)
+    )
+    kv_spec = lambda ax: pl.BlockSpec(
+        (1, block_k, d), lambda bh, a, b_: (bh, a if ax == 1 else b_, 0)
+    )
+
+    dq = pl.pallas_call(
+        functools.partial(
+            _dq_kernel, scale=scale, causal=causal,
+            block_q=block_q, block_k=block_k, n_k=n_k,
+        ),
+        grid=(b * h, n_q, n_k),
+        in_specs=[
+            q_spec(1), kv_spec(2), kv_spec(2), q_spec(1),
+            row_spec(1), row_spec(1),
+        ],
+        out_specs=q_spec(1),
+        out_shape=jax.ShapeDtypeStruct((b * h, t, d), q.dtype),
+        scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32)],
+        interpret=interpret,
+    )(q2, k2, v2, do2, lse, dd)
+
+    dk, dv = pl.pallas_call(
+        functools.partial(
+            _dkv_kernel, scale=scale, causal=causal,
+            block_q=block_q, block_k=block_k, n_q=n_q,
+        ),
+        grid=(b * h, n_k, n_q),
+        in_specs=[
+            q_spec(2), kv_spec(1), kv_spec(1), q_spec(2),
+            row_spec(2), row_spec(2),
+        ],
+        out_specs=[kv_spec(1), kv_spec(1)],
+        out_shape=[
+            jax.ShapeDtypeStruct((b * h, t, d), k.dtype),
+            jax.ShapeDtypeStruct((b * h, t, d), v.dtype),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((block_k, d), jnp.float32),
+            pltpu.VMEM((block_k, d), jnp.float32),
+        ],
+        interpret=interpret,
+    )(q2, k2, v2, do2, lse, dd)
+
+    return (
+        _from2d(dq, b, h, t, d),
+        _from2d(dk, b, h, t, d),
+        _from2d(dv, b, h, t, d),
+    )
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
 def _flash(q, k, v, causal, block_q, block_k, interpret):
-    """Differentiable wrapper: pallas forward, exact recompute backward.
+    """Differentiable flash attention: pallas kernels both directions.
 
-    ``pallas_call`` has no automatic VJP, so training through the kernel
-    needs one. The backward currently recomputes through
-    :func:`dense_attention`'s VJP — mathematically exact (the kernel
-    computes the identical function, proven by the equivalence tests),
-    but it materializes the (T, T) scores, so flash's memory saving
-    applies to the forward/inference path only for now; a pallas
-    backward kernel (the standard dq/dk/dv two-pass recipe) is the
-    follow-up once a TPU measurement justifies it.
+    ``pallas_call`` has no automatic VJP; the backward here is the
+    standard FlashAttention recipe — recompute P from the saved
+    log-sum-exp, never materializing more than a (block, block) score
+    tile: a dQ kernel (q-blocks outer, k-blocks inner) and a fused
+    dK/dV kernel (k-blocks outer, q-blocks inner), with the D = rowsum
+    (dO ∘ O) vector computed by XLA outside.
     """
-    return _flash_pallas(q, k, v, causal, block_q, block_k, interpret)
+    return _flash_pallas(q, k, v, causal, block_q, block_k, interpret)[0]
 
 
 def _flash_fwd(q, k, v, causal, block_q, block_k, interpret):
-    return _flash(q, k, v, causal, block_q, block_k, interpret), (q, k, v)
+    out, lse = _flash_pallas(q, k, v, causal, block_q, block_k, interpret)
+    return out, (q, k, v, out, lse)
 
 
 def _flash_bwd(causal, block_q, block_k, interpret, res, ct):
-    q, k, v = res
-    _, vjp = jax.vjp(
-        lambda q_, k_, v_: dense_attention(q_, k_, v_, causal=causal),
-        q, k, v,
+    q, k, v, out, lse = res
+    return _flash_pallas_bwd(
+        q, k, v, out, lse, ct, causal, block_q, block_k, interpret
     )
-    return vjp(ct)
 
 
 _flash.defvjp(_flash_fwd, _flash_bwd)
@@ -153,23 +350,26 @@ _flash.defvjp(_flash_fwd, _flash_bwd)
 def _flash_pallas(q, k, v, causal, block_q, block_k, interpret):
     b, t, h, d = q.shape
     scale = 1.0 / (d ** 0.5)
-    to2d = lambda a: a.transpose(0, 2, 1, 3).reshape(b * h, t, d)
-    q2, k2, v2 = to2d(q), to2d(k), to2d(v)
+    q2, k2, v2 = _to2d(q), _to2d(k), _to2d(v)
     n_q, n_k = t // block_q, t // block_k
 
     q_spec = pl.BlockSpec((1, block_q, d), lambda bh, i, j: (bh, i, 0))
     kv_spec = pl.BlockSpec((1, block_k, d), lambda bh, i, j: (bh, j, 0))
-    out = pl.pallas_call(
+    out, lse = pl.pallas_call(
         functools.partial(
             _kernel, scale=scale, causal=causal,
             block_q=block_q, block_k=block_k, n_k=n_k,
         ),
         grid=(b * h, n_q, n_k),
         in_specs=[q_spec, kv_spec, kv_spec],
-        out_specs=pl.BlockSpec(
-            (1, block_q, d), lambda bh, i, j: (bh, i, 0)
-        ),
-        out_shape=jax.ShapeDtypeStruct((b * h, t, d), q.dtype),
+        out_specs=[
+            pl.BlockSpec((1, block_q, d), lambda bh, i, j: (bh, i, 0)),
+            pl.BlockSpec((1, block_q), lambda bh, i, j: (bh, i)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((b * h, t, d), q.dtype),
+            jax.ShapeDtypeStruct((b * h, t), jnp.float32),
+        ],
         scratch_shapes=[
             pltpu.VMEM((block_q, _LANE), jnp.float32),  # running max m
             pltpu.VMEM((block_q, _LANE), jnp.float32),  # normalizer l
@@ -177,7 +377,7 @@ def _flash_pallas(q, k, v, causal, block_q, block_k, interpret):
         ],
         interpret=interpret,
     )(q2, k2, v2)
-    return out.reshape(b, h, t, d).transpose(0, 2, 1, 3)
+    return _from2d(out, b, h, t, d), lse
 
 
 def flash_attention(
@@ -194,11 +394,10 @@ def flash_attention(
     ``use_pallas``: True = require the kernel (interpret mode off TPU),
     False = XLA dense attention, None = kernel on TPU, XLA elsewhere.
 
-    TRAINING CAVEAT: the backward pass is an exact dense-attention
-    recompute (``pallas_call`` has no auto-VJP), so under ``jax.grad``
-    the (T, T) score matrix still materializes and the forward runs
-    twice — the kernel's VMEM tiling pays off for inference/eval today;
-    a pallas backward kernel is the follow-up.
+    Fully trainable: the custom VJP runs the standard FlashAttention
+    backward as pallas kernels too (P recomputed from the saved
+    log-sum-exp; dQ and fused dK/dV passes), so no (T, T) score matrix
+    materializes in either direction.
     Falls back to dense whenever ``T`` does not tile cleanly — blocks
     clamp to ``T`` for short sequences, but a clamped block must still
     be sublane-aligned (a multiple of 8) and divide ``T`` — exactness

@@ -68,10 +68,22 @@ class TestFlashAttention:
             np.asarray(got), np.asarray(want), rtol=1e-6, atol=1e-6
         )
 
-    def test_gradients_match_dense(self):
-        """Training through the kernel: the custom VJP must produce the
-        same q/k/v gradients as differentiating dense attention."""
-        q, k, v = _qkv(t=128, seed=5)
+    @pytest.mark.parametrize(
+        "t,blocks,causal,dtype",
+        [
+            (128, 128, True, jnp.float32),   # single block
+            (256, 128, True, jnp.float32),   # multi-block + skip logic
+            (256, 128, False, jnp.float32),  # full attention
+            (128, 32, True, jnp.float32),    # many tiny blocks
+            (256, 128, True, jnp.bfloat16),  # reduced-precision inputs
+        ],
+    )
+    def test_gradients_match_dense(self, t, blocks, causal, dtype):
+        """Training through the kernel: the custom VJP (pallas dQ and
+        dK/dV kernels) must produce the same q/k/v gradients as
+        differentiating dense attention."""
+        q, k, v = _qkv(t=t, dtype=dtype, seed=5)
+        tol = 2e-5 if dtype == jnp.float32 else 3e-2
 
         def loss(fn):
             return lambda q_, k_, v_: (
@@ -80,17 +92,19 @@ class TestFlashAttention:
 
         g_flash = jax.grad(
             loss(lambda a, b, c: flash_attention(
-                a, b, c, causal=True, use_pallas=True
+                a, b, c, causal=causal, block_q=blocks, block_k=blocks,
+                use_pallas=True,
             )),
             argnums=(0, 1, 2),
         )(q, k, v)
         g_dense = jax.grad(
-            loss(lambda a, b, c: dense_attention(a, b, c, causal=True)),
+            loss(lambda a, b, c: dense_attention(a, b, c, causal=causal)),
             argnums=(0, 1, 2),
         )(q, k, v)
         for gf, gd in zip(g_flash, g_dense):
             np.testing.assert_allclose(
-                np.asarray(gf), np.asarray(gd), rtol=2e-5, atol=2e-5
+                np.asarray(gf, np.float32), np.asarray(gd, np.float32),
+                rtol=tol, atol=tol,
             )
 
     def test_training_step_matches_xla(self):
