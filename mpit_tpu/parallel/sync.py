@@ -43,7 +43,16 @@ class DataParallelTrainer:
         topo: Optional[Topology] = None,
         loss_fn: Optional[Callable] = None,
         donate_state: bool = True,
+        accum_steps: int = 1,
     ):
+        """``accum_steps``: gradient accumulation — each step's local
+        batch is processed as that many sequential slices (``lax.scan``)
+        whose gradients average before the one optimizer update. The
+        math is EXACTLY the full-batch step (equal slice sizes, mean
+        losses, and no model here carries batch statistics — GroupNorm/
+        LayerNorm only), so it trades step latency for peak activation
+        memory: effective batch B needs only B/accum_steps of forward
+        state in HBM at once."""
         self.model = model
         self.optimizer = optimizer
         self.topo = topo if topo is not None else _current_topology()
@@ -52,11 +61,37 @@ class DataParallelTrainer:
             if loss_fn is not None
             else common.default_loss_fn(model.apply)
         )
+        accum = int(accum_steps)
+        if accum < 1:
+            raise ValueError(f"accum_steps={accum_steps} must be >= 1")
+        self.accum_steps = accum
         axis = self.topo.worker_axis
         mesh = self.topo.mesh
 
+        def local_loss_grads(params, x, y):
+            if accum == 1:
+                return jax.value_and_grad(self.loss_fn)(params, x, y)
+            xs = x.reshape(accum, x.shape[0] // accum, *x.shape[1:])
+            ys = y.reshape(accum, y.shape[0] // accum, *y.shape[1:])
+
+            def fold(carry, xy):
+                loss_acc, g_acc = carry
+                l, g = jax.value_and_grad(self.loss_fn)(params, *xy)
+                return (
+                    loss_acc + l,
+                    jax.tree.map(jnp.add, g_acc, g),
+                ), None
+
+            (loss, grads), _ = jax.lax.scan(
+                fold,
+                (jnp.float32(0.0),
+                 jax.tree.map(jnp.zeros_like, params)),
+                (xs, ys),
+            )
+            return loss / accum, jax.tree.map(lambda g: g / accum, grads)
+
         def train_step(state: common.TrainState, x, y):
-            loss, grads = jax.value_and_grad(self.loss_fn)(state.params, x, y)
+            loss, grads = local_loss_grads(state.params, x, y)
             # the one collective of the step: grad average over workers
             grads = jax.lax.pmean(grads, axis)
             loss = jax.lax.pmean(loss, axis)
@@ -109,9 +144,19 @@ class DataParallelTrainer:
             state, self.topo.replicated_sharding()
         )
 
+    def _check(self, x) -> None:
+        w = self.topo.num_workers
+        common.check_global_batch(len(x), w)
+        if (len(x) // w) % self.accum_steps:
+            raise ValueError(
+                f"per-worker batch {len(x) // w} not divisible by "
+                f"accum_steps={self.accum_steps}"
+            )
+
     def step(self, state, x_global, y_global):
-        """One sync-DP step on a global batch (leading dim divisible by W)."""
-        common.check_global_batch(len(x_global), self.topo.num_workers)
+        """One sync-DP step on a global batch (leading dim divisible by W,
+        per-worker shard divisible by accum_steps)."""
+        self._check(x_global)
         state, metrics = self._step(state, x_global, y_global)
         common.bound_cpu_dispatch(self.topo, metrics)
         return state, metrics
@@ -137,11 +182,10 @@ class DataParallelTrainer:
         """Epoch loop over a :class:`mpit_tpu.data.Batches` — the shared
         :func:`common.synced_fit_loop` with the sync-DP sharding/check.
         Returns (state, last_metrics)."""
-        w = self.topo.num_workers
         return common.synced_fit_loop(
             self.topo, self._step, batches, state,
             sharding=self.topo.worker_sharding(),
-            check=lambda x: common.check_global_batch(len(x), w),
+            check=self._check,
             log_tag="sync-dp",
             epochs=epochs, log_every=log_every, start_epoch=start_epoch,
             skip_steps=skip_steps, on_step=on_step, prefetch=prefetch,

@@ -163,6 +163,14 @@ def build_trainer(cfg: TrainConfig, model, opt, topo):
             f"unknown exchange_dtype {cfg.exchange_dtype!r}; have: none, bf16"
         )
     algo = cfg.resolved_algo()
+    if cfg.grad_accum > 1 and algo != "sync":
+        import warnings
+
+        warnings.warn(
+            f"grad_accum={cfg.grad_accum} applies to algo='sync' only; "
+            f"algo={cfg.algo!r} runs without accumulation",
+            stacklevel=2,
+        )
     if cfg.exchange_dtype != "none" and algo != "easgd":
         import warnings
 
@@ -182,7 +190,8 @@ def build_trainer(cfg: TrainConfig, model, opt, topo):
         return DownpourTrainer(model, opt, topo, tau=cfg.tau,
                                staleness=cfg.staleness)
     if algo == "sync":
-        return DataParallelTrainer(model, opt, topo)
+        return DataParallelTrainer(model, opt, topo,
+                                   accum_steps=cfg.grad_accum)
     if algo == "seq-sync":
         return SeqParallelTrainer(model, opt, topo)
     if algo == "moe-sync":

@@ -77,6 +77,10 @@ class TrainConfig:
     pp_virtual: int = 2
     # transformer depth (pp-sync needs layers % pp == 0)
     layers: int = 2
+    # sync only: gradient accumulation — per-worker batch processed as
+    # this many sequential slices, one optimizer update (exact math; no
+    # model here has batch statistics). Memory knob for big batches.
+    grad_accum: int = 1
     # transformer dense-attention implementation: "xla" (fused dense) or
     # "flash" (pallas tiled kernel on TPU; dense elsewhere) — the kernel
     # stays opt-in until its TPU measurement lands (ops/flash_attention)

@@ -50,6 +50,44 @@ def test_grad_averaging_matches_single_worker(topo8):
     )
 
 
+def test_grad_accumulation_matches_full_batch(topo8):
+    """accum_steps=4 on the same global batch must reproduce the
+    unaccumulated step exactly (equal slice sizes, mean losses, no batch
+    statistics in any model here) — accumulation is a memory knob, not a
+    math change."""
+    model = LeNet(compute_dtype=jnp.float32)
+    opt = optax.sgd(0.1, momentum=0.9)
+    rng = np.random.default_rng(1)
+    x = rng.uniform(0, 1, (64, 28, 28, 1)).astype(np.float32)
+    y = rng.integers(0, 10, 64).astype(np.int32)
+
+    results = {}
+    for accum in (1, 4):
+        tr = DataParallelTrainer(
+            model, opt, topo8, donate_state=False, accum_steps=accum
+        )
+        st = tr.init_state(jax.random.key(0), x[:2])
+        losses = []
+        for _ in range(3):
+            st, m = tr.step(st, x, y)
+            losses.append(float(m["loss"]))
+        results[accum] = (
+            losses, jax.tree.map(np.asarray, jax.device_get(st.params))
+        )
+    np.testing.assert_allclose(results[4][0], results[1][0], rtol=1e-5)
+    jax.tree.map(
+        lambda a, b: np.testing.assert_allclose(a, b, atol=2e-5),
+        results[4][1], results[1][1],
+    )
+    # divisibility: per-worker batch of 8 % accum 3 != 0
+    tr3 = DataParallelTrainer(
+        model, opt, topo8, donate_state=False, accum_steps=3
+    )
+    st3 = tr3.init_state(jax.random.key(0), x[:2])
+    with pytest.raises(ValueError, match="accum_steps"):
+        tr3.step(st3, x, y)
+
+
 def test_sync_dp_trains_mnist(topo8, mnist):
     x_tr, y_tr, x_te, y_te = mnist
     model = LeNet(compute_dtype=jnp.float32)
