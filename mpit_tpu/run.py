@@ -477,19 +477,22 @@ def _run_async_ps(cfg, model, opt, x_tr, y_tr, x_te, y_te, log, results):
     no-ops): ``profile_dir`` traces the whole async run; ``ckpt_dir`` writes
     a final center checkpoint; ``log_every`` logs the per-step client losses
     post-hoc (there is no global step during the run — clients are
-    asynchronous by design). ``resume``/``ckpt_every`` have no meaningful
-    mid-stream semantics here and WARN instead of silently ignoring."""
+    asynchronous by design). ``resume``/``ckpt_every``/``grad_accum``
+    have no meaning here and WARN instead of silently ignoring."""
     import warnings
 
     from mpit_tpu.parallel import AsyncPSTrainer
     from mpit_tpu.utils import save_checkpoint, trace
 
-    for flag in ("resume", "ckpt_every"):
-        if getattr(cfg, flag):
+    for flag, on in (
+        ("resume", cfg.resume),
+        ("ckpt_every", cfg.ckpt_every),
+        ("grad_accum", cfg.grad_accum > 1),
+    ):
+        if on:
             warnings.warn(
                 f"{flag!r} is not supported with algo={cfg.algo!r} "
-                "(async PS has no deterministic mid-stream schedule to "
-                "re-enter); ignoring",
+                "(async PS clients run their own local steps); ignoring",
                 stacklevel=3,
             )
     if cfg.exchange_dtype not in ("none", "bf16"):
