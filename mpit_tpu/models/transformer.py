@@ -26,6 +26,7 @@ import jax
 import jax.numpy as jnp
 
 from mpit_tpu.ops.ring_attention import dense_attention, ring_attention
+from mpit_tpu.ops.ulysses import ulysses_attention
 
 
 class Block(nn.Module):
@@ -42,6 +43,8 @@ class Block(nn.Module):
     # "flash" (pallas kernels both directions on TPU, dense elsewhere),
     # "flash_force" (pallas everywhere — interpret mode off TPU; tests)
     attn_impl: str = "xla"
+    # sequence-parallel scheme when seq_axis is set — see TransformerLM
+    seq_impl: str = "ring"
 
     @nn.compact
     def __call__(self, x):
@@ -52,7 +55,13 @@ class Block(nn.Module):
         q, k, v = jnp.split(qkv, 3, axis=-1)
         split = lambda a: a.reshape(*a.shape[:2], h, d)
         q, k, v = split(q), split(k), split(v)
-        if self.seq_axis is not None:
+        if self.seq_impl not in ("ring", "ulysses"):
+            raise ValueError(
+                f"seq_impl={self.seq_impl!r} must be 'ring' or 'ulysses'"
+            )
+        if self.seq_axis is not None and self.seq_impl == "ulysses":
+            att = ulysses_attention(q, k, v, self.seq_axis, causal=True)
+        elif self.seq_axis is not None:
             att = ring_attention(q, k, v, self.seq_axis, causal=True)
         elif self.attn_impl in ("flash", "flash_force"):
             from mpit_tpu.ops.flash_attention import flash_attention
@@ -199,6 +208,11 @@ class TransformerLM(nn.Module):
     moe_zloss_weight: float = 0.0
     # attention tiling for the dense (seq_axis=None) path — see Block
     attn_impl: str = "xla"
+    # sequence-parallel scheme when seq_axis is set: "ring" (K/V blocks
+    # rotate via ppermute — extreme T, no score matrix) or "ulysses"
+    # (all_to_all head<->sequence re-shard around dense attention —
+    # moderate T, needs num_heads % axis == 0). Both exact.
+    seq_impl: str = "ring"
 
     @nn.compact
     def __call__(self, tokens):
@@ -242,6 +256,7 @@ class TransformerLM(nn.Module):
                 moe_capacity_factor=self.moe_capacity_factor,
                 moe_top_k=self.moe_top_k,
                 attn_impl=self.attn_impl,
+                seq_impl=self.seq_impl,
                 name=f"Block_{i}",
             )(x)
         x = nn.LayerNorm(dtype=dt)(x)

@@ -23,3 +23,4 @@ from mpit_tpu.ops.moe import (  # noqa: F401
     moe_ffn,
     moe_ffn_dense_reference,
 )
+from mpit_tpu.ops.ulysses import ulysses_attention  # noqa: F401

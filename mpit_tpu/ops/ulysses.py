@@ -1,0 +1,68 @@
+"""Ulysses sequence parallelism: all-to-all head↔sequence re-sharding.
+
+The OTHER standard long-context scheme (DeepSpeed-Ulysses, arXiv:
+2309.14509), complementing ``ops/ring_attention.py``: instead of rotating
+K/V blocks around a ring (W ppermute hops, compute overlapped), Ulysses
+re-shards ONCE — an ``all_to_all`` converts the sequence-sharded
+``(B, T/P, H, D)`` activations into head-sharded ``(B, T, H/P, D)``,
+every device runs plain DENSE attention over the full sequence for its
+own heads, and the reverse ``all_to_all`` restores sequence sharding.
+
+Trade-off vs the ring (why both exist): Ulysses moves each element
+twice total in two balanced all-to-alls and computes attention with zero
+extra softmax bookkeeping, but requires ``H % P == 0`` and holds the
+full (T, T) per-head score matrix on one device — so the ring wins for
+EXTREME sequence lengths (scores never materialize), Ulysses for
+moderate T where the all-to-all is cheaper than W rotation steps. Both
+are exact; the tests pin both against the same dense reference.
+
+Positions are global automatically: after the first exchange every
+device sees the FULL sequence in ring order, so causal masking needs no
+rank offset.
+"""
+
+from __future__ import annotations
+
+import jax
+from jax import lax
+
+from mpit_tpu.ops.ring_attention import dense_attention
+
+
+def ulysses_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    axis_name: str,
+    causal: bool = False,
+) -> jax.Array:
+    """Exact sequence-parallel attention inside ``shard_map``.
+
+    Args: the LOCAL sequence shard ``(B, T_local, H, D)`` (contiguous
+    blocks in ring order, same contract as
+    :func:`~mpit_tpu.ops.ring_attention.ring_attention`); ``H`` must be
+    divisible by the axis extent. Returns the local shard of
+    ``softmax(QKᵀ/√D)V``, same shape/dtype as ``q``.
+    """
+    if q.ndim != 4:
+        raise ValueError(f"expected (B, T, H, D) inputs, got {q.shape}")
+    world = lax.axis_size(axis_name)
+    h = q.shape[2]
+    if h % world:
+        raise ValueError(
+            f"ulysses needs num_heads ({h}) divisible by the {world}-wide "
+            f"{axis_name!r} axis; use ring attention for more devices "
+            "than heads"
+        )
+
+    def seq_to_head(a):  # (B, T/P, H, D) -> (B, T, H/P, D)
+        return lax.all_to_all(
+            a, axis_name, split_axis=2, concat_axis=1, tiled=True
+        )
+
+    qh, kh, vh = seq_to_head(q), seq_to_head(k), seq_to_head(v)
+    out = dense_attention(qh, kh, vh, causal=causal)
+    # (B, T, H/P, D) -> (B, T/P, H, D)
+    return lax.all_to_all(
+        out, axis_name, split_axis=1, concat_axis=2, tiled=True
+    )

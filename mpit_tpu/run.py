@@ -110,6 +110,7 @@ def _build_model(cfg: TrainConfig, meta: dict, worker_axis: str = None):
             # sharded on the mesh's "sp" axis (ring attention); moe-sync
             # shards experts over the worker axis
             seq_axis="sp" if algo == "seq-sync" else None,
+            seq_impl=cfg.seq_impl,
             remat=cfg.remat,
             attn_impl=cfg.attn_impl,
             **(

@@ -65,8 +65,11 @@ class TrainConfig:
     # sequence models
     seq_len: int = 32
     # seq-sync only: sequence-parallel extent (devices per ring; the mesh is
-    # (num_devices // sp) x sp — batch axis "dp", sequence axis "sp")
+    # (num_devices // sp) x sp — batch axis "dp", sequence axis "sp") and
+    # the scheme: "ring" (ppermute K/V rotation — extreme T) or "ulysses"
+    # (all_to_all head<->sequence re-shard — moderate T, heads % sp == 0)
     sp: int = 1
+    seq_impl: str = "ring"
     # pp-sync only: pipeline extent (stages; mesh (num_devices // pp) x pp),
     # microbatches per step, the schedule (gpipe | 1f1b | interleaved),
     # and virtual chunks per stage (interleaved only; layers must divide

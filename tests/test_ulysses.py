@@ -1,0 +1,132 @@
+"""Ulysses (all-to-all) sequence parallelism ≡ dense ≡ ring.
+
+The all_to_all pair is pure data movement: head-sharded dense attention
+over the re-gathered sequence must equal both the unsharded reference
+and the ring formulation bit-for-bit (same math, different collective).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+import mpit_tpu
+from jax.sharding import PartitionSpec as P
+from mpit_tpu.models.transformer import TransformerLM
+from mpit_tpu.ops import dense_attention, ulysses_attention
+from mpit_tpu.ops.ring_attention import ring_attention
+from mpit_tpu.parallel import SeqParallelTrainer
+
+B, T, H, D = 2, 32, 8, 4
+V = 29
+
+
+def _qkv(seed=0, dtype=jnp.float32):
+    rng = np.random.default_rng(seed)
+    mk = lambda: jnp.asarray(
+        rng.standard_normal((B, T, H, D)).astype(np.float32), dtype
+    )
+    return mk(), mk(), mk()
+
+
+def _sharded(topo, fn):
+    spec = P(None, topo.worker_axis)
+    return jax.jit(jax.shard_map(
+        fn, mesh=topo.mesh, in_specs=(spec, spec, spec), out_specs=spec,
+        check_vma=False,
+    ))
+
+
+class TestUlyssesOp:
+    @pytest.mark.parametrize("causal", [False, True])
+    def test_matches_dense_and_ring(self, topo8, causal):
+        q, k, v = _qkv()
+        axis = topo8.worker_axis
+        uly = _sharded(
+            topo8,
+            lambda a, b, c: ulysses_attention(a, b, c, axis, causal=causal),
+        )(q, k, v)
+        ring = _sharded(
+            topo8,
+            lambda a, b, c: ring_attention(a, b, c, axis, causal=causal),
+        )(q, k, v)
+        want = dense_attention(q, k, v, causal=causal)
+        np.testing.assert_allclose(
+            np.asarray(uly), np.asarray(want), rtol=2e-5, atol=2e-5
+        )
+        np.testing.assert_allclose(
+            np.asarray(uly), np.asarray(ring), rtol=2e-5, atol=2e-5
+        )
+
+    def test_bf16(self, topo8):
+        q, k, v = _qkv(seed=1, dtype=jnp.bfloat16)
+        axis = topo8.worker_axis
+        uly = _sharded(
+            topo8,
+            lambda a, b, c: ulysses_attention(a, b, c, axis, causal=True),
+        )(q, k, v)
+        want = dense_attention(q, k, v, causal=True)
+        np.testing.assert_allclose(
+            np.asarray(uly, np.float32), np.asarray(want, np.float32),
+            rtol=3e-2, atol=3e-2,
+        )
+        assert uly.dtype == jnp.bfloat16
+
+    def test_head_divisibility_error(self, topo8):
+        q, k, v = _qkv()
+        q6 = q[:, :, :6]  # 6 heads over an 8-wide axis
+        axis = topo8.worker_axis
+        with pytest.raises(ValueError, match="divisible"):
+            _sharded(
+                topo8,
+                lambda a, b, c: ulysses_attention(a, b, c, axis),
+            )(q6, q6, q6)
+
+
+class TestUlyssesTrainer:
+    def _run(self, seq_impl, steps=3):
+        mpit_tpu.finalize()
+        topo = mpit_tpu.init(axis_names=("dp", "sp"), mesh_shape=(2, 4))
+        model = TransformerLM(
+            vocab_size=V, num_layers=2, d_model=32, num_heads=8,
+            max_len=T, compute_dtype=jnp.float32, seq_axis="sp",
+            seq_impl=seq_impl,
+        )
+        tr = SeqParallelTrainer(
+            model, optax.sgd(0.1, momentum=0.9), topo, donate_state=False
+        )
+        rng = np.random.default_rng(0)
+        x = rng.integers(0, V, (8, T)).astype(np.int32)
+        y = np.roll(x, -1, axis=1).astype(np.int32)
+        state = tr.init_state(jax.random.key(0), x[:2, : T // 4])
+        losses = []
+        for _ in range(steps):
+            state, m = tr.step(state, x, y)
+            losses.append(float(m["loss"]))
+        params = jax.tree.map(np.asarray, jax.device_get(state.params))
+        mpit_tpu.finalize()
+        return losses, params
+
+    def test_ulysses_matches_ring_trajectory(self):
+        """Scheme choice is pure communication: identical training."""
+        ring = self._run("ring")
+        uly = self._run("ulysses")
+        np.testing.assert_allclose(
+            uly[0], ring[0], rtol=2e-5, atol=2e-6
+        )
+        jax.tree.map(
+            lambda a, b: np.testing.assert_allclose(
+                a, b, rtol=3e-4, atol=3e-4
+            ),
+            uly[1], ring[1],
+        )
+
+
+def test_unknown_seq_impl_rejected(topo8):
+    model = TransformerLM(
+        vocab_size=V, max_len=T, seq_impl="ulyses"  # typo must not
+    )                                               # silently run ring
+    x = np.zeros((2, 8), np.int32)
+    with pytest.raises(ValueError, match="must be 'ring' or 'ulysses'"):
+        model.init(jax.random.key(0), x)
