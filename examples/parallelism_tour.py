@@ -91,6 +91,16 @@ st = tr.init_state(jax.random.key(0), X[:2])
 st, m = tr.step(st, np.tile(X, (4, 1)), np.tile(Y, (4, 1)))
 show("dp + grad accumulation x4", topo, float(m["loss"]))
 
+# dp with ZeRO-1 — Adam's mu/nu sharded 1/8 per device, same trajectory
+from mpit_tpu.parallel import ZeroDataParallelTrainer  # noqa: E402
+
+tr = ZeroDataParallelTrainer(
+    lm(), optax.adam(1e-3), topo, donate_state=False
+)
+st = tr.init_state(jax.random.key(0), X[:2])
+st, m = tr.step(st, X, Y)
+show("dp + ZeRO-1 optimizer shards", topo, float(m["loss"]))
+
 # sp — the sequence sharded across devices, exact ring attention
 topo = fresh(("dp", "sp"), (2, 4))
 tr = SeqParallelTrainer(

@@ -32,3 +32,4 @@ from mpit_tpu.parallel.tensor import TensorParallelTrainer  # noqa: F401
 from mpit_tpu.parallel.pipeline import PipelineParallelTrainer  # noqa: F401
 from mpit_tpu.parallel.moe import MoEParallelTrainer  # noqa: F401
 from mpit_tpu.parallel.composed import ComposedParallelTrainer  # noqa: F401
+from mpit_tpu.parallel.zero import ZeroDataParallelTrainer  # noqa: F401

@@ -358,6 +358,34 @@ class RoundTrainer:
         return correct / n
 
 
+def build_count_loss_eval(model, topo) -> Callable:
+    """Jitted shard_map eval over the worker axis returning global
+    (correct-count sum, loss sum) — the ONE copy shared by the
+    replicated-param DP trainers (sync and ZeRO)."""
+    import optax
+    from jax.sharding import PartitionSpec as P
+
+    axis = topo.worker_axis
+
+    def eval_step(params, x, y):
+        logits = model.apply({"params": params}, x)
+        correct = jnp.sum(jnp.argmax(logits, -1) == y)
+        loss_sum = optax.softmax_cross_entropy_with_integer_labels(
+            logits, y
+        ).sum()
+        return jax.lax.psum(correct, axis), jax.lax.psum(loss_sum, axis)
+
+    return jax.jit(
+        jax.shard_map(
+            eval_step,
+            mesh=topo.mesh,
+            in_specs=(P(), P(axis), P(axis)),
+            out_specs=(P(), P()),
+            check_vma=False,
+        )
+    )
+
+
 def build_center_eval(model, topo) -> Optional[Callable]:
     """Jitted shard_map eval returning the summed correct-count across the
     worker axis, or None when model-less."""

@@ -117,23 +117,7 @@ class DataParallelTrainer:
             donate_argnums=(0,) if donate_state else (),
         )
 
-        def eval_step(params, x, y):
-            logits = self.model.apply({"params": params}, x)
-            correct = jnp.sum(jnp.argmax(logits, -1) == y)
-            loss_sum = optax.softmax_cross_entropy_with_integer_labels(
-                logits, y
-            ).sum()
-            return jax.lax.psum(correct, axis), jax.lax.psum(loss_sum, axis)
-
-        self._eval = jax.jit(
-            jax.shard_map(
-                eval_step,
-                mesh=mesh,
-                in_specs=(P(), P(axis), P(axis)),
-                out_specs=(P(), P()),
-                check_vma=False,
-            )
-        )
+        self._eval = common.build_count_loss_eval(model, self.topo)
 
     def init_state(self, rng, sample_x) -> common.TrainState:
         """Initialize replicated state. ``sample_x`` is a *per-worker* shaped

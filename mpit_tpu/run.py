@@ -100,6 +100,15 @@ def _build_model(cfg: TrainConfig, meta: dict, worker_axis: str = None):
             f"algo={cfg.algo!r} runs without experts",
             stacklevel=2,
         )
+    if cfg.seq_impl != "ring" and algo != "seq-sync":
+        import warnings
+
+        warnings.warn(
+            f"seq_impl={cfg.seq_impl!r} only applies with algo='seq-sync' "
+            f"(no sequence axis exists under algo={cfg.algo!r}); running "
+            "plain dense attention",
+            stacklevel=2,
+        )
     if name == "transformer":
         return get_model(
             cfg.model,
@@ -140,7 +149,7 @@ def _build_model(cfg: TrainConfig, meta: dict, worker_axis: str = None):
 
 # the per-step (no τ-round) algos — ONE copy; bench.py imports these so
 # its mesh/τ handling can never drift from the driver's
-SYNC_ALGOS = ("sync", "seq-sync", "moe-sync", "pp-sync")
+SYNC_ALGOS = ("sync", "zero-sync", "seq-sync", "moe-sync", "pp-sync")
 
 
 def second_axis_for(cfg: TrainConfig) -> dict:
@@ -193,6 +202,10 @@ def build_trainer(cfg: TrainConfig, model, opt, topo):
     if algo == "sync":
         return DataParallelTrainer(model, opt, topo,
                                    accum_steps=cfg.grad_accum)
+    if algo == "zero-sync":
+        from mpit_tpu.parallel import ZeroDataParallelTrainer
+
+        return ZeroDataParallelTrainer(model, opt, topo)
     if algo == "seq-sync":
         return SeqParallelTrainer(model, opt, topo)
     if algo == "moe-sync":

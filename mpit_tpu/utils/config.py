@@ -22,8 +22,9 @@ class TrainConfig:
     preset: Optional[str] = None  # one of PRESETS, or None for flag-driven
     model: str = "lenet"
     dataset: str = "mnist"
-    # easgd | eamsgd | downpour | sync | seq-sync | moe-sync | pp-sync |
-    # ps-easgd | ps-eamsgd | ps-downpour (eamsgd = EASGD with momentum in
+    # easgd | eamsgd | downpour | sync | zero-sync | seq-sync | moe-sync |
+    # pp-sync | ps-easgd | ps-eamsgd | ps-downpour (zero-sync = sync DP
+    # with ZeRO-1 sharded optimizer state; eamsgd = EASGD with momentum in
     # the local optimizer, the paper's momentum variant — the alias
     # asserts momentum > 0; seq-sync = sync DP over a 2-D dp x sp mesh
     # with sequence-parallel ring attention; moe-sync = sync DP with the
