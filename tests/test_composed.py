@@ -33,13 +33,14 @@ def _data(seed=0):
     return x, np.roll(x, -1, axis=1).astype(np.int32)
 
 
-def _run_composed(mesh_shape, steps=3):
+def _run_composed(mesh_shape, steps=3, seq_impl="ring"):
     mpit_tpu.finalize()
     topo = mpit_tpu.init(
         axis_names=("dp", "tp", "sp"), mesh_shape=mesh_shape
     )
     tr = ComposedParallelTrainer(
-        _model(), optax.sgd(0.1, momentum=0.9), topo, donate_state=False
+        _model().clone(seq_impl=seq_impl),
+        optax.sgd(0.1, momentum=0.9), topo, donate_state=False,
     )
     x, y = _data()
     state = tr.init_state(
@@ -72,6 +73,22 @@ class TestComposed:
                 params, ref_params,
             )
             assert ev[0] == pytest.approx(ref_ev[0], abs=0.03)
+
+    def test_ulysses_composes_too(self):
+        """The sequence scheme is a model-level choice: the composed
+        dp x tp x sp step with seq_impl='ulysses' (all_to_all inside the
+        manual sp region, GSPMD tp outside) matches the ring trajectory."""
+        ref_losses, ref_params, _ = _run_composed((2, 2, 2))
+        losses, params, _ = _run_composed((2, 2, 2), seq_impl="ulysses")
+        np.testing.assert_allclose(
+            losses, ref_losses, rtol=2e-5, atol=2e-6
+        )
+        jax.tree.map(
+            lambda a, b: np.testing.assert_allclose(
+                a, b, rtol=3e-4, atol=3e-4
+            ),
+            params, ref_params,
+        )
 
     def test_matches_dedicated_seq_trainer(self):
         """The composed step at tp=1 equals the 2-D dp×sp trainer."""
