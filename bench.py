@@ -401,9 +401,16 @@ def bench_ps_literal(
 def bench_preset(
     name: str, num_workers=None, cpu_smoke: bool = False,
     input_dtype: str = "float32", stem: str = None, remat: bool = False,
+    overrides: dict = None,
 ) -> dict:
     """Steady-state training samples/sec/chip for one BASELINE workload
-    config (same staging/timing harness as the headline metric)."""
+    config (same staging/timing harness as the headline metric).
+
+    ``overrides``: extra TrainConfig field replacements applied on top of
+    the preset — the generic channel for measuring variant axes
+    (``{"attn_impl": "flash"}``, ``{"seq_impl": "ulysses"}``,
+    ``{"algo": "zero-sync"}``, ``{"pp_schedule": "1f1b"}``, ...) without
+    a dedicated flag per axis. Unknown fields raise."""
     import dataclasses
 
     import optax
@@ -418,6 +425,21 @@ def bench_preset(
             f"{sorted(ALL_BENCH_PRESETS)}"
         )
     cfg = TrainConfig().apply_preset(name)
+    if overrides:
+        unknown = set(overrides) - {
+            f.name for f in dataclasses.fields(TrainConfig)
+        }
+        if unknown:
+            raise ValueError(
+                f"unknown TrainConfig override(s) {sorted(unknown)}"
+            )
+        if "input_dtype" in overrides:
+            raise ValueError(
+                "input staging uses the input_dtype PARAMETER, not cfg — "
+                "overriding cfg.input_dtype would silently measure "
+                "float32; pass input_dtype=... instead"
+            )
+        cfg = dataclasses.replace(cfg, **overrides)
     if stem is not None:  # measure the s2d-stem variant of a stem model
         from mpit_tpu.models import STEM_MODELS
 

@@ -9,6 +9,12 @@ final markdown table for docs/PERF.md. Optional variants per preset via flags:
   --stem space_to_depth  stem variant for stem-capable presets (resnet50,
                          alexnet); others ignore it
   --remat                rematerialize blocks (resnet50/transformer presets)
+  --set key=value        generic TrainConfig override, repeatable — the
+                         channel for every other variant axis, e.g.
+                         --set attn_impl=flash --set seq_impl=ulysses
+                         --set algo=zero-sync --set pp_schedule=1f1b
+                         (values cast by the field's type; unknown keys
+                         fail at startup)
 
 Keep the host otherwise idle while this runs — the box has one CPU core and
 the timing legs dispatch from it.
@@ -64,6 +70,60 @@ def main():
 
     remat = "--remat" in argv
 
+    # --set key=value (repeatable): generic TrainConfig overrides, cast
+    # by the field's ANNOTATION (type(default) lies for Optional fields
+    # whose default is None — alpha, client_timeout); every bad input
+    # fails here, not 25 minutes into the serial sweep
+    import dataclasses
+
+    _CAST = {
+        "int": int, "float": float, "str": str,
+        "Optional[int]": int, "Optional[float]": float,
+        "Optional[str]": str,
+    }
+    field_ann = {
+        f.name: str(f.type) for f in dataclasses.fields(TrainConfig)
+    }
+    overrides = {}
+    for i, a in enumerate(argv):
+        if a != "--set":
+            continue
+        if i + 1 >= len(argv) or "=" not in argv[i + 1]:
+            print("--set requires key=value", file=sys.stderr)
+            raise SystemExit(2)
+        key, _, val = argv[i + 1].partition("=")
+        if key not in field_ann:
+            print(f"--set: unknown config field {key!r}", file=sys.stderr)
+            raise SystemExit(2)
+        if key == "input_dtype":
+            # bench_preset stages data via its own input_dtype parameter,
+            # not cfg — an override here would silently measure float32
+            print(
+                "--set input_dtype=... would be a silent no-op; use "
+                "--input-dtype",
+                file=sys.stderr,
+            )
+            raise SystemExit(2)
+        ann = field_ann[key]
+        if ann == "bool":
+            if val.lower() not in ("0", "1", "true", "false"):
+                print(
+                    f"--set {key}: bool wants true/false/1/0, "
+                    f"got {val!r}",
+                    file=sys.stderr,
+                )
+                raise SystemExit(2)
+            overrides[key] = val.lower() in ("1", "true")
+        else:
+            try:
+                overrides[key] = _CAST.get(ann, str)(val)
+            except ValueError:
+                print(
+                    f"--set {key}: cannot cast {val!r} to {ann}",
+                    file=sys.stderr,
+                )
+                raise SystemExit(2)
+
     def variant_kw(name):
         """Pass stem/remat only to presets whose model takes them."""
         model = TrainConfig().apply_preset(name).model.lower()
@@ -78,7 +138,8 @@ def main():
     for name in names:
         try:
             res = bench.bench_preset(
-                name, input_dtype=input_dtype, **variant_kw(name)
+                name, input_dtype=input_dtype,
+                overrides=overrides or None, **variant_kw(name)
             )
         except Exception as e:  # keep the sweep alive past one bad preset
             print(json.dumps({"preset": name, "error": repr(e)}), flush=True)
