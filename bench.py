@@ -433,13 +433,30 @@ def bench_preset(
             raise ValueError(
                 f"unknown TrainConfig override(s) {sorted(unknown)}"
             )
-        if "input_dtype" in overrides:
+        # fields the harness OWNS — an override would be silently stomped
+        # (train_size/image_size are replaced below; the batch comes from
+        # the per-preset table, epochs from the adaptive timed leg)
+        harness_owned = {
+            "input_dtype": "pass input_dtype=... instead",
+            "train_size": "the harness sizes the staged dataset itself",
+            "image_size": "the harness caps resolution itself",
+            "global_batch": "per-worker batch comes from _PRESET_BENCH",
+            "epochs": "the timed leg is sized adaptively, not by epochs",
+        }
+        clashes = set(overrides) & set(harness_owned)
+        if clashes:
             raise ValueError(
-                "input staging uses the input_dtype PARAMETER, not cfg — "
-                "overriding cfg.input_dtype would silently measure "
-                "float32; pass input_dtype=... instead"
+                "override(s) the bench harness owns would be silently "
+                "ignored: "
+                + "; ".join(f"{k}: {harness_owned[k]}" for k in clashes)
             )
         cfg = dataclasses.replace(cfg, **overrides)
+    if name == "mnist-ps" and overrides:
+        raise ValueError(
+            "mnist-ps runs the dedicated host-async harness "
+            "(bench_ps_literal), which takes no config overrides — drop "
+            "--set for this preset"
+        )
     if stem is not None:  # measure the s2d-stem variant of a stem model
         from mpit_tpu.models import STEM_MODELS
 

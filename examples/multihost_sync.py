@@ -25,7 +25,8 @@ sys.path.insert(
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument(
-        "--algo", choices=("sync", "easgd", "downpour"), default="sync"
+        "--algo", choices=("sync", "zero", "easgd", "downpour"),
+        default="sync",
     )
     ap.add_argument("--steps", type=int, default=40)
     ap.add_argument(
@@ -73,6 +74,15 @@ def main():
     model = MLP(hidden=(64,), compute_dtype=np.float32)
     if ns.algo == "sync":
         trainer = DataParallelTrainer(model, optax.sgd(0.2), topo)
+    elif ns.algo == "zero":
+        # ZeRO-1 across PROCESSES: each rank's optimizer shards are
+        # non-addressable to the others — the strongest multi-host case
+        # for the psum_scatter/all_gather pair and the checkpoint gather
+        from mpit_tpu.parallel import ZeroDataParallelTrainer
+
+        trainer = ZeroDataParallelTrainer(
+            model, optax.adam(1e-3), topo
+        )
     elif ns.algo == "easgd":
         from mpit_tpu.parallel import EASGDTrainer
 
@@ -89,7 +99,7 @@ def main():
     first = last = None
     for step in range(ns.steps):
         idx = np.random.default_rng(step).integers(0, len(x), tau * gb)
-        if ns.algo == "sync":
+        if ns.algo in ("sync", "zero"):
             state, m = trainer.step(state, x[idx], y[idx])
         else:  # one whole tau-round per step (local scan + exchange: EASGD's
             # elastic psum, or Downpour's update push / stale center pull)

@@ -156,11 +156,15 @@ def main():
             ),
             "timed_seconds": res.get("timed_seconds"),
             "input_dtype": input_dtype,
+            # variant rows must be distinguishable from baseline rows
+            **({"overrides": overrides} if overrides else {}),
             **{k: res[k] for k in ("accuracy", "stem") if k in res},
         }
         rows.append(row)
         print(json.dumps(row), flush=True)
 
+    if overrides:
+        print(f"\nvariant: {json.dumps(overrides)}")
     print("\n| Preset | samples/s/chip | MFU |")
     print("|---|---|---|")
     for r in rows:

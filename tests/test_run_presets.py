@@ -212,6 +212,22 @@ class TestDriverPlumbing:
         r = run(dataclasses.replace(base, resume=True, epochs=2))
         assert r["resumed_from"] == 2
 
+    def test_zero_sync_resume_matches_uninterrupted(self, tmp_path):
+        """ZeRO's sharded optimizer leaves round-trip through the same
+        checkpoint path: resumed training is bit-identical."""
+        base = _cfg("mnist-easgd", algo="zero-sync", train_size=512,
+                    global_batch=64)
+        straight = run(dataclasses.replace(
+            base, epochs=2, ckpt_dir=str(tmp_path / "a")))
+        run(dataclasses.replace(base, epochs=1,
+                                ckpt_dir=str(tmp_path / "b")))
+        resumed = run(dataclasses.replace(
+            base, epochs=2, ckpt_dir=str(tmp_path / "b"), resume=True))
+        assert straight["last_checkpoint"] == resumed["last_checkpoint"]
+        a = (tmp_path / "a" / "ckpt_00000016.msgpack").read_bytes()
+        b = (tmp_path / "b" / "ckpt_00000016.msgpack").read_bytes()
+        assert a == b, "resumed ZeRO state diverged"
+
     def test_pp_sync_gpipe_resume_allows_pp_change(self, tmp_path):
         """Identity-layout schedules store globally-ordered layers, so
         restoring onto a different pp extent just re-shards — the
