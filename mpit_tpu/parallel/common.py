@@ -109,6 +109,54 @@ def assert_elementwise_optimizer(
         )
 
 
+def accumulated_value_and_grad(loss_fn: Callable, accum: int) -> Callable:
+    """(params, x, y) -> (loss, grads), processing the batch as ``accum``
+    sequential ``lax.scan`` slices whose losses/gradients average —
+    exactly the full-batch mean for equal slices (no model here carries
+    batch statistics), at 1/accum of the peak activation memory. The ONE
+    accumulation fold shared by the sync and ZeRO trainers; ``accum=1``
+    is the plain ``value_and_grad``. Raises on accum < 1 so every
+    caller shares one guard."""
+    if int(accum) != accum or accum < 1:
+        raise ValueError(f"accum_steps={accum} must be an integer >= 1")
+    if accum == 1:
+        return jax.value_and_grad(loss_fn)
+
+    def value_and_grad(params, x, y):
+        xs = x.reshape(accum, x.shape[0] // accum, *x.shape[1:])
+        ys = y.reshape(accum, y.shape[0] // accum, *y.shape[1:])
+
+        def fold(carry, xy):
+            loss_acc, g_acc = carry
+            l, g = jax.value_and_grad(loss_fn)(params, *xy)
+            return (
+                loss_acc + l,
+                jax.tree.map(jnp.add, g_acc, g),
+            ), None
+
+        (loss, grads), _ = jax.lax.scan(
+            fold,
+            (jnp.float32(0.0), jax.tree.map(jnp.zeros_like, params)),
+            (xs, ys),
+        )
+        return loss / accum, jax.tree.map(lambda g: g / accum, grads)
+
+    return value_and_grad
+
+
+def check_accum_batch(
+    global_batch: int, num_workers: int, accum: int
+) -> None:
+    """Sync-trainer batch check: divisible by W, per-worker shard
+    divisible by the accumulation factor."""
+    check_global_batch(global_batch, num_workers)
+    if (global_batch // num_workers) % accum:
+        raise ValueError(
+            f"per-worker batch {global_batch // num_workers} not "
+            f"divisible by accum_steps={accum}"
+        )
+
+
 def check_global_batch(global_batch: int, num_workers: int) -> int:
     if global_batch % num_workers != 0:
         raise ValueError(

@@ -61,37 +61,13 @@ class DataParallelTrainer:
             if loss_fn is not None
             else common.default_loss_fn(model.apply)
         )
-        accum = int(accum_steps)
-        if accum < 1:
-            raise ValueError(f"accum_steps={accum_steps} must be >= 1")
-        self.accum_steps = accum
+        self.accum_steps = accum = int(accum_steps)
         axis = self.topo.worker_axis
         mesh = self.topo.mesh
-
-        def local_loss_grads(params, x, y):
-            if accum == 1:
-                return jax.value_and_grad(self.loss_fn)(params, x, y)
-            xs = x.reshape(accum, x.shape[0] // accum, *x.shape[1:])
-            ys = y.reshape(accum, y.shape[0] // accum, *y.shape[1:])
-
-            def fold(carry, xy):
-                loss_acc, g_acc = carry
-                l, g = jax.value_and_grad(self.loss_fn)(params, *xy)
-                return (
-                    loss_acc + l,
-                    jax.tree.map(jnp.add, g_acc, g),
-                ), None
-
-            (loss, grads), _ = jax.lax.scan(
-                fold,
-                (jnp.float32(0.0),
-                 jax.tree.map(jnp.zeros_like, params)),
-                (xs, ys),
-            )
-            return loss / accum, jax.tree.map(lambda g: g / accum, grads)
+        local_vg = common.accumulated_value_and_grad(self.loss_fn, accum)
 
         def train_step(state: common.TrainState, x, y):
-            loss, grads = local_loss_grads(state.params, x, y)
+            loss, grads = local_vg(state.params, x, y)
             # the one collective of the step: grad average over workers
             grads = jax.lax.pmean(grads, axis)
             loss = jax.lax.pmean(loss, axis)
@@ -129,13 +105,9 @@ class DataParallelTrainer:
         )
 
     def _check(self, x) -> None:
-        w = self.topo.num_workers
-        common.check_global_batch(len(x), w)
-        if (len(x) // w) % self.accum_steps:
-            raise ValueError(
-                f"per-worker batch {len(x) // w} not divisible by "
-                f"accum_steps={self.accum_steps}"
-            )
+        common.check_accum_batch(
+            len(x), self.topo.num_workers, self.accum_steps
+        )
 
     def step(self, state, x_global, y_global):
         """One sync-DP step on a global batch (leading dim divisible by W,

@@ -62,7 +62,11 @@ class ZeroDataParallelTrainer:
         topo: Optional[Topology] = None,
         loss_fn: Optional[Callable] = None,
         donate_state: bool = True,
+        accum_steps: int = 1,
     ):
+        """``accum_steps``: gradient accumulation, composable with the
+        state sharding — both memory knobs together (activations / accum,
+        optimizer state / W)."""
         self.model = model
         self.optimizer = optimizer
         common.assert_elementwise_optimizer(
@@ -74,6 +78,7 @@ class ZeroDataParallelTrainer:
             if loss_fn is not None
             else common.default_loss_fn(model.apply)
         )
+        self.accum_steps = accum = int(accum_steps)
         axis = self.topo.worker_axis
         mesh = self.topo.mesh
         w = self.topo.num_workers
@@ -124,10 +129,12 @@ class ZeroDataParallelTrainer:
             step=P(),
         )
 
+        local_vg = common.accumulated_value_and_grad(
+            self.loss_fn, self.accum_steps
+        )
+
         def train_step(state: common.TrainState, x, y):
-            loss, grads = jax.value_and_grad(self.loss_fn)(
-                state.params, x, y
-            )
+            loss, grads = local_vg(state.params, x, y)
             flat_g, _ = flatten_params(grads)
             flat_g = jnp.pad(flat_g, (0, padded - n))
             # mean-gradient CHUNK per device: half of the
@@ -180,8 +187,11 @@ class ZeroDataParallelTrainer:
         )
 
     def step(self, state, x_global, y_global):
-        """One ZeRO-1 step on a global batch (divisible by W)."""
-        common.check_global_batch(len(x_global), self._w)
+        """One ZeRO-1 step on a global batch (divisible by W; per-worker
+        shard divisible by accum_steps)."""
+        common.check_accum_batch(
+            len(x_global), self._w, self.accum_steps
+        )
         if self._step is None:
             _ = self._build(state.params)
         state, metrics = self._step(state, x_global, y_global)
@@ -202,11 +212,11 @@ class ZeroDataParallelTrainer:
         """Epoch loop — the shared :func:`common.synced_fit_loop`."""
         if self._step is None:
             _ = self._build(state.params)
-        w = self._w
+        w, accum = self._w, self.accum_steps
         return common.synced_fit_loop(
             self.topo, self._step, batches, state,
             sharding=self.topo.worker_sharding(),
-            check=lambda x: common.check_global_batch(len(x), w),
+            check=lambda x: common.check_accum_batch(len(x), w, accum),
             log_tag="zero-dp",
             epochs=epochs, log_every=log_every, start_epoch=start_epoch,
             skip_steps=skip_steps, on_step=on_step, prefetch=prefetch,

@@ -173,12 +173,13 @@ def build_trainer(cfg: TrainConfig, model, opt, topo):
             f"unknown exchange_dtype {cfg.exchange_dtype!r}; have: none, bf16"
         )
     algo = cfg.resolved_algo()
-    if cfg.grad_accum > 1 and algo != "sync":
+    if cfg.grad_accum > 1 and algo not in ("sync", "zero-sync"):
         import warnings
 
         warnings.warn(
-            f"grad_accum={cfg.grad_accum} applies to algo='sync' only; "
-            f"algo={cfg.algo!r} runs without accumulation",
+            f"grad_accum={cfg.grad_accum} applies to algo='sync' and "
+            f"'zero-sync' only; algo={cfg.algo!r} runs without "
+            "accumulation",
             stacklevel=2,
         )
     if cfg.exchange_dtype != "none" and algo != "easgd":
@@ -205,7 +206,8 @@ def build_trainer(cfg: TrainConfig, model, opt, topo):
     if algo == "zero-sync":
         from mpit_tpu.parallel import ZeroDataParallelTrainer
 
-        return ZeroDataParallelTrainer(model, opt, topo)
+        return ZeroDataParallelTrainer(model, opt, topo,
+                                       accum_steps=cfg.grad_accum)
     if algo == "seq-sync":
         return SeqParallelTrainer(model, opt, topo)
     if algo == "moe-sync":

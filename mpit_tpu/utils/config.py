@@ -81,7 +81,7 @@ class TrainConfig:
     pp_virtual: int = 2
     # transformer depth (pp-sync needs layers % pp == 0)
     layers: int = 2
-    # sync only: gradient accumulation — per-worker batch processed as
+    # sync/zero-sync: gradient accumulation — per-worker batch processed as
     # this many sequential slices, one optimizer update (exact math; no
     # model here has batch statistics). Memory knob for big batches.
     grad_accum: int = 1

@@ -79,6 +79,33 @@ class TestZero:
         ][0]
         assert mu.sharding.spec[0] == axis
 
+    def test_composes_with_grad_accumulation(self, topo8):
+        """Both memory knobs together: accumulated ZeRO equals plain DP
+        on the same global batch."""
+        model = LeNet(compute_dtype=jnp.float32)
+        opt = optax.adam(1e-3)
+        x, y = _data(n=32, seed=2)
+        ref = DataParallelTrainer(model, opt, topo8, donate_state=False)
+        st_r = ref.init_state(jax.random.key(0), x[:2])
+        za = ZeroDataParallelTrainer(
+            model, opt, topo8, donate_state=False, accum_steps=2
+        )
+        st_z = za.init_state(jax.random.key(0), x[:2])
+        for _ in range(2):
+            st_r, m_r = ref.step(st_r, x, y)
+            st_z, m_z = za.step(st_z, x, y)
+            np.testing.assert_allclose(
+                float(m_z["loss"]), float(m_r["loss"]), rtol=1e-5
+            )
+        jax.tree.map(
+            lambda a, b: np.testing.assert_allclose(
+                np.asarray(a), np.asarray(b), atol=2e-5
+            ),
+            st_z.params, st_r.params,
+        )
+        with pytest.raises(ValueError, match="accum_steps"):
+            za.step(st_z, x[:8], y[:8])  # per-worker 1 % 2 != 0
+
     def test_cross_leaf_optimizer_rejected(self, topo8):
         """Global-norm clipping over a CHUNK would differ per device —
         the behavioral probe refuses it up front."""
