@@ -518,7 +518,12 @@ def bench_preset(
     )
     x_tr, y_tr, *_rest, _meta = _load_dataset(cfg)
     model = _build_model(cfg, _meta, worker_axis=topo.worker_axis)
-    opt = optax.sgd(cfg.lr, momentum=cfg.momentum)
+    # honor --set optimizer=.../lr_schedule=... (adam state math changes
+    # step cost; the schedule is a count-based scalar, timing-neutral).
+    # The horizon only shapes the cosine curve, not throughput.
+    from mpit_tpu.run import build_optimizer
+
+    opt = build_optimizer(cfg, 10_000)
     trainer = build_trainer(cfg, model, opt, topo)
     res = _stage_and_time(
         trainer, is_sync, topo, x_tr, y_tr, pwb, tau, rounds,

@@ -158,6 +158,42 @@ def second_axis_for(cfg: TrainConfig) -> dict:
     return {"seq-sync": ("sp", cfg.sp), "pp-sync": ("pp", cfg.pp)}
 
 
+def build_optimizer(cfg: TrainConfig, total_updates: int):
+    """The config's optax optimizer + schedule (the ONE construction the
+    driver, PS path, and bench harness share).
+
+    ``total_updates``: optimizer-update count the cosine decays over —
+    for τ-round trainers that is LOCAL steps (the local optimizer updates
+    every step), for sync trainers it equals the step count.
+    """
+    import optax
+
+    total = max(int(total_updates), 2)  # optax needs decay_steps > 0
+    if cfg.lr_schedule == "constant":
+        lr = cfg.lr
+    elif cfg.lr_schedule == "cosine":
+        lr = optax.cosine_decay_schedule(cfg.lr, total)
+    elif cfg.lr_schedule == "warmup-cosine":
+        warm = min(cfg.warmup_steps, total - 1)  # strictly < total
+        lr = optax.warmup_cosine_decay_schedule(
+            0.0, cfg.lr, warm, total
+        )
+    else:
+        raise ValueError(
+            f"unknown lr_schedule {cfg.lr_schedule!r}; have: constant, "
+            "cosine, warmup-cosine"
+        )
+    if cfg.optimizer == "sgd":
+        return optax.sgd(lr, momentum=cfg.momentum)
+    if cfg.optimizer == "adam":
+        return optax.adam(lr)
+    if cfg.optimizer == "adamw":
+        return optax.adamw(lr, weight_decay=cfg.weight_decay)
+    raise ValueError(
+        f"unknown optimizer {cfg.optimizer!r}; have: sgd, adam, adamw"
+    )
+
+
 def build_trainer(cfg: TrainConfig, model, opt, topo):
     """Collective trainer for ``cfg.algo`` (the single algo→trainer mapping;
     the bench harness reuses it so both measure the exact same construction)."""
@@ -228,15 +264,20 @@ def build_trainer(cfg: TrainConfig, model, opt, topo):
                 f"a transformer layer stack); got model={cfg.model!r}"
             )
         ignored = [
-            f for f, on in (("attn_impl", cfg.attn_impl != "xla"),
-                            ("remat", cfg.remat)) if on
+            f for f, on in (
+                ("attn_impl", cfg.attn_impl != "xla"),
+                ("remat", cfg.remat),
+                ("optimizer", cfg.optimizer != "sgd"),
+                ("lr_schedule", cfg.lr_schedule != "constant"),
+            ) if on
         ]
         if ignored:
             import warnings
 
             warnings.warn(
                 f"pp-sync builds its own f32 dense-attention pipeline "
-                f"model; {ignored} do not apply and are ignored",
+                f"model with a built-in SGD+momentum update; {ignored} "
+                "do not apply and are ignored",
                 stacklevel=2,
             )
         # the pipeline builds its own stacked-leaf params; shapes come
@@ -357,7 +398,6 @@ def run(cfg: TrainConfig) -> dict:
     is finalized and rebuilt, see :func:`_world_for`).
     """
     import jax
-    import optax
 
     import mpit_tpu
     from mpit_tpu.data import Batches
@@ -379,7 +419,16 @@ def run(cfg: TrainConfig) -> dict:
     x_tr = cast_input_dtype(x_tr, cfg.input_dtype)
     is_seq = cfg.dataset == "ptb"
     model = _build_model(cfg, meta, worker_axis=topo.worker_axis)
-    opt = optax.sgd(cfg.lr, momentum=cfg.momentum)
+    # cosine horizon: PS clients count LOCAL steps; everyone else counts
+    # fit-loop units x (τ local updates per unit for the round trainers)
+    if cfg.algo.startswith("ps-"):
+        total_updates = cfg.steps
+    else:
+        steps_per_epoch = max(
+            len(x_tr) // max(cfg.global_batch, 1), 1
+        )
+        total_updates = cfg.epochs * steps_per_epoch
+    opt = build_optimizer(cfg, total_updates)
 
     log = MetricsLogger(path=cfg.metrics_path, tag=cfg.algo, echo=False)
     results: dict = {"config": cfg.to_json(), "workers": topo.num_workers,

@@ -32,9 +32,18 @@ class TrainConfig:
     # pipeline parallelism over a dp x pp mesh, --pp-schedule
     # gpipe|1f1b|interleaved — all three transformer only)
     algo: str = "easgd"
-    # optimization (reference conf table: lr, τ, α — SURVEY.md §5)
+    # optimization (reference conf table: lr, τ, α — SURVEY.md §5).
+    # optimizer: sgd (the reference's; momentum applies) | adam | adamw
+    # (weight_decay applies). lr_schedule: constant | cosine |
+    # warmup-cosine (peak cfg.lr after warmup_steps, cosine to 0 over the
+    # run's optimizer-update count). All elementwise — every trainer
+    # (incl. ZeRO/MoE with their cross-leaf guards) accepts them.
+    optimizer: str = "sgd"
     lr: float = 0.05
     momentum: float = 0.9
+    lr_schedule: str = "constant"
+    warmup_steps: int = 100
+    weight_decay: float = 1e-4
     tau: int = 4
     alpha: Optional[float] = None  # None -> 0.9/W (EASGD paper rule)
     staleness: int = 0

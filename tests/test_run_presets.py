@@ -212,6 +212,25 @@ class TestDriverPlumbing:
         r = run(dataclasses.replace(base, resume=True, epochs=2))
         assert r["resumed_from"] == 2
 
+    def test_optimizer_and_schedule_flags(self):
+        """--optimizer / --lr-schedule reach the update rule: adamw with
+        warmup-cosine trains and diverges from the sgd default; unknown
+        names fail fast."""
+        base = _cfg("mnist-easgd", train_size=256, global_batch=64,
+                    epochs=1)
+        default = run(base)
+        adamw = run(dataclasses.replace(
+            base, optimizer="adamw", lr=1e-3,
+            lr_schedule="warmup-cosine", warmup_steps=2))
+        assert adamw["trained_units"] == default["trained_units"]
+        assert adamw["final_loss"] != default["final_loss"]
+        cosine = run(dataclasses.replace(base, lr_schedule="cosine"))
+        assert cosine["final_loss"] != default["final_loss"]
+        with pytest.raises(ValueError, match="unknown optimizer"):
+            run(dataclasses.replace(base, optimizer="lion"))
+        with pytest.raises(ValueError, match="unknown lr_schedule"):
+            run(dataclasses.replace(base, lr_schedule="step"))
+
     def test_zero_sync_resume_matches_uninterrupted(self, tmp_path):
         """ZeRO's sharded optimizer leaves round-trip through the same
         checkpoint path: resumed training is bit-identical."""
