@@ -15,11 +15,16 @@ reference file:line):
   XLA analogue), over in-process queues or TCP sockets.
 - ``mpit_tpu.goptim``    — distributed optimizers (EASGD/EAMSGD, Downpour)
   re-expressed as jit-compiled sharded update steps (SURVEY.md §2 comp. 5).
-- ``mpit_tpu.parallel``  — trainers: sync allreduce DP, collective EASGD /
-  Downpour, and the host-async pserver/pclient fidelity mode
-  (SURVEY.md §2 comps. 3, 4, 7).
-- ``mpit_tpu.models``    — LeNet, VGG-small, AlexNet, ResNet-50, PTB LSTM
-  (BASELINE.json configs 1–5).
+- ``mpit_tpu.parallel``  — trainers: sync allreduce DP (plus ZeRO-1
+  sharded optimizer state and gradient accumulation), collective EASGD /
+  Downpour, the host-async pserver/pclient fidelity mode
+  (SURVEY.md §2 comps. 3, 4, 7), and the beyond-parity suite: sequence
+  (ring or Ulysses), tensor (GSPMD), pipeline (GPipe/1F1B/interleaved),
+  expert (top-k MoE), and the composed dp×tp×sp step.
+- ``mpit_tpu.ops``       — pallas kernels (flash attention fwd+bwd,
+  fused elastic update) and the sharded attention/MoE primitives.
+- ``mpit_tpu.models``    — LeNet, VGG-small, AlexNet, ResNet-50, PTB
+  LSTM (BASELINE.json configs 1–5), plus MLP and the transformer LM.
 - ``mpit_tpu.data``      — dataset pipelines with deterministic synthetic
   fallbacks (no-network environments).
 - ``mpit_tpu.utils``     — flat-parameter utilities (≡ Torch
