@@ -113,9 +113,11 @@ def accumulated_value_and_grad(loss_fn: Callable, accum: int) -> Callable:
     """(params, x, y) -> (loss, grads), processing the batch as ``accum``
     sequential ``lax.scan`` slices whose losses/gradients average —
     exactly the full-batch mean for equal slices (no model here carries
-    batch statistics), at 1/accum of the peak activation memory. The ONE
-    accumulation fold shared by the sync and ZeRO trainers; ``accum=1``
-    is the plain ``value_and_grad``. Raises on accum < 1 so every
+    batch statistics), at 1/accum of the peak activation memory. Used by
+    the sync trainer; the ZeRO trainer carries its own fold because its
+    accumulator is the reduce-scattered SHARD, not the full pytree
+    (parallel/zero.py::scattered_grad). ``accum=1`` is the plain
+    ``value_and_grad``. Raises on accum < 1 so every
     caller shares one guard."""
     if int(accum) != accum or accum < 1:
         raise ValueError(f"accum_steps={accum} must be an integer >= 1")

@@ -7,10 +7,12 @@ into W contiguous chunks and each device owns ONE chunk's optimizer
 state (Rajbhandari et al., ZeRO stage 1 — arXiv:1910.02054):
 
 - forward/backward run exactly as in sync DP (params replicated);
-- the gradient average and sharding happen in ONE collective:
-  ``lax.psum_scatter`` hands each device the mean-gradient chunk it
-  owns (this is also half of the bandwidth-optimal allreduce, so the
-  step moves no more bytes than plain DP's ``pmean``);
+- the gradient average and sharding happen in one ``lax.psum_scatter``
+  per step (half of the bandwidth-optimal allreduce, so the step moves
+  no more bytes than plain DP's ``pmean``). Under gradient accumulation
+  the scatter moves inside the fold — one per slice, same aggregate
+  bytes, accum× the collective count — so the full gradient pytree
+  never persists across slices (the ZeRO-2 composition);
 - the optimizer updates only the local chunk (state leaves live sharded
   ``P(axis)`` — 1/W of Adam's mu/nu per device);
 - ``lax.all_gather`` reassembles the updated flat vector (the other
@@ -78,6 +80,10 @@ class ZeroDataParallelTrainer:
             if loss_fn is not None
             else common.default_loss_fn(model.apply)
         )
+        if int(accum_steps) != accum_steps or accum_steps < 1:
+            raise ValueError(
+                f"accum_steps={accum_steps} must be an integer >= 1"
+            )
         self.accum_steps = accum = int(accum_steps)
         axis = self.topo.worker_axis
         mesh = self.topo.mesh
@@ -129,17 +135,49 @@ class ZeroDataParallelTrainer:
             step=P(),
         )
 
-        local_vg = common.accumulated_value_and_grad(
-            self.loss_fn, self.accum_steps
-        )
+        accum = self.accum_steps
+
+        def scattered_grad(params, x, y):
+            """Mean-gradient CHUNK for this device.
+
+            accum=1: one grad, one psum_scatter — half of the
+            bandwidth-optimal allreduce, no extra bytes vs pmean.
+            accum>1: the scatter moves INSIDE the accumulation fold
+            (ZeRO-2 composed with accumulation): each slice's gradient
+            is reduced-scattered immediately and only the (chunk,)
+            accumulator persists across slices — gradient memory is
+            1/W·accum of the full-batch gradient, at the cost of one
+            collective per slice instead of one per step. Mean of
+            scattered slices == scattered full-batch mean, exactly.
+            """
+            vg = jax.value_and_grad(self.loss_fn)
+            if accum == 1:
+                loss, grads = vg(params, x, y)
+                flat_g, _ = flatten_params(grads)
+                flat_g = jnp.pad(flat_g, (0, padded - n))
+                return loss, lax.psum_scatter(
+                    flat_g, axis, tiled=True
+                ) / w
+            xs = x.reshape(accum, x.shape[0] // accum, *x.shape[1:])
+            ys = y.reshape(accum, y.shape[0] // accum, *y.shape[1:])
+
+            def fold(carry, xy):
+                loss_acc, shard_acc = carry
+                l, g = vg(params, *xy)
+                flat_g, _ = flatten_params(g)
+                flat_g = jnp.pad(flat_g, (0, padded - n))
+                gs = lax.psum_scatter(flat_g, axis, tiled=True) / w
+                return (loss_acc + l, shard_acc + gs), None
+
+            (loss, shard), _ = lax.scan(
+                fold,
+                (jnp.float32(0.0), jnp.zeros((chunk,), flat0.dtype)),
+                (xs, ys),
+            )
+            return loss / accum, shard / accum
 
         def train_step(state: common.TrainState, x, y):
-            loss, grads = local_vg(state.params, x, y)
-            flat_g, _ = flatten_params(grads)
-            flat_g = jnp.pad(flat_g, (0, padded - n))
-            # mean-gradient CHUNK per device: half of the
-            # bandwidth-optimal allreduce, so no extra bytes vs pmean
-            g_shard = lax.psum_scatter(flat_g, axis, tiled=True) / w
+            loss, g_shard = scattered_grad(state.params, x, y)
             flat_p, _ = flatten_params(state.params)
             flat_p = jnp.pad(flat_p, (0, padded - n))
             rank = lax.axis_index(axis)
