@@ -109,6 +109,13 @@ def assert_elementwise_optimizer(
         )
 
 
+def check_accum_steps(accum) -> int:
+    """The ONE accum_steps guard (sync fold + ZeRO constructor)."""
+    if int(accum) != accum or accum < 1:
+        raise ValueError(f"accum_steps={accum} must be an integer >= 1")
+    return int(accum)
+
+
 def accumulated_value_and_grad(loss_fn: Callable, accum: int) -> Callable:
     """(params, x, y) -> (loss, grads), processing the batch as ``accum``
     sequential ``lax.scan`` slices whose losses/gradients average —
@@ -117,10 +124,8 @@ def accumulated_value_and_grad(loss_fn: Callable, accum: int) -> Callable:
     the sync trainer; the ZeRO trainer carries its own fold because its
     accumulator is the reduce-scattered SHARD, not the full pytree
     (parallel/zero.py::scattered_grad). ``accum=1`` is the plain
-    ``value_and_grad``. Raises on accum < 1 so every
-    caller shares one guard."""
-    if int(accum) != accum or accum < 1:
-        raise ValueError(f"accum_steps={accum} must be an integer >= 1")
+    ``value_and_grad``. Validates via :func:`check_accum_steps`."""
+    accum = check_accum_steps(accum)
     if accum == 1:
         return jax.value_and_grad(loss_fn)
 

@@ -11,8 +11,10 @@ state (Rajbhandari et al., ZeRO stage 1 — arXiv:1910.02054):
   per step (half of the bandwidth-optimal allreduce, so the step moves
   no more bytes than plain DP's ``pmean``). Under gradient accumulation
   the scatter moves inside the fold — one per slice, same aggregate
-  bytes, accum× the collective count — so the full gradient pytree
-  never persists across slices (the ZeRO-2 composition);
+  bytes, accum× the collective count — so the PERSISTENT gradient
+  state is a 1/W chunk instead of a full param-sized pytree (the
+  ZeRO-2 composition; each slice's backward still transiently builds
+  one param-sized gradient);
 - the optimizer updates only the local chunk (state leaves live sharded
   ``P(axis)`` — 1/W of Adam's mu/nu per device);
 - ``lax.all_gather`` reassembles the updated flat vector (the other
@@ -80,11 +82,7 @@ class ZeroDataParallelTrainer:
             if loss_fn is not None
             else common.default_loss_fn(model.apply)
         )
-        if int(accum_steps) != accum_steps or accum_steps < 1:
-            raise ValueError(
-                f"accum_steps={accum_steps} must be an integer >= 1"
-            )
-        self.accum_steps = accum = int(accum_steps)
+        self.accum_steps = accum = common.check_accum_steps(accum_steps)
         axis = self.topo.worker_axis
         mesh = self.topo.mesh
         w = self.topo.num_workers
@@ -145,10 +143,12 @@ class ZeroDataParallelTrainer:
             accum>1: the scatter moves INSIDE the accumulation fold
             (ZeRO-2 composed with accumulation): each slice's gradient
             is reduced-scattered immediately and only the (chunk,)
-            accumulator persists across slices — gradient memory is
-            1/W·accum of the full-batch gradient, at the cost of one
-            collective per slice instead of one per step. Mean of
-            scattered slices == scattered full-batch mean, exactly.
+            accumulator persists across slices — the persistent gradient
+            state shrinks from a full param-sized pytree to 1/W of one
+            (each slice's backward still materializes one transient
+            param-sized gradient), at the cost of one collective per
+            slice instead of one per step. Mean of scattered slices ==
+            scattered full-batch mean, exactly.
             """
             vg = jax.value_and_grad(self.loss_fn)
             if accum == 1:

@@ -41,15 +41,19 @@ _ACCEPTANCE = {
     "mnist": ("mnist-easgd", dict(epochs=10), 0.985),
     "cifar10": ("cifar-vgg-sync", dict(epochs=10), None),
     "ptb": ("ptb-lstm-easgd", dict(epochs=5), None),
+    "imagenet": ("alexnet-downpour", dict(epochs=2), None),
 }
 
 
 def main() -> int:
     d = ds._data_dir()
     if not d:
+        raw = os.environ.get("MPIT_DATA_DIR")
+        what = f"{raw!r} is not a directory" if raw else "is unset"
         print(
-            "acceptance: $MPIT_DATA_DIR is unset — set it to a directory "
-            "holding MNIST idx / CIFAR-10 bin / PTB txt files"
+            f"acceptance: $MPIT_DATA_DIR {what} — point it at a "
+            "directory holding MNIST idx / CIFAR-10 bin / ImageNet "
+            "class-tree / PTB txt files"
         )
         return 2
     available = {
