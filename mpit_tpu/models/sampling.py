@@ -1,0 +1,89 @@
+"""Autoregressive decoding for :class:`TransformerLM` — eval utility.
+
+The reference is a training harness with no sampling path; users of a
+trained LM still expect one. This is the exact, compile-once recipe —
+NOT a serving path (no KV cache): each step re-runs the full forward on
+a FIXED ``(1, max_len)`` token buffer, so jit compiles exactly once, and
+causal attention guarantees the logits at the current position are
+unaffected by whatever garbage sits beyond it (pinned by a test that
+varies the suffix). Cost is O(T²·d) per token — fine for demos and eval
+perplexity spot-checks, deliberately not optimized further until a use
+case needs it.
+
+When the context outgrows ``max_len`` the window slides: absolute
+positions shift, so generation continues from the model's view of the
+last ``max_len − 1`` tokens (documented truncation, not an error).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+
+
+@functools.partial(jax.jit, static_argnums=0)
+def _apply(model, params, x):
+    """Module-level jit keyed on the (hashable) flax module: repeated
+    generate() calls with the same model hit one compile cache entry
+    instead of retracing per call."""
+    return model.apply({"params": params}, x)
+
+
+def generate(
+    model,
+    params,
+    prompt: Sequence[int],
+    steps: int,
+    temperature: float = 0.0,
+    seed: int = 0,
+    rng: Optional[jax.Array] = None,
+) -> list:
+    """Continue ``prompt`` by ``steps`` tokens; returns prompt + new.
+
+    ``temperature=0``: greedy argmax (deterministic). ``>0``: softmax
+    sampling at that temperature, reproducible from ``seed`` (or pass an
+    explicit ``rng`` key). ``model`` must be the dense single-device
+    configuration (``seq_axis=None``).
+    """
+    if getattr(model, "seq_axis", None) is not None:
+        raise ValueError(
+            "generate() runs the dense model; clone(seq_axis=None) first"
+        )
+    if not 0 < len(prompt) <= model.max_len:
+        raise ValueError(
+            f"prompt of {len(prompt)} tokens must be in [1, "
+            f"max_len={model.max_len}]"
+        )
+    if temperature < 0:
+        raise ValueError(f"temperature={temperature} must be >= 0")
+    bad = [t for t in prompt if not 0 <= int(t) < model.vocab_size]
+    if bad:
+        raise ValueError(
+            f"prompt tokens {bad} outside [0, vocab_size="
+            f"{model.vocab_size}) — XLA would silently clamp the "
+            "embedding lookup"
+        )
+    length = model.max_len
+    buf = jnp.zeros((1, length), jnp.int32)
+    buf = buf.at[0, : len(prompt)].set(jnp.asarray(prompt, jnp.int32))
+    pos = len(prompt)
+    if rng is None:
+        rng = jax.random.key(seed)
+    keys = jax.random.split(rng, max(steps, 1))
+    toks = [int(t) for t in prompt]
+    for i in range(steps):
+        if pos >= length:  # slide the window (positions shift — see doc)
+            buf = jnp.roll(buf, -1, axis=1)
+            pos = length - 1
+        logits = _apply(model, params, buf)[0, pos - 1]
+        if temperature > 0:
+            nxt = jax.random.categorical(keys[i], logits / temperature)
+        else:
+            nxt = jnp.argmax(logits)
+        buf = buf.at[0, pos].set(nxt)
+        toks.append(int(nxt))
+        pos += 1
+    return toks
