@@ -14,7 +14,7 @@ BASELINE.json config:
 
 from mpit_tpu.models.lenet import LeNet  # noqa: F401
 from mpit_tpu.models.mlp import MLP  # noqa: F401
-from mpit_tpu.models.sampling import generate  # noqa: F401
+from mpit_tpu.models.sampling import generate, generate_fast  # noqa: F401
 
 _REGISTRY = {"lenet": LeNet, "mlp": MLP}
 

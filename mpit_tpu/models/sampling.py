@@ -1,18 +1,25 @@
-"""Autoregressive decoding for :class:`TransformerLM` — eval utility.
+"""Autoregressive decoding for :class:`TransformerLM`.
 
 The reference is a training harness with no sampling path; users of a
-trained LM still expect one. This is the exact, compile-once recipe —
-NOT a serving path (no KV cache): each step re-runs the full forward on
-a FIXED ``(1, max_len)`` token buffer, so jit compiles exactly once, and
-causal attention guarantees the logits at the current position are
-unaffected by whatever garbage sits beyond it (pinned by a test that
-varies the suffix). Cost is O(T²·d) per token — fine for demos and eval
-perplexity spot-checks, deliberately not optimized further until a use
-case needs it.
+trained LM still expect one. Two recipes, same sampling semantics:
 
-When the context outgrows ``max_len`` the window slides: absolute
-positions shift, so generation continues from the model's view of the
-last ``max_len − 1`` tokens (documented truncation, not an error).
+- :func:`generate` — the exact fixed-buffer recipe: each step re-runs
+  the full forward on a FIXED ``(1, max_len)`` token buffer, so jit
+  compiles exactly once, and causal attention guarantees the logits at
+  the current position are unaffected by whatever garbage sits beyond
+  it (pinned by a test that varies the suffix). Cost is O(T²·d) per
+  token — fine for demos and spot-checks, and the only recipe that
+  slides the window past ``max_len`` (positions shift; documented
+  truncation, not an error).
+- :func:`generate_fast` — the serving recipe: ``decode=True`` clones
+  the model into one-token cached-attention steps (K/V cache in the
+  ``cache`` collection, ``TransformerLM.decode``) and the ENTIRE
+  prompt+generation loop runs as a single ``lax.scan`` inside one jit —
+  O(T·d) per token, no per-token host round-trips, one device fetch at
+  the end. Scan lengths are bucketed to powers of two so at most
+  log₂(max_len) programs ever compile per model. Greedy output is
+  pinned equal to :func:`generate`'s; sampled output is pinned equal
+  at the same seed (both index the same per-step key stream).
 """
 
 from __future__ import annotations
@@ -48,24 +55,7 @@ def generate(
     explicit ``rng`` key). ``model`` must be the dense single-device
     configuration (``seq_axis=None``).
     """
-    if getattr(model, "seq_axis", None) is not None:
-        raise ValueError(
-            "generate() runs the dense model; clone(seq_axis=None) first"
-        )
-    if not 0 < len(prompt) <= model.max_len:
-        raise ValueError(
-            f"prompt of {len(prompt)} tokens must be in [1, "
-            f"max_len={model.max_len}]"
-        )
-    if temperature < 0:
-        raise ValueError(f"temperature={temperature} must be >= 0")
-    bad = [t for t in prompt if not 0 <= int(t) < model.vocab_size]
-    if bad:
-        raise ValueError(
-            f"prompt tokens {bad} outside [0, vocab_size="
-            f"{model.vocab_size}) — XLA would silently clamp the "
-            "embedding lookup"
-        )
+    _validate(model, prompt, temperature)
     length = model.max_len
     buf = jnp.zeros((1, length), jnp.int32)
     buf = buf.at[0, : len(prompt)].set(jnp.asarray(prompt, jnp.int32))
@@ -87,3 +77,150 @@ def generate(
         toks.append(int(nxt))
         pos += 1
     return toks
+
+
+def _validate(model, prompt, temperature):
+    """Shared argument checks for both recipes."""
+    if getattr(model, "seq_axis", None) is not None:
+        raise ValueError(
+            "generation runs the dense model; clone(seq_axis=None) first"
+        )
+    if not 0 < len(prompt) <= model.max_len:
+        raise ValueError(
+            f"prompt of {len(prompt)} tokens must be in [1, "
+            f"max_len={model.max_len}]"
+        )
+    if temperature < 0:
+        raise ValueError(f"temperature={temperature} must be >= 0")
+    bad = [t for t in prompt if not 0 <= int(t) < model.vocab_size]
+    if bad:
+        raise ValueError(
+            f"prompt tokens {bad} outside [0, vocab_size="
+            f"{model.vocab_size}) — XLA would silently clamp the "
+            "embedding lookup"
+        )
+
+
+@functools.lru_cache(maxsize=8)
+def _zero_cache(dec):
+    """The all-zeros ``cache`` collection for a decode-mode model, by
+    shape inference only — no parameter initialization is executed and
+    repeat calls for the same model are free (arrays are immutable, so
+    sharing one instance is safe)."""
+    shapes = jax.eval_shape(
+        dec.init, jax.random.key(0), jnp.zeros((1, 1), jnp.int32)
+    )["cache"]
+    return jax.tree.map(lambda s: jnp.zeros(s.shape, s.dtype), shapes)
+
+
+@functools.partial(jax.jit, static_argnums=(0, 1, 2))
+def _decode_scan(
+    model, scan_len, greedy, params, cache0, buf, p_len, keys, temp
+):
+    """The whole prompt+generation pass as ONE compiled program.
+
+    ``model`` is the decode-mode clone; ``scan_len`` the bucketed step
+    count (static — at most log₂(max_len) distinct compiles per model);
+    ``buf`` the (scan_len+1,) token buffer holding the prompt (suffix
+    arbitrary); ``p_len`` the traced prompt length. Step t feeds the
+    token at position t (prompt token while t < p_len, else the
+    previously sampled one) and samples position t+1 from the returned
+    logits with keys[t - (p_len-1)] — the same per-generated-token key
+    stream :func:`generate` uses, which is what makes the two recipes
+    comparable at a fixed seed.
+    """
+
+    def step(carry, t):
+        cache, prev = carry
+        tok = jnp.where(t < p_len, buf[t], prev)
+        logits, mut = model.apply(
+            {"params": params, "cache": cache},
+            tok[None, None],
+            mutable=["cache"],
+        )
+        logits = logits[0, 0]
+        if greedy:
+            nxt = jnp.argmax(logits).astype(jnp.int32)
+        else:
+            j = jnp.clip(t - (p_len - 1), 0, keys.shape[0] - 1)
+            nxt = jax.random.categorical(
+                keys[j], logits / temp
+            ).astype(jnp.int32)
+        return (mut["cache"], nxt), nxt
+
+    (_, _), nxt = jax.lax.scan(
+        step, (cache0, buf[0]), jnp.arange(scan_len)
+    )
+    # position t+1's token: prompt while t+1 < p_len, else sampled
+    out = jnp.where(jnp.arange(1, scan_len + 1) < p_len, buf[1:], nxt)
+    return jnp.concatenate([buf[:1], out])
+
+
+def generate_fast(
+    model,
+    params,
+    prompt: Sequence[int],
+    steps: int,
+    temperature: float = 0.0,
+    seed: int = 0,
+    rng: Optional[jax.Array] = None,
+) -> list:
+    """KV-cached generation: continue ``prompt`` by ``steps`` tokens.
+
+    Same sampling semantics as :func:`generate` (greedy at
+    ``temperature=0``, else softmax sampling keyed per generated token),
+    but O(T·d) per token and compiled as one program — the serving path.
+    Narrower model support than :func:`generate`, which handles anything
+    dense ``apply`` can run:
+
+    - no window sliding — ``len(prompt) + steps`` must fit in
+      ``model.max_len``;
+    - MoE models are rejected (``generate`` runs them via the
+      dense-reference FFN; the cache path does not);
+    - ``attn_impl`` is overridden to the cached XLA path, so for a
+      flash-attention model the greedy-equality pin versus
+      :func:`generate` holds only up to that kernel's numerics.
+    """
+    _validate(model, prompt, temperature)
+    total = len(prompt) + steps
+    if total > model.max_len:
+        raise ValueError(
+            f"prompt+steps = {total} exceeds max_len={model.max_len}; "
+            "the KV cache cannot slide — use generate() for overflow"
+        )
+    if steps <= 0:
+        return [int(t) for t in prompt]
+    dec = model.clone(
+        decode=True, remat=False, seq_axis=None, attn_impl="xla"
+    )
+    cache0 = _zero_cache(dec)
+    # bucket the scan so repeated calls with nearby lengths share one
+    # compile; extra steps feed already-sampled tokens and their outputs
+    # are discarded. The min() with max_len keeps every cache write and
+    # positional-embedding gather strictly in bounds (index peaks at
+    # scan_len-1 ≤ max_len-1) — enlarge the bucket past max_len and both
+    # would clamp silently, so don't.
+    scan_len = 1
+    while scan_len < total - 1:
+        scan_len *= 2
+    scan_len = min(scan_len, model.max_len)
+    buf = jnp.zeros((scan_len + 1,), jnp.int32)
+    buf = buf.at[: len(prompt)].set(jnp.asarray(prompt, jnp.int32))
+    if rng is None:
+        rng = jax.random.key(seed)
+    # the key STREAM must match generate()'s split(rng, steps) exactly,
+    # but the key SHAPE must depend only on the bucket — otherwise every
+    # distinct steps value would recompile the scan. Pad with repeats of
+    # the last key: padded slots are only ever indexed by discarded
+    # bucket-overrun steps (kept tokens clip j to steps-1 and below).
+    keys = jax.random.split(rng, max(steps, 1))
+    if keys.shape[0] < scan_len:
+        keys = jnp.concatenate(
+            [keys, jnp.repeat(keys[-1:], scan_len - keys.shape[0], axis=0)]
+        )
+    toks = _decode_scan(
+        dec, scan_len, temperature == 0.0, params, cache0, buf,
+        jnp.asarray(len(prompt), jnp.int32), keys,
+        jnp.asarray(max(temperature, 1e-9), jnp.float32),
+    )
+    return [int(t) for t in jax.device_get(toks[:total])]

@@ -45,6 +45,12 @@ class Block(nn.Module):
     attn_impl: str = "xla"
     # sequence-parallel scheme when seq_axis is set — see TransformerLM
     seq_impl: str = "ring"
+    # autoregressive decode mode: the block consumes ONE token per call
+    # and attends over a (B, max_len) K/V cache held in the "cache"
+    # variable collection (serving path — models/sampling.generate_fast);
+    # decode_len sizes the cache (the LM passes its max_len)
+    decode: bool = False
+    decode_len: int = 0
 
     @nn.compact
     def __call__(self, x):
@@ -59,7 +65,14 @@ class Block(nn.Module):
             raise ValueError(
                 f"seq_impl={self.seq_impl!r} must be 'ring' or 'ulysses'"
             )
-        if self.seq_axis is not None and self.seq_impl == "ulysses":
+        if self.decode:
+            if self.seq_axis is not None or self.moe_experts:
+                raise ValueError(
+                    "decode mode is single-device dense-FFN only "
+                    "(seq_axis=None, moe_experts=0)"
+                )
+            att = self._cached_attention(q, k, v)
+        elif self.seq_axis is not None and self.seq_impl == "ulysses":
             att = ulysses_attention(q, k, v, self.seq_axis, causal=True)
         elif self.seq_axis is not None:
             att = ring_attention(q, k, v, self.seq_axis, causal=True)
@@ -83,6 +96,68 @@ class Block(nn.Module):
             y = nn.gelu(y)
             x = x + nn.Dense(self.d_model, dtype=dt)(y)
         return x
+
+    def _cached_attention(self, q, k, v):
+        """One-token causal attention over the persistent K/V cache.
+
+        The cache lives in the ``cache`` variable collection (flax's
+        standard decode recipe): ``cached_key``/``cached_value`` hold the
+        first ``cache_index`` positions' keys/values; each call appends
+        the current token's K/V at ``cache_index`` and attends the one
+        query over every filled slot. Static shapes throughout — the
+        cache is allocated at ``decode_len`` and masked, so the whole
+        generation loop compiles once per bucket (sampling.generate_fast).
+
+        Numerics match :func:`dense_attention`: f32 scores/softmax/
+        accumulation, inputs left in compute dtype for the einsums.
+        """
+        if self.decode_len <= 0:
+            raise ValueError(
+                f"decode=True needs decode_len > 0, got {self.decode_len}"
+            )
+        b, t, h, d = q.shape
+        if t != 1:
+            raise ValueError(
+                f"decode mode consumes one token per call, got T={t}"
+            )
+        # has_variable BEFORE self.variable: during model.init the cache
+        # is created on this very call, and mutating it then would leak
+        # a post-step index into the initial cache state
+        ready = self.has_variable("cache", "cached_key")
+        zeros = nn.initializers.zeros_init()
+        ck = self.variable(
+            "cache", "cached_key", zeros, None,
+            (b, self.decode_len, h, d), k.dtype,
+        )
+        cv = self.variable(
+            "cache", "cached_value", zeros, None,
+            (b, self.decode_len, h, d), v.dtype,
+        )
+        idx = self.variable(
+            "cache", "cache_index",
+            lambda: jnp.zeros((), jnp.int32),
+        )
+        i = idx.value
+        key_cache = jax.lax.dynamic_update_slice(
+            ck.value, k, (0, i, 0, 0)
+        )
+        val_cache = jax.lax.dynamic_update_slice(
+            cv.value, v, (0, i, 0, 0)
+        )
+        if ready:
+            ck.value, cv.value = key_cache, val_cache
+            idx.value = i + 1
+        s = jnp.einsum(
+            "bqhd,bkhd->bhqk", q, key_cache,
+            preferred_element_type=jnp.float32,
+        ) / (d ** 0.5)
+        mask = jnp.arange(self.decode_len)[None, None, None, :] <= i
+        s = jnp.where(mask, s, -jnp.inf)
+        p = jax.nn.softmax(s, axis=-1)
+        return jnp.einsum(
+            "bhqk,bkhd->bqhd", p, val_cache,
+            preferred_element_type=jnp.float32,
+        ).astype(q.dtype)
 
     def _moe(self, y):
         """GShard MoE FFN replacing the dense MLP (``mpit_tpu.ops.moe``).
@@ -213,6 +288,10 @@ class TransformerLM(nn.Module):
     # (all_to_all head<->sequence re-shard around dense attention —
     # moderate T, needs num_heads % axis == 0). Both exact.
     seq_impl: str = "ring"
+    # serving path: decode=True turns every block into a one-token-per-call
+    # cached-attention step (see Block.decode); params are IDENTICAL to the
+    # training configuration — only the "cache" collection is added
+    decode: bool = False
 
     @nn.compact
     def __call__(self, tokens):
@@ -232,9 +311,21 @@ class TransformerLM(nn.Module):
         )
         offset = 0
         total_len = t_local
-        if self.seq_axis is not None:
-            total_len = t_local * jax.lax.axis_size(self.seq_axis)
-            offset = jax.lax.axis_index(self.seq_axis) * t_local
+        if self.decode:
+            if self.seq_axis is not None:
+                raise ValueError("decode mode requires seq_axis=None")
+            # the LM's own position counter (each block keeps its own
+            # cache_index; this one feeds the positional embedding) —
+            # same create-before-mutate discipline as Block's cache
+            ready = self.has_variable("cache", "pos_index")
+            pidx = self.variable(
+                "cache", "pos_index",
+                lambda: jnp.zeros((), jnp.int32),
+            )
+            offset = pidx.value
+            if ready:
+                pidx.value = offset + t_local
+            total_len = 1  # bounds are the caller's contract in decode
         if total_len > self.max_len:
             raise ValueError(
                 f"sequence of {total_len} exceeds max_len={self.max_len}"
@@ -257,6 +348,8 @@ class TransformerLM(nn.Module):
                 moe_top_k=self.moe_top_k,
                 attn_impl=self.attn_impl,
                 seq_impl=self.seq_impl,
+                decode=self.decode,
+                decode_len=self.max_len if self.decode else 0,
                 name=f"Block_{i}",
             )(x)
         x = nn.LayerNorm(dtype=dt)(x)

@@ -1,15 +1,20 @@
 #!/bin/bash
 # Remaining round-3 measurement backlog (docs/PERF.md "moment the tunnel
 # returns" list, minus the legs already measured 2026-07-31 morning).
-# Safe to re-run; each leg overwrites its own log under /tmp.
+# Safe to re-run; each leg overwrites its own log under /tmp. The DONE
+# sentinel records how many legs failed — "DONE failed=0" is the only
+# all-clear (a flapping tunnel can fail every leg and still reach the
+# end of this script).
 cd "$(dirname "$0")/.."
 set -x
-python scripts/measure_presets.py --remat --presets resnet50-sync,ptb-transformer-seq > /tmp/v_remat.log 2>&1
-python scripts/measure_presets.py --set algo=zero-sync --presets mnist-easgd,cifar-vgg-sync > /tmp/v_zero.log 2>&1
-python scripts/measure_presets.py --set optimizer=adam --presets mnist-easgd > /tmp/v_adam.log 2>&1
-python scripts/measure_presets.py --set attn_impl=flash --presets ptb-transformer-seq > /tmp/v_flash.log 2>&1
-python scripts/measure_presets.py --presets ptb-transformer-pp --set pp_schedule=1f1b > /tmp/v_1f1b.log 2>&1
-python scripts/sweep_lenet.py > /tmp/v_sweep_lenet.log 2>&1
-python scripts/measure_presets.py --stem space_to_depth --presets resnet50-sync > /tmp/v_s2d_r50.log 2>&1
-python bench.py --preset resnet50-sync --profile /tmp/prof_r50 > /tmp/v_prof_r50.log 2>&1
-echo DONE > /tmp/tpu_backlog.done
+failed=0
+run() { timeout 1800 "$@" || failed=$((failed+1)); }
+run python scripts/measure_presets.py --remat --presets resnet50-sync,ptb-transformer-seq > /tmp/v_remat.log 2>&1
+run python scripts/measure_presets.py --set algo=zero-sync --presets mnist-easgd,cifar-vgg-sync > /tmp/v_zero.log 2>&1
+run python scripts/measure_presets.py --set optimizer=adam --presets mnist-easgd > /tmp/v_adam.log 2>&1
+run python scripts/measure_presets.py --set attn_impl=flash --presets ptb-transformer-seq > /tmp/v_flash.log 2>&1
+run python scripts/measure_presets.py --presets ptb-transformer-pp --set pp_schedule=1f1b > /tmp/v_1f1b.log 2>&1
+run python scripts/sweep_lenet.py > /tmp/v_sweep_lenet.log 2>&1
+run python scripts/measure_presets.py --stem space_to_depth --presets resnet50-sync > /tmp/v_s2d_r50.log 2>&1
+run python bench.py --preset resnet50-sync --profile /tmp/prof_r50 > /tmp/v_prof_r50.log 2>&1
+echo "DONE failed=$failed" > /tmp/tpu_backlog.done
