@@ -331,6 +331,10 @@ _PRESET_BENCH = {
     # beyond-parity pipeline config (pp=1 on one chip — microbatching and
     # the schedule still run; multi-stage proven on the CPU mesh/dryrun)
     "ptb-transformer-pp": 64,
+    # MFU-ceiling config: GPT-2-small shape (768/3072, T=512) — the row
+    # that shows the low parity-preset MFUs are model shapes, not the
+    # framework
+    "ptb-transformer-large": 8,
 }
 # every benchmarkable preset (the staged collective ones above plus the
 # host-async literal-PS shape, which has its own harness)

@@ -101,16 +101,23 @@ def _validate(model, prompt, temperature):
         )
 
 
-@functools.lru_cache(maxsize=8)
-def _zero_cache(dec):
-    """The all-zeros ``cache`` collection for a decode-mode model, by
-    shape inference only — no parameter initialization is executed and
-    repeat calls for the same model are free (arrays are immutable, so
-    sharing one instance is safe)."""
-    shapes = jax.eval_shape(
+@functools.lru_cache(maxsize=32)
+def _cache_shapes(dec):
+    """Shape inference for a decode-mode model's ``cache`` collection —
+    host-side ShapeDtypeStructs only, so caching them pins no device
+    memory (and no parameter initialization ever executes)."""
+    return jax.eval_shape(
         dec.init, jax.random.key(0), jnp.zeros((1, 1), jnp.int32)
     )["cache"]
-    return jax.tree.map(lambda s: jnp.zeros(s.shape, s.dtype), shapes)
+
+
+def _zero_cache(dec):
+    """Fresh all-zeros cache per call: the arrays die with the request
+    instead of being pinned in an lru slot (zeros are cheap; the traced
+    init shape inference is the part worth caching)."""
+    return jax.tree.map(
+        lambda s: jnp.zeros(s.shape, s.dtype), _cache_shapes(dec)
+    )
 
 
 @functools.partial(jax.jit, static_argnums=(0, 1, 2))

@@ -114,6 +114,9 @@ def _build_model(cfg: TrainConfig, meta: dict, worker_axis: str = None):
             cfg.model,
             vocab_size=meta.get("vocab_size", 10_000),
             num_layers=cfg.layers,
+            d_model=cfg.d_model,
+            num_heads=cfg.heads,
+            d_ff=cfg.d_ff,
             max_len=max(cfg.seq_len, 32),
             # seq-sync applies the model inside shard_map with the sequence
             # sharded on the mesh's "sp" axis (ring attention); moe-sync

@@ -126,6 +126,22 @@ class TestPresets:
                       epochs=1))
         assert r2["workers"] == 8  # world rebuilt to the 1-D mesh
 
+    def test_ptb_transformer_large_dims_reach_the_model(self):
+        # the MFU-ceiling preset's width knobs must actually build a wider
+        # model (run at toy scale — d_model shrunk, depth/heads kept)
+        from mpit_tpu.run import _build_model
+
+        cfg = _cfg("ptb-transformer-large", d_model=48, seq_len=32,
+                   train_size=32, global_batch=8, epochs=1)
+        model = _build_model(cfg, {"vocab_size": 100})
+        assert (model.d_model, model.num_heads, model.num_layers) == (
+            48, 12, 6
+        )
+        assert model.d_ff == 0  # 0 -> 4x d_model inside the block
+        r = run(cfg)
+        assert r["trained_units"] == 4
+        assert 0.0 <= r["accuracy"] <= 1.0 and "eval_loss" in r
+
 
 class TestDriverPlumbing:
     def test_metrics_and_checkpoint(self, tmp_path):
