@@ -109,6 +109,53 @@ def assert_elementwise_optimizer(
         )
 
 
+def check_clip_norm(clip_norm):
+    """The ONE clip_norm guard (MoE and ZeRO trainer constructors)."""
+    if clip_norm is not None and clip_norm <= 0:
+        raise ValueError(f"clip_norm={clip_norm} must be > 0")
+    return clip_norm
+
+
+def clip_by_global_norm_in_mesh(
+    grads, max_norm: float, axis: str, is_sharded=None
+):
+    """Global-norm gradient clipping that is CORRECT inside shard_map —
+    the safe counterpart to the cross-leaf transforms
+    :func:`assert_elementwise_optimizer` rejects.
+
+    The true global norm is assembled mesh-wide: device-varying leaves
+    (``is_sharded(path)`` true, e.g. expert shards or ZeRO gradient
+    chunks) contribute their local sum-of-squares through a ``psum``
+    over ``axis``; replicated leaves are identical everywhere and count
+    once outside it. Every device therefore computes the SAME norm and
+    the same scale — no replica drift. ``is_sharded=None`` treats every
+    leaf as device-varying (the flat-chunk case).
+
+    The scale rule is exactly ``optax.clip_by_global_norm``'s
+    (``g * max_norm / norm`` when ``norm > max_norm``, identity
+    otherwise), so a sharded run clips bit-for-bit like a dense run of
+    the same model under the optax transform — pinned by the trainer
+    equivalence tests.
+
+    Returns ``(clipped_grads, global_norm)``.
+    """
+    leaves = jax.tree_util.tree_leaves_with_path(grads)
+    shard_sq = jnp.float32(0.0)
+    repl_sq = jnp.float32(0.0)
+    for path, g in leaves:
+        sq = jnp.sum(jnp.square(g.astype(jnp.float32)))
+        if is_sharded is None or is_sharded(path):
+            shard_sq = shard_sq + sq
+        else:
+            repl_sq = repl_sq + sq
+    norm = jnp.sqrt(jax.lax.psum(shard_sq, axis) + repl_sq)
+    scale = jnp.where(norm > max_norm, max_norm / norm, 1.0)
+    return (
+        jax.tree.map(lambda g: (g * scale).astype(g.dtype), grads),
+        norm,
+    )
+
+
 def check_accum_steps(accum) -> int:
     """The ONE accum_steps guard (sync fold + ZeRO constructor)."""
     if int(accum) != accum or accum < 1:

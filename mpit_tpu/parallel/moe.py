@@ -60,7 +60,12 @@ class MoEParallelTrainer:
     device and silently desynchronize the replicated leaves; use per-leaf
     clipping (``clip``, ``clip_by_block_rms``) instead. The constructor
     probes the optimizer behaviorally and REJECTS cross-leaf transforms
-    (:func:`common.assert_elementwise_optimizer`).
+    (:func:`common.assert_elementwise_optimizer`). For global-norm
+    clipping specifically, pass ``clip_norm=c`` — the trainer applies
+    :func:`common.clip_by_global_norm_in_mesh` to the reduced gradients
+    inside the step (expert shards psum their sum-of-squares, replicated
+    leaves count once), which equals ``optax.clip_by_global_norm(c)`` on
+    the dense model exactly.
     """
 
     def __init__(
@@ -69,10 +74,12 @@ class MoEParallelTrainer:
         optimizer: optax.GradientTransformation,
         topo: Optional[Topology] = None,
         donate_state: bool = True,
+        clip_norm: Optional[float] = None,
     ):
         self.model = model
         self.optimizer = optimizer
         common.assert_elementwise_optimizer(optimizer, "MoEParallelTrainer")
+        clip_norm = common.check_clip_norm(clip_norm)
         self.topo = topo if topo is not None else _current_topology()
         mesh = self.topo.mesh
         axis = self.topo.worker_axis
@@ -129,6 +136,10 @@ class MoEParallelTrainer:
                 grads,
             )
             loss = jax.lax.pmean(loss, axis)
+            if clip_norm is not None:
+                grads, _ = common.clip_by_global_norm_in_mesh(
+                    grads, clip_norm, axis, is_sharded=_is_expert_leaf
+                )
             updates, opt_state = self.optimizer.update(
                 grads, state.opt_state, state.params
             )

@@ -67,15 +67,22 @@ class ZeroDataParallelTrainer:
         loss_fn: Optional[Callable] = None,
         donate_state: bool = True,
         accum_steps: int = 1,
+        clip_norm: Optional[float] = None,
     ):
         """``accum_steps``: gradient accumulation, composable with the
         state sharding — both memory knobs together (activations / accum,
-        optimizer state / W)."""
+        optimizer state / W). ``clip_norm``: global-norm gradient
+        clipping done mesh-correctly on the gradient chunks
+        (:func:`common.clip_by_global_norm_in_mesh` — the psum over
+        chunk sum-of-squares IS the full-vector norm, so this equals
+        ``optax.clip_by_global_norm`` on unsharded sync DP exactly; the
+        chain form itself is rejected by the elementwise probe below)."""
         self.model = model
         self.optimizer = optimizer
         common.assert_elementwise_optimizer(
             optimizer, "ZeroDataParallelTrainer"
         )
+        self.clip_norm = common.check_clip_norm(clip_norm)
         self.topo = topo if topo is not None else _current_topology()
         self.loss_fn = (
             loss_fn
@@ -176,8 +183,17 @@ class ZeroDataParallelTrainer:
             )
             return loss / accum, shard / accum
 
+        clip_norm = self.clip_norm
+
         def train_step(state: common.TrainState, x, y):
             loss, g_shard = scattered_grad(state.params, x, y)
+            if clip_norm is not None:
+                # every device holds a disjoint chunk of the ONE flat
+                # mean gradient (padding is zeros), so psum of chunk
+                # sums-of-squares is exactly the full-vector norm
+                g_shard, _ = common.clip_by_global_norm_in_mesh(
+                    g_shard, clip_norm, axis
+                )
             flat_p, _ = flatten_params(state.params)
             flat_p = jnp.pad(flat_p, (0, padded - n))
             rank = lax.axis_index(axis)

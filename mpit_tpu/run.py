@@ -187,14 +187,29 @@ def build_optimizer(cfg: TrainConfig, total_updates: int):
             "cosine, warmup-cosine"
         )
     if cfg.optimizer == "sgd":
-        return optax.sgd(lr, momentum=cfg.momentum)
-    if cfg.optimizer == "adam":
-        return optax.adam(lr)
-    if cfg.optimizer == "adamw":
-        return optax.adamw(lr, weight_decay=cfg.weight_decay)
-    raise ValueError(
-        f"unknown optimizer {cfg.optimizer!r}; have: sgd, adam, adamw"
-    )
+        opt = optax.sgd(lr, momentum=cfg.momentum)
+    elif cfg.optimizer == "adam":
+        opt = optax.adam(lr)
+    elif cfg.optimizer == "adamw":
+        opt = optax.adamw(lr, weight_decay=cfg.weight_decay)
+    else:
+        raise ValueError(
+            f"unknown optimizer {cfg.optimizer!r}; have: sgd, adam, adamw"
+        )
+    # --clip-norm: chain the optax transform wherever the update sees
+    # consistent gradients (sync/seq/tp: reduced before update;
+    # easgd/downpour/ps-*: per-worker local updates, so a per-worker
+    # clip IS the async semantics). moe-sync/zero-sync updates run on
+    # device-varying gradients — their trainers take clip_norm directly
+    # (mesh-correct psum'd norm) and their constructors REJECT this
+    # chain, so the driver must not install it there. pp-sync ignores
+    # the optax optimizer entirely (built-in update) and WARNS that
+    # clip_norm does not apply (see its ignored-flags list).
+    if cfg.clip_norm is not None and cfg.resolved_algo() not in (
+        "moe-sync", "zero-sync"
+    ):
+        opt = optax.chain(optax.clip_by_global_norm(cfg.clip_norm), opt)
+    return opt
 
 
 def build_trainer(cfg: TrainConfig, model, opt, topo):
@@ -246,7 +261,8 @@ def build_trainer(cfg: TrainConfig, model, opt, topo):
         from mpit_tpu.parallel import ZeroDataParallelTrainer
 
         return ZeroDataParallelTrainer(model, opt, topo,
-                                       accum_steps=cfg.grad_accum)
+                                       accum_steps=cfg.grad_accum,
+                                       clip_norm=cfg.clip_norm)
     if algo == "seq-sync":
         return SeqParallelTrainer(model, opt, topo)
     if algo == "moe-sync":
@@ -257,7 +273,8 @@ def build_trainer(cfg: TrainConfig, model, opt, topo):
                 "algo='moe-sync' needs --moe-experts > 0 (and model="
                 "transformer)"
             )
-        return MoEParallelTrainer(model, opt, topo)
+        return MoEParallelTrainer(model, opt, topo,
+                                  clip_norm=cfg.clip_norm)
     if algo == "pp-sync":
         from mpit_tpu.parallel import PipelineParallelTrainer
 
@@ -272,6 +289,7 @@ def build_trainer(cfg: TrainConfig, model, opt, topo):
                 ("remat", cfg.remat),
                 ("optimizer", cfg.optimizer != "sgd"),
                 ("lr_schedule", cfg.lr_schedule != "constant"),
+                ("clip_norm", cfg.clip_norm is not None),
             ) if on
         ]
         if ignored:

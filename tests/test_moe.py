@@ -396,3 +396,97 @@ class TestMoETrainer:
         with pytest.raises(ValueError, match="not divisible"):
             MoEParallelTrainer(indivisible, optax.sgd(0.1), topo)
         mpit_tpu.finalize()
+
+
+class TestClipNorm:
+    """clip_norm: the mesh-correct global-norm clip the elementwise probe
+    exists to protect — equal to optax.clip_by_global_norm on the dense
+    model, and mesh-width-invariant on the sharded one."""
+
+    def _model(self, axis):
+        from mpit_tpu.models.transformer import TransformerLM
+
+        return TransformerLM(
+            vocab_size=31, num_layers=2, d_model=32, num_heads=4,
+            max_len=16, compute_dtype=jnp.float32,
+            moe_experts=16, moe_axis=axis, moe_capacity_factor=16.0,
+        )
+
+    def test_clip_matches_optax_dense_and_w_invariant(self):
+        import optax
+
+        from mpit_tpu.parallel import MoEParallelTrainer
+        from mpit_tpu.parallel.common import cross_entropy_loss
+
+        rng = np.random.default_rng(5)
+        x = rng.integers(0, 31, (8, 16)).astype(np.int32)
+        y = np.roll(x, -1, axis=1).astype(np.int32)
+        c = 0.5
+
+        # ground truth: dense model + the optax transform itself
+        mpit_tpu.finalize()
+        topo1 = mpit_tpu.init(num_workers=1)
+        dense = self._model(None)
+        params = dense.init(
+            jax.random.key(0), jnp.asarray(x[:8])
+        )["params"]
+        opt = optax.chain(optax.clip_by_global_norm(c), optax.sgd(0.1))
+        opt_state = opt.init(params)
+
+        def loss_fn(p):
+            return cross_entropy_loss(
+                dense.apply({"params": p}, jnp.asarray(x)), jnp.asarray(y)
+            )
+
+        g0 = jax.grad(loss_fn)(params)
+        assert float(optax.global_norm(g0)) > c, "clip would not engage"
+        ref_losses, ref_params = [], params
+        for _ in range(3):
+            loss, g = jax.value_and_grad(loss_fn)(ref_params)
+            upd, opt_state = opt.update(g, opt_state, ref_params)
+            ref_params = optax.apply_updates(ref_params, upd)
+            ref_losses.append(float(loss))
+        ref_params = jax.tree.map(np.asarray, jax.device_get(ref_params))
+        mpit_tpu.finalize()
+
+        got = {}
+        for w in (1, 8):
+            topo = mpit_tpu.init(num_workers=w)
+            tr = MoEParallelTrainer(
+                self._model(topo.worker_axis), optax.sgd(0.1), topo,
+                donate_state=False, clip_norm=c,
+            )
+            st = tr.init_state(jax.random.key(0), x[: max(8 // w, 1)])
+            losses = []
+            for _ in range(3):
+                st, m = tr.step(st, x, y)
+                losses.append(float(m["loss"]))
+            got[w] = (
+                losses, jax.tree.map(np.asarray, jax.device_get(st.params))
+            )
+            mpit_tpu.finalize()
+
+        for w in (1, 8):
+            np.testing.assert_allclose(
+                got[w][0], ref_losses, rtol=1e-4, atol=1e-5
+            )
+            jax.tree.map(
+                lambda a, b: np.testing.assert_allclose(
+                    a, b, rtol=3e-4, atol=3e-4
+                ),
+                got[w][1], ref_params,
+            )
+
+    def test_clip_validation(self):
+        import optax
+
+        from mpit_tpu.parallel import MoEParallelTrainer
+
+        mpit_tpu.finalize()
+        topo = mpit_tpu.init()
+        with pytest.raises(ValueError, match="clip_norm"):
+            MoEParallelTrainer(
+                self._model(topo.worker_axis), optax.sgd(0.1), topo,
+                clip_norm=-1.0,
+            )
+        mpit_tpu.finalize()

@@ -338,3 +338,22 @@ class TestExchangeDtypeFlag:
         r = run(_cfg("mnist-easgd", train_size=256, global_batch=64,
                      epochs=1, exchange_dtype="bf16"))
         assert r["trained_units"] == 1
+
+    def test_clip_norm_through_the_driver(self):
+        # chained path (sync) trains; device-varying paths (zero-sync,
+        # moe-sync) construct with the trainer-side mesh-correct clip
+        # instead of the rejected optax chain
+        import optax
+
+        from mpit_tpu.run import _build_model, build_optimizer, build_trainer
+        from mpit_tpu.comm.topology import topology as current_topology
+
+        r = run(_cfg("mnist-easgd", train_size=256, global_batch=64,
+                     epochs=1, clip_norm=0.5))
+        assert r["trained_units"] == 1
+
+        cfg = _cfg("mnist-easgd", algo="zero-sync", clip_norm=0.5)
+        topo = current_topology()
+        opt = build_optimizer(cfg, 10)
+        tr = build_trainer(cfg, _build_model(cfg, {}), opt, topo)
+        assert tr.clip_norm == 0.5  # reached the trainer, not the chain

@@ -147,3 +147,51 @@ class TestZero:
             lambda a, b: np.testing.assert_allclose(a, b, atol=3e-5),
             results[8][1], results[1][1],
         )
+
+
+class TestClipNorm:
+    def test_clip_matches_optax_chain_on_plain_dp(self, topo8):
+        """clip_norm through the chunked update == optax.clip_by_global_norm
+        on plain sync DP (where the chain IS safe, since grads are
+        pmean-ed before the update). Clipping must actually engage."""
+        model = LeNet(compute_dtype=jnp.float32)
+        x, y = _data()
+        c = 0.05  # far below a fresh LeNet's CE gradient norm
+
+        ref = DataParallelTrainer(
+            model,
+            optax.chain(optax.clip_by_global_norm(c), optax.sgd(0.1)),
+            topo8, donate_state=False,
+        )
+        st_r = ref.init_state(jax.random.key(0), x[:2])
+        # prove the threshold engages: the unclipped grad norm exceeds c
+        g = jax.grad(
+            lambda p: optax.softmax_cross_entropy_with_integer_labels(
+                model.apply({"params": p}, jnp.asarray(x)), jnp.asarray(y)
+            ).mean()
+        )(st_r.params)
+        assert float(optax.global_norm(g)) > c
+
+        zt = ZeroDataParallelTrainer(
+            model, optax.sgd(0.1), topo8, donate_state=False, clip_norm=c
+        )
+        st_z = zt.init_state(jax.random.key(0), x[:2])
+        for _ in range(3):
+            st_r, mr = ref.step(st_r, x, y)
+            st_z, mz = zt.step(st_z, x, y)
+            assert float(mz["loss"]) == pytest.approx(
+                float(mr["loss"]), rel=1e-6
+            )
+        jax.tree.map(
+            lambda p, q: np.testing.assert_allclose(
+                np.asarray(p), np.asarray(q), atol=2e-6
+            ),
+            jax.device_get(st_z.params), jax.device_get(st_r.params),
+        )
+
+    def test_clip_validation(self, topo8):
+        model = LeNet(compute_dtype=jnp.float32)
+        with pytest.raises(ValueError, match="clip_norm"):
+            ZeroDataParallelTrainer(
+                model, optax.sgd(0.1), topo8, clip_norm=0.0
+            )
