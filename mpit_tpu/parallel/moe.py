@@ -79,7 +79,7 @@ class MoEParallelTrainer:
         self.model = model
         self.optimizer = optimizer
         common.assert_elementwise_optimizer(optimizer, "MoEParallelTrainer")
-        clip_norm = common.check_clip_norm(clip_norm)
+        clip_norm = self.clip_norm = common.check_clip_norm(clip_norm)
         self.topo = topo if topo is not None else _current_topology()
         mesh = self.topo.mesh
         axis = self.topo.worker_axis

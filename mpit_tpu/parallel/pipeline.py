@@ -48,7 +48,20 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 from mpit_tpu.comm.topology import topology as _current_topology
 from mpit_tpu.comm.topology import Topology
 from mpit_tpu.models.transformer import Block
-from mpit_tpu.parallel.common import bound_cpu_dispatch
+from mpit_tpu.parallel.common import (
+    assert_elementwise_optimizer,
+    bound_cpu_dispatch,
+    check_clip_norm,
+    clip_by_global_norm_in_mesh,
+)
+
+
+def _is_blocks_leaf(path) -> bool:
+    """Stage-sharded leaves live under the top-level ``blocks`` group
+    (disjoint layer shards per pp rank); everything else is replicated
+    across pp after its psum."""
+    head = path[0] if path else None
+    return getattr(head, "key", None) == "blocks"
 
 
 def _block_module(d_model: int, num_heads: int, d_ff: int) -> Block:
@@ -311,7 +324,17 @@ class PipelineParallelTrainer:
         momentum: float = 0.9,
         schedule: str = "gpipe",
         virtual: int = 2,
+        optimizer=None,
+        clip_norm: Optional[float] = None,
     ):
+        """``optimizer``: an optax GradientTransformation replacing the
+        built-in SGD+momentum (``lr``/``momentum`` are then ignored).
+        Its update runs on stage-sharded block gradients inside
+        shard_map, so it must be ELEMENTWISE — the same behavioral probe
+        the MoE/ZeRO trainers use rejects cross-leaf transforms here.
+        ``clip_norm``: mesh-correct global-norm clipping (block shards
+        psum their sum-of-squares over pp, the replicated rest counts
+        once) — works with either optimizer path."""
         self.topo = topo if topo is not None else _current_topology()
         mesh = self.topo.mesh
         if len(mesh.axis_names) < 2 or mesh.axis_names[1] != "pp":
@@ -337,6 +360,12 @@ class PipelineParallelTrainer:
         self.seq_len = seq_len
         self.n_micro = n_micro
         self.lr, self.momentum = lr, momentum
+        self.optimizer = optimizer
+        if optimizer is not None:
+            assert_elementwise_optimizer(
+                optimizer, "PipelineParallelTrainer"
+            )
+        self.clip_norm = check_clip_norm(clip_norm)
         if schedule not in ("gpipe", "1f1b", "interleaved"):
             raise ValueError(
                 f"schedule={schedule!r} must be 'gpipe', '1f1b', or "
@@ -648,8 +677,10 @@ class PipelineParallelTrainer:
             def loss_and_grads(params, x, y):
                 return jax.value_and_grad(loss_fn)(params, x, y)
 
-        def train_step(state, x, y):
-            params, mom = state["params"], state["momentum"]
+        opt = self.optimizer
+        clip_norm = self.clip_norm
+
+        def _reduced_loss_grads(params, x, y):
             loss, grads = loss_and_grads(params, x, y)
             # the head stage owns the loss; psum makes it world-visible
             loss = lax.psum(loss, "pp")
@@ -657,6 +688,19 @@ class PipelineParallelTrainer:
             grads["rest"] = lax.psum(grads["rest"], "pp")
             grads = lax.pmean(grads, dp_axis)
             loss = lax.pmean(loss, dp_axis)
+            if clip_norm is not None:
+                # blocks are disjoint layer shards per pp rank; rest is
+                # replicated over pp (and everything is dp-consistent
+                # after the pmean above), so one psum over pp of the
+                # block sums-of-squares completes the true global norm
+                grads, _ = clip_by_global_norm_in_mesh(
+                    grads, clip_norm, "pp", is_sharded=_is_blocks_leaf
+                )
+            return loss, grads
+
+        def train_step(state, x, y):
+            params, mom = state["params"], state["momentum"]
+            loss, grads = _reduced_loss_grads(params, x, y)
             mom = jax.tree.map(
                 lambda m, g: momentum * m + g, mom, grads
             )
@@ -669,7 +713,57 @@ class PipelineParallelTrainer:
                 {"loss": loss},
             )
 
-        state_spec = {"params": spec, "momentum": spec, "step": P()}
+        def train_step_optax(state, x, y):
+            import optax
+
+            params = state["params"]
+            loss, grads = _reduced_loss_grads(params, x, y)
+            updates, opt_state = opt.update(
+                grads, state["opt_state"], params
+            )
+            params = optax.apply_updates(params, updates)
+            return (
+                {"params": params, "opt_state": opt_state,
+                 "step": state["step"] + 1},
+                {"loss": loss},
+            )
+
+        if opt is not None:
+            train_step = train_step_optax
+            # optimizer state mirrors the param tree in params-shaped
+            # SUBTREES (sgd's trace, adam's mu/nu); scalars (count) are
+            # replicated. Infer the real structure by shape-only
+            # evaluation — nothing materializes — and place the params
+            # prefix spec at every params-shaped subtree (shard_map
+            # accepts prefix pytrees).
+            p_shape = jax.eval_shape(
+                functools.partial(
+                    init_params,
+                    vocab_size=vocab_size, num_layers=num_layers,
+                    d_model=d_model, d_ff=self.d_ff, max_len=seq_len,
+                    num_heads=num_heads,
+                ),
+                jax.random.key(0),
+            )
+            params_td = jax.tree.structure(p_shape)
+
+            def is_params_like(n):
+                try:
+                    return jax.tree.structure(n) == params_td
+                except Exception:
+                    return False
+
+            opt_spec = jax.tree.map(
+                lambda n: spec if is_params_like(n) else P(),
+                jax.eval_shape(opt.init, p_shape),
+                is_leaf=is_params_like,
+            )
+            self._is_params_like = is_params_like
+            state_spec = {"params": spec, "opt_state": opt_spec,
+                          "step": P()}
+        else:
+            self._is_params_like = None
+            state_spec = {"params": spec, "momentum": spec, "step": P()}
         self._step = jax.jit(
             jax.shard_map(
                 train_step,
@@ -794,11 +888,6 @@ class PipelineParallelTrainer:
                 ),
                 "rest": params["rest"],
             }
-        state = {
-            "params": params,
-            "momentum": jax.tree.map(jnp.zeros_like, params),
-            "step": jnp.zeros((), jnp.int32),
-        }
         mesh = self.topo.mesh
 
         def group_shardings(tree):
@@ -811,6 +900,29 @@ class PipelineParallelTrainer:
                 ),
             }
 
+        if self.optimizer is not None:
+            opt_state = self.optimizer.init(params)
+            state = {
+                "params": params,
+                "opt_state": opt_state,
+                "step": jnp.zeros((), jnp.int32),
+            }
+            shardings = {
+                "params": group_shardings(params),
+                "opt_state": jax.tree.map(
+                    lambda n: group_shardings(n)
+                    if self._is_params_like(n)
+                    else NamedSharding(mesh, P()),
+                    opt_state, is_leaf=self._is_params_like,
+                ),
+                "step": NamedSharding(mesh, P()),
+            }
+            return jax.device_put(state, shardings)
+        state = {
+            "params": params,
+            "momentum": jax.tree.map(jnp.zeros_like, params),
+            "step": jnp.zeros((), jnp.int32),
+        }
         shardings = {
             "params": group_shardings(params),
             "momentum": group_shardings(params),

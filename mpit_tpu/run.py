@@ -202,11 +202,12 @@ def build_optimizer(cfg: TrainConfig, total_updates: int):
     # clip IS the async semantics). moe-sync/zero-sync updates run on
     # device-varying gradients — their trainers take clip_norm directly
     # (mesh-correct psum'd norm) and their constructors REJECT this
-    # chain, so the driver must not install it there. pp-sync ignores
-    # the optax optimizer entirely (built-in update) and WARNS that
-    # clip_norm does not apply (see its ignored-flags list).
+    # chain, so the driver must not install it there. pp-sync is in the
+    # same boat: its trainer receives this optimizer and applies it on
+    # stage-sharded block gradients inside shard_map (the probe would
+    # reject the chain), so it too takes clip_norm= directly.
     if cfg.clip_norm is not None and cfg.resolved_algo() not in (
-        "moe-sync", "zero-sync"
+        "moe-sync", "zero-sync", "pp-sync"
     ):
         opt = optax.chain(optax.clip_by_global_norm(cfg.clip_norm), opt)
     return opt
@@ -287,9 +288,6 @@ def build_trainer(cfg: TrainConfig, model, opt, topo):
             f for f, on in (
                 ("attn_impl", cfg.attn_impl != "xla"),
                 ("remat", cfg.remat),
-                ("optimizer", cfg.optimizer != "sgd"),
-                ("lr_schedule", cfg.lr_schedule != "constant"),
-                ("clip_norm", cfg.clip_norm is not None),
             ) if on
         ]
         if ignored:
@@ -297,14 +295,15 @@ def build_trainer(cfg: TrainConfig, model, opt, topo):
 
             warnings.warn(
                 f"pp-sync builds its own f32 dense-attention pipeline "
-                f"model with a built-in SGD+momentum update; {ignored} "
-                "do not apply and are ignored",
+                f"model; {ignored} do not apply and are ignored",
                 stacklevel=2,
             )
         # the pipeline builds its own stacked-leaf params; shapes come
         # off the flax model so one --model transformer config drives
-        # every trainer. Its optimizer is the built-in SGD+momentum —
-        # the same rule run() builds for everyone (cfg.lr/cfg.momentum).
+        # every trainer. It takes the SAME optax optimizer run() builds
+        # for everyone (elementwise — probe-enforced) and the
+        # mesh-correct clip_norm (the optax chain must NOT be installed
+        # for pp-sync; build_optimizer excludes it).
         return PipelineParallelTrainer(
             vocab_size=model.vocab_size,
             num_layers=model.num_layers,
@@ -314,8 +313,8 @@ def build_trainer(cfg: TrainConfig, model, opt, topo):
             d_ff=model.d_ff,
             topo=topo,
             n_micro=cfg.n_micro,
-            lr=cfg.lr,
-            momentum=cfg.momentum,
+            optimizer=opt,
+            clip_norm=cfg.clip_norm,
             schedule=cfg.pp_schedule,
             virtual=cfg.pp_virtual,
         )
@@ -385,6 +384,28 @@ def _check_resume_layout(cfg: TrainConfig) -> None:
         return  # cross-algo restore fails on structure already
     if cfg.algo != "pp-sync":
         return
+    # state-LAYOUT generation check: the pipeline state moved from
+    # {params, momentum, step} (built-in SGD) to {params, opt_state,
+    # step} (optax path). The config looks identical across that code
+    # change, so peek at the serialized top-level keys and fail clearly
+    # instead of deep inside from_bytes.
+    from flax.serialization import msgpack_restore
+
+    from mpit_tpu.utils.checkpoint import _ckpt_path
+
+    try:
+        raw = msgpack_restore(open(_ckpt_path(cfg.ckpt_dir, step), "rb").read())
+        keys = set(raw.get("state", raw).keys())
+    except Exception:
+        keys = None
+    if keys is not None and "momentum" in keys and "opt_state" not in keys:
+        raise ValueError(
+            f"checkpoint step {step} in {cfg.ckpt_dir} stores the "
+            "pre-optax pipeline state layout {params, momentum, step}; "
+            "the current pp-sync trainer keeps {params, opt_state, "
+            "step}. Restart training (or restore with an old build) — "
+            "resuming across this layout change is not supported."
+        )
     # only interleaving permutes storage: under gpipe/1f1b the stacked
     # layers are globally ordered, so a different pp extent re-shards
     # soundly on restore and a gpipe<->1f1b flip is layout-identical.

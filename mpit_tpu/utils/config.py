@@ -43,8 +43,8 @@ class TrainConfig:
     momentum: float = 0.9
     # global-norm gradient clipping (None = off). Algos whose update runs
     # on consistent gradients get optax.clip_by_global_norm chained in;
-    # moe-sync/zero-sync (device-varying grads inside shard_map, where
-    # the chain would silently desync replicas) get the trainer's
+    # moe-sync/zero-sync/pp-sync (device-varying grads inside shard_map,
+    # where the chain would silently desync replicas) get the trainer's
     # mesh-correct clip_norm instead — same math, proven equal in tests
     clip_norm: Optional[float] = None
     lr_schedule: str = "constant"

@@ -357,3 +357,25 @@ class TestExchangeDtypeFlag:
         opt = build_optimizer(cfg, 10)
         tr = build_trainer(cfg, _build_model(cfg, {}), opt, topo)
         assert tr.clip_norm == 0.5  # reached the trainer, not the chain
+
+    def test_pp_sync_pre_optax_checkpoint_rejected(self, tmp_path):
+        # a checkpoint holding the old built-in-SGD state layout
+        # ({params, momentum, step}) must fail the resume guard with a
+        # clear message, not a from_bytes structure error
+        import jax
+        import jax.numpy as jnp
+
+        from mpit_tpu.utils.checkpoint import save_checkpoint
+
+        base = _cfg("ptb-transformer-pp", pp=2, layers=2, n_micro=2,
+                    train_size=32, global_batch=16, seq_len=32, epochs=2,
+                    ckpt_dir=str(tmp_path / "ck"))
+        fake = {
+            "params": {"w": jnp.zeros((2,))},
+            "momentum": {"w": jnp.zeros((2,))},
+            "step": jnp.zeros((), jnp.int32),
+        }
+        save_checkpoint(str(tmp_path / "ck"), fake, 2,
+                        metadata={"config": base.to_json()})
+        with pytest.raises(ValueError, match="pre-optax"):
+            run(dataclasses.replace(base, resume=True))
