@@ -47,15 +47,20 @@ def generate(
     temperature: float = 0.0,
     seed: int = 0,
     rng: Optional[jax.Array] = None,
+    top_k: Optional[int] = None,
+    top_p: Optional[float] = None,
 ) -> list:
     """Continue ``prompt`` by ``steps`` tokens; returns prompt + new.
 
     ``temperature=0``: greedy argmax (deterministic). ``>0``: softmax
     sampling at that temperature, reproducible from ``seed`` (or pass an
-    explicit ``rng`` key). ``model`` must be the dense single-device
-    configuration (``seq_axis=None``).
+    explicit ``rng`` key), optionally restricted to the ``top_k``
+    highest-scoring tokens and/or the ``top_p`` probability nucleus
+    (temperature scales first, then the filters — the standard order).
+    ``model`` must be the dense single-device configuration
+    (``seq_axis=None``).
     """
-    _validate(model, prompt, temperature)
+    _validate(model, prompt, temperature, top_k, top_p)
     length = model.max_len
     buf = jnp.zeros((1, length), jnp.int32)
     buf = buf.at[0, : len(prompt)].set(jnp.asarray(prompt, jnp.int32))
@@ -70,7 +75,8 @@ def generate(
             pos = length - 1
         logits = _apply(model, params, buf)[0, pos - 1]
         if temperature > 0:
-            nxt = jax.random.categorical(keys[i], logits / temperature)
+            scaled = _filter_logits(logits / temperature, top_k, top_p)
+            nxt = jax.random.categorical(keys[i], scaled)
         else:
             nxt = jnp.argmax(logits)
         buf = buf.at[0, pos].set(nxt)
@@ -79,7 +85,7 @@ def generate(
     return toks
 
 
-def _validate(model, prompt, temperature):
+def _validate(model, prompt, temperature, top_k=None, top_p=None):
     """Shared argument checks for both recipes."""
     if getattr(model, "seq_axis", None) is not None:
         raise ValueError(
@@ -92,6 +98,18 @@ def _validate(model, prompt, temperature):
         )
     if temperature < 0:
         raise ValueError(f"temperature={temperature} must be >= 0")
+    if top_k is not None and not 1 <= top_k <= model.vocab_size:
+        raise ValueError(
+            f"top_k={top_k} must be in [1, vocab_size={model.vocab_size}]"
+        )
+    if top_p is not None and not 0.0 < top_p <= 1.0:
+        raise ValueError(f"top_p={top_p} must be in (0, 1]")
+    if (top_k is not None or top_p is not None) and temperature == 0:
+        raise ValueError(
+            "top_k/top_p shape the SAMPLING distribution; temperature=0 "
+            "is greedy argmax, which they cannot affect — set "
+            "temperature > 0"
+        )
     bad = [t for t in prompt if not 0 <= int(t) < model.vocab_size]
     if bad:
         raise ValueError(
@@ -99,6 +117,31 @@ def _validate(model, prompt, temperature):
             f"{model.vocab_size}) — XLA would silently clamp the "
             "embedding lookup"
         )
+
+
+def _filter_logits(logits, top_k, top_p):
+    """Mask logits outside the top-k set and/or the top-p nucleus to
+    -inf (jit-safe, static shapes). The ONE filter both recipes share —
+    what makes their sampled streams comparable at a fixed seed.
+
+    top-p keeps the smallest prefix of probability-sorted tokens whose
+    cumulative mass reaches ``top_p`` (the token that crosses the
+    threshold is kept — standard nucleus rule), so at least one token
+    always survives; ties at the top-k boundary keep every token equal
+    to the k-th value (strictly-less masking).
+    """
+    if top_k is not None:
+        kth = jax.lax.top_k(logits, top_k)[0][-1]
+        logits = jnp.where(logits < kth, -jnp.inf, logits)
+    if top_p is not None:
+        order = jnp.argsort(logits)[::-1]  # descending
+        probs = jax.nn.softmax(logits[order])
+        # mass STRICTLY BEFORE each token; the crossing token stays
+        before = jnp.cumsum(probs) - probs
+        keep_sorted = before < top_p
+        keep = jnp.zeros_like(keep_sorted).at[order].set(keep_sorted)
+        logits = jnp.where(keep, logits, -jnp.inf)
+    return logits
 
 
 @functools.lru_cache(maxsize=32)
@@ -120,9 +163,10 @@ def _zero_cache(dec):
     )
 
 
-@functools.partial(jax.jit, static_argnums=(0, 1, 2))
+@functools.partial(jax.jit, static_argnums=(0, 1, 2, 3, 4))
 def _decode_scan(
-    model, scan_len, greedy, params, cache0, buf, p_len, keys, temp
+    model, scan_len, greedy, top_k, use_top_p,
+    params, cache0, buf, p_len, keys, temp, top_p,
 ):
     """The whole prompt+generation pass as ONE compiled program.
 
@@ -150,8 +194,14 @@ def _decode_scan(
             nxt = jnp.argmax(logits).astype(jnp.int32)
         else:
             j = jnp.clip(t - (p_len - 1), 0, keys.shape[0] - 1)
+            # top_k must be static (lax.top_k shape); top_p is a plain
+            # elementwise threshold, kept traced so a nucleus sweep
+            # reuses ONE compiled program (use_top_p gates the branch)
+            scaled = _filter_logits(
+                logits / temp, top_k, top_p if use_top_p else None
+            )
             nxt = jax.random.categorical(
-                keys[j], logits / temp
+                keys[j], scaled
             ).astype(jnp.int32)
         return (mut["cache"], nxt), nxt
 
@@ -171,6 +221,8 @@ def generate_fast(
     temperature: float = 0.0,
     seed: int = 0,
     rng: Optional[jax.Array] = None,
+    top_k: Optional[int] = None,
+    top_p: Optional[float] = None,
 ) -> list:
     """KV-cached generation: continue ``prompt`` by ``steps`` tokens.
 
@@ -188,7 +240,7 @@ def generate_fast(
       flash-attention model the greedy-equality pin versus
       :func:`generate` holds only up to that kernel's numerics.
     """
-    _validate(model, prompt, temperature)
+    _validate(model, prompt, temperature, top_k, top_p)
     total = len(prompt) + steps
     if total > model.max_len:
         raise ValueError(
@@ -226,8 +278,12 @@ def generate_fast(
             [keys, jnp.repeat(keys[-1:], scan_len - keys.shape[0], axis=0)]
         )
     toks = _decode_scan(
-        dec, scan_len, temperature == 0.0, params, cache0, buf,
+        dec, scan_len, temperature == 0.0, top_k, top_p is not None,
+        params, cache0, buf,
         jnp.asarray(len(prompt), jnp.int32), keys,
         jnp.asarray(max(temperature, 1e-9), jnp.float32),
+        jnp.asarray(
+            1.0 if top_p is None else top_p, jnp.float32
+        ),
     )
     return [int(t) for t in jax.device_get(toks[:total])]

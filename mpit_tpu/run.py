@@ -389,13 +389,20 @@ def _check_resume_layout(cfg: TrainConfig) -> None:
     # step} (optax path). The config looks identical across that code
     # change, so peek at the serialized top-level keys and fail clearly
     # instead of deep inside from_bytes.
-    from flax.serialization import msgpack_restore
-
     from mpit_tpu.utils.checkpoint import _ckpt_path
 
     try:
-        raw = msgpack_restore(open(_ckpt_path(cfg.ckpt_dir, step), "rb").read())
-        keys = set(raw.get("state", raw).keys())
+        # stream ONLY the top-level map keys — deserializing the full
+        # tree here would double resume I/O and spike host memory just
+        # to look at three strings
+        import msgpack
+
+        with open(_ckpt_path(cfg.ckpt_dir, step), "rb") as f:
+            unp = msgpack.Unpacker(f, raw=False)
+            keys = set()
+            for _ in range(unp.read_map_header()):
+                keys.add(unp.unpack())
+                unp.skip()
     except Exception:
         keys = None
     if keys is not None and "momentum" in keys and "opt_state" not in keys:

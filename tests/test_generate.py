@@ -181,3 +181,109 @@ def test_decode_mode_rejects_parallel_configs(topo8):
     moe = _model().clone(decode=True, moe_experts=2)
     with pytest.raises(ValueError, match="dense-FFN"):
         moe.init(jax.random.key(0), jnp.zeros((1, 1), jnp.int32))
+
+
+# ------------------------------------------------------------ top-k / top-p
+
+
+def test_filter_logits_unit(topo8):
+    from mpit_tpu.models.sampling import _filter_logits
+
+    logits = jnp.array([0.0, 1.0, 2.0, 3.0])
+    out = np.asarray(_filter_logits(logits, 2, None))
+    assert np.isneginf(out[[0, 1]]).all() and (out[[2, 3]] == [2, 3]).all()
+    # nucleus: softmax([0,1,2,3]) ~ [.032,.087,.237,.644]. top_p=0.6:
+    # token 3 alone crosses (its before-mass 0 < .6; token 2's before-
+    # mass .644 >= .6 -> dropped)
+    out = np.asarray(_filter_logits(logits, None, 0.6))
+    assert np.isneginf(out[[0, 1, 2]]).all() and out[3] == 3.0
+    # top_p=0.85: {3, 2} (token 1's before-mass .881 >= .85 -> dropped)
+    out = np.asarray(_filter_logits(logits, None, 0.85))
+    assert np.isneginf(out[[0, 1]]).all() and (out[[2, 3]] == [2, 3]).all()
+    # ties at the k-th value all survive
+    out = np.asarray(_filter_logits(jnp.array([1.0, 2.0, 2.0, 0.0]), 2, None))
+    assert np.isneginf(out[[0, 3]]).all() and (out[[1, 2]] == 2.0).all()
+
+
+def test_top_k_one_is_greedy(topo8):
+    model = _model()
+    params = model.init(
+        jax.random.key(0), jnp.zeros((1, T), jnp.int32)
+    )["params"]
+    from mpit_tpu.models import generate_fast
+
+    greedy = generate(model, params, [3, 1], steps=6)
+    for fn in (generate, generate_fast):
+        assert fn(
+            model, params, [3, 1], steps=6, temperature=1.0, top_k=1,
+            seed=9,
+        ) == greedy, fn.__name__
+
+
+def test_top_filters_match_across_recipes(topo8):
+    model = _model()
+    params = model.init(
+        jax.random.key(0), jnp.zeros((1, T), jnp.int32)
+    )["params"]
+    from mpit_tpu.models import generate_fast
+
+    for kw in ({"top_k": 3}, {"top_p": 0.8}, {"top_k": 5, "top_p": 0.9}):
+        a = generate(
+            model, params, [1, 2], steps=6, temperature=0.9, seed=4, **kw
+        )
+        b = generate_fast(
+            model, params, [1, 2], steps=6, temperature=0.9, seed=4, **kw
+        )
+        assert a == b, kw
+
+
+def test_top_k_restricts_support(topo8):
+    """Every sampled token must be one of the k most likely at its
+    step: check against the step-by-step argsort of the slow recipe."""
+    model = _model()
+    params = model.init(
+        jax.random.key(0), jnp.zeros((1, T), jnp.int32)
+    )["params"]
+    k = 2
+    for seed in range(4):
+        toks = generate(
+            model, params, [5], steps=5, temperature=2.0, top_k=k,
+            seed=seed,
+        )
+        # recompute each step's top-k set from the prefix
+        for i in range(1, 6):
+            prefix = toks[:i]
+            logits = model.apply(
+                {"params": params}, jnp.asarray(prefix, jnp.int32)[None]
+            )[0, -1]
+            allowed = set(np.argsort(np.asarray(logits))[-k:].tolist())
+            assert toks[i] in allowed, (seed, i)
+
+
+def test_top_filter_validation(topo8):
+    model = _model()
+    params = model.init(
+        jax.random.key(0), jnp.zeros((1, T), jnp.int32)
+    )["params"]
+    with pytest.raises(ValueError, match="top_k"):
+        generate(model, params, [1], 2, temperature=1.0, top_k=0)
+    with pytest.raises(ValueError, match="top_p"):
+        generate(model, params, [1], 2, temperature=1.0, top_p=1.5)
+    with pytest.raises(ValueError, match="greedy"):
+        generate(model, params, [1], 2, top_k=3)
+
+
+def test_top_p_sweep_shares_one_program(topo8):
+    """top_p is a traced threshold: sweeping nucleus values must not
+    recompile the decode scan (only top_k changes the program)."""
+    model = _model()
+    params = model.init(
+        jax.random.key(0), jnp.zeros((1, T), jnp.int32)
+    )["params"]
+    from mpit_tpu.models import generate_fast, sampling
+
+    generate_fast(model, params, [1], 8, temperature=1.0, top_p=0.5)
+    n0 = sampling._decode_scan._cache_size()
+    for p in (0.6, 0.8, 0.9, 0.95):
+        generate_fast(model, params, [1], 8, temperature=1.0, top_p=p)
+    assert sampling._decode_scan._cache_size() == n0
