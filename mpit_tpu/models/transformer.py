@@ -326,6 +326,11 @@ class TransformerLM(nn.Module):
             if ready:
                 pidx.value = offset + t_local
             total_len = 1  # bounds are the caller's contract in decode
+        elif self.seq_axis is not None:
+            # sequence-parallel: this shard's tokens are the ring-rank'th
+            # contiguous block, so positions are GLOBAL offsets
+            total_len = t_local * jax.lax.axis_size(self.seq_axis)
+            offset = jax.lax.axis_index(self.seq_axis) * t_local
         if total_len > self.max_len:
             raise ValueError(
                 f"sequence of {total_len} exceeds max_len={self.max_len}"
