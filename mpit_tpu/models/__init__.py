@@ -14,7 +14,11 @@ BASELINE.json config:
 
 from mpit_tpu.models.lenet import LeNet  # noqa: F401
 from mpit_tpu.models.mlp import MLP  # noqa: F401
-from mpit_tpu.models.sampling import generate, generate_fast  # noqa: F401
+from mpit_tpu.models.sampling import (  # noqa: F401
+    beam_search,
+    generate,
+    generate_fast,
+)
 
 _REGISTRY = {"lenet": LeNet, "mlp": MLP}
 

@@ -145,21 +145,22 @@ def _filter_logits(logits, top_k, top_p):
 
 
 @functools.lru_cache(maxsize=32)
-def _cache_shapes(dec):
+def _cache_shapes(dec, batch):
     """Shape inference for a decode-mode model's ``cache`` collection —
     host-side ShapeDtypeStructs only, so caching them pins no device
-    memory (and no parameter initialization ever executes)."""
+    memory (and no parameter initialization ever executes). ``batch`` is
+    the decode batch (1 for generate_fast, beam width for beam_search)."""
     return jax.eval_shape(
-        dec.init, jax.random.key(0), jnp.zeros((1, 1), jnp.int32)
+        dec.init, jax.random.key(0), jnp.zeros((batch, 1), jnp.int32)
     )["cache"]
 
 
-def _zero_cache(dec):
+def _zero_cache(dec, batch=1):
     """Fresh all-zeros cache per call: the arrays die with the request
     instead of being pinned in an lru slot (zeros are cheap; the traced
     init shape inference is the part worth caching)."""
     return jax.tree.map(
-        lambda s: jnp.zeros(s.shape, s.dtype), _cache_shapes(dec)
+        lambda s: jnp.zeros(s.shape, s.dtype), _cache_shapes(dec, batch)
     )
 
 
@@ -241,30 +242,10 @@ def generate_fast(
       :func:`generate` holds only up to that kernel's numerics.
     """
     _validate(model, prompt, temperature, top_k, top_p)
-    total = len(prompt) + steps
-    if total > model.max_len:
-        raise ValueError(
-            f"prompt+steps = {total} exceeds max_len={model.max_len}; "
-            "the KV cache cannot slide — use generate() for overflow"
-        )
     if steps <= 0:
-        return [int(t) for t in prompt]
-    dec = model.clone(
-        decode=True, remat=False, seq_axis=None, attn_impl="xla"
-    )
+        return [int(t) for t in prompt]  # prompt length already validated
+    dec, scan_len, buf, total = _decode_setup(model, prompt, steps)
     cache0 = _zero_cache(dec)
-    # bucket the scan so repeated calls with nearby lengths share one
-    # compile; extra steps feed already-sampled tokens and their outputs
-    # are discarded. The min() with max_len keeps every cache write and
-    # positional-embedding gather strictly in bounds (index peaks at
-    # scan_len-1 ≤ max_len-1) — enlarge the bucket past max_len and both
-    # would clamp silently, so don't.
-    scan_len = 1
-    while scan_len < total - 1:
-        scan_len *= 2
-    scan_len = min(scan_len, model.max_len)
-    buf = jnp.zeros((scan_len + 1,), jnp.int32)
-    buf = buf.at[: len(prompt)].set(jnp.asarray(prompt, jnp.int32))
     if rng is None:
         rng = jax.random.key(seed)
     # the key STREAM must match generate()'s split(rng, steps) exactly,
@@ -287,3 +268,163 @@ def generate_fast(
         ),
     )
     return [int(t) for t in jax.device_get(toks[:total])]
+
+
+def _decode_setup(model, prompt, steps):
+    """Shared generate_fast/beam_search setup: the decode-mode clone,
+    the power-of-two-bucketed scan length (capped at max_len so every
+    cache write and positional gather stays strictly in bounds — enlarge
+    the bucket past max_len and both would clamp silently, so don't),
+    and the prompt buffer. ONE copy of the overflow contract."""
+    total = len(prompt) + steps
+    if total > model.max_len:
+        raise ValueError(
+            f"prompt+steps = {total} exceeds max_len={model.max_len}; "
+            "the KV cache cannot slide — use generate() for overflow"
+        )
+    dec = model.clone(
+        decode=True, remat=False, seq_axis=None, attn_impl="xla"
+    )
+    scan_len = 1
+    while scan_len < total - 1:
+        scan_len *= 2
+    scan_len = min(scan_len, model.max_len)
+    buf = jnp.zeros((scan_len + 1,), jnp.int32)
+    buf = buf.at[: len(prompt)].set(jnp.asarray(prompt, jnp.int32))
+    return dec, scan_len, buf, total
+
+
+@functools.partial(jax.jit, static_argnums=(0, 1, 2, 3))
+def _beam_scan(
+    model, scan_len, beam, eos_id, params, cache0, buf, p_len, limit
+):
+    """Fixed-budget beam search as ONE compiled program.
+
+    Beams ride the decode batch dimension: the K/V caches are (beam, ...)
+    and every survivor-selection step REORDERS them by parent beam with a
+    plain gather (the standard recipe — cheap relative to the matmuls).
+    During the prompt ticks every beam is forced onto the prompt token
+    and scores stay [0, -inf, ...], so the first free expansion picks
+    the ``beam`` best distinct continuations of beam 0, exactly the
+    textbook initialization. ``eos_id`` (static; None = fixed-length): a
+    finished beam's only allowed continuation is another ``eos_id`` at
+    zero cost, freezing its score while the budget runs out. ``limit``
+    (traced, = p_len + steps): bucket-overrun ticks at or past the
+    budget freeze EVERYTHING — parents, scores, done — so the final
+    ranking reflects exactly ``steps`` expansions, not the bucket's
+    horizon (the _decode_scan analogue merely discards outputs; a beam
+    ranking must be frozen, not just ignored).
+
+    Returns ``(tokens (beam, scan_len+1), scores (beam,))`` sorted by
+    construction of the final top-k (row 0 need not be best — the caller
+    argmaxes over scores).
+    """
+    vocab = model.vocab_size
+
+    def gather_beams(tree, parents):
+        return jax.tree.map(
+            lambda a: a[parents]
+            if getattr(a, "ndim", 0) >= 1 and a.shape[0] == beam
+            else a,
+            tree,
+        )
+
+    toks0 = jnp.broadcast_to(buf, (beam, buf.shape[0])).astype(jnp.int32)
+    scores0 = jnp.full((beam,), -jnp.inf).at[0].set(0.0)
+    done0 = jnp.zeros((beam,), bool)
+    prev0 = jnp.broadcast_to(buf[0], (beam,)).astype(jnp.int32)
+
+    def step(carry, t):
+        cache, toks, scores, done, prev = carry
+        logits, mut = model.apply(
+            {"params": params, "cache": cache},
+            prev[:, None],
+            mutable=["cache"],
+        )
+        cache = mut["cache"]
+        logp = jax.nn.log_softmax(
+            logits[:, 0].astype(jnp.float32), axis=-1
+        )
+        cand = scores[:, None] + logp  # (beam, vocab)
+        if eos_id is not None:
+            # finished beams may only emit eos again, at zero cost
+            pad_row = jnp.full((vocab,), -jnp.inf).at[eos_id].set(0.0)
+            cand = jnp.where(
+                done[:, None], scores[:, None] + pad_row[None, :], cand
+            )
+        top_scores, top_idx = jax.lax.top_k(cand.reshape(-1), beam)
+        parents = top_idx // vocab
+        chosen = (top_idx % vocab).astype(jnp.int32)
+        # prompt ticks: every beam stays itself and feeds the known
+        # token; overrun ticks (budget exhausted): freeze entirely
+        in_prefill = t + 1 < p_len
+        # generated positions are t+1 in [p_len, limit-1]; at t+1 >= limit
+        # the steps budget is spent
+        frozen = t + 1 >= limit
+        keep = in_prefill | frozen
+        parents = jnp.where(keep, jnp.arange(beam), parents)
+        chosen = jnp.where(
+            in_prefill, buf[t + 1], jnp.where(frozen, prev, chosen)
+        )
+        scores = jnp.where(keep, scores, top_scores)
+        cache = gather_beams(cache, parents)
+        toks = toks[parents].at[:, t + 1].set(chosen)
+        if eos_id is not None:
+            done = jnp.where(
+                keep, done, done[parents] | (chosen == eos_id)
+            )
+        return (cache, toks, scores, done, chosen), None
+
+    (cache, toks, scores, done, _), _ = jax.lax.scan(
+        step, (cache0, toks0, scores0, done0, prev0),
+        jnp.arange(scan_len),
+    )
+    return toks, scores
+
+
+def beam_search(
+    model,
+    params,
+    prompt: Sequence[int],
+    steps: int,
+    beam_size: int = 4,
+    eos_id: Optional[int] = None,
+) -> "tuple[list, float]":
+    """Beam-search decoding over the KV-cached model: the highest
+    log-probability continuation of ``prompt`` found with ``beam_size``
+    beams and a fixed budget of ``steps`` expansions.
+
+    Returns ``(tokens, score)`` — the best sequence (prompt included,
+    truncated just past the first ``eos_id`` beyond the prompt when one
+    was emitted) and its total log-probability (raw sum; no length
+    penalty). ``beam_size=1`` is exactly greedy :func:`generate_fast`.
+    Same model restrictions as :func:`generate_fast` (no MoE, fits in
+    ``max_len``); with ``beam_size`` large enough to hold every partial
+    hypothesis the search is exhaustive — pinned against brute-force
+    enumeration in tests.
+    """
+    _validate(model, prompt, 0.0)
+    if beam_size < 1:
+        raise ValueError(f"beam_size={beam_size} must be >= 1")
+    if eos_id is not None and not 0 <= eos_id < model.vocab_size:
+        raise ValueError(
+            f"eos_id={eos_id} outside [0, vocab_size={model.vocab_size})"
+        )
+    if steps <= 0:
+        return [int(t) for t in prompt], 0.0
+    dec, scan_len, buf, total = _decode_setup(model, prompt, steps)
+    toks, scores = _beam_scan(
+        dec, scan_len, beam_size, eos_id,
+        params, _zero_cache(dec, beam_size), buf,
+        jnp.asarray(len(prompt), jnp.int32),
+        jnp.asarray(total, jnp.int32),
+    )
+    best = int(jnp.argmax(scores))
+    seq = [int(t) for t in jax.device_get(toks[best, :total])]
+    score = float(scores[best])
+    if eos_id is not None:
+        for i in range(len(prompt), len(seq)):
+            if seq[i] == eos_id:
+                seq = seq[: i + 1]
+                break
+    return seq, score

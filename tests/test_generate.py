@@ -287,3 +287,140 @@ def test_top_p_sweep_shares_one_program(topo8):
     for p in (0.6, 0.8, 0.9, 0.95):
         generate_fast(model, params, [1], 8, temperature=1.0, top_p=p)
     assert sampling._decode_scan._cache_size() == n0
+
+
+# --------------------------------------------------------------- beam search
+
+
+def test_beam_one_is_greedy(topo8):
+    model = _model()
+    params = model.init(
+        jax.random.key(0), jnp.zeros((1, T), jnp.int32)
+    )["params"]
+    from mpit_tpu.models import beam_search, generate_fast
+
+    seq, score = beam_search(model, params, [3, 1, 4], steps=6, beam_size=1)
+    assert seq == generate_fast(model, params, [3, 1, 4], steps=6)
+    assert np.isfinite(score)
+
+
+def test_beam_matches_brute_force(topo8):
+    """With beam_size >= V^(steps-1) the search is exhaustive: its best
+    sequence must equal the argmax over ALL V^steps continuations scored
+    by the full forward."""
+    import itertools
+
+    model = TransformerLM(
+        vocab_size=5, num_layers=1, d_model=16, num_heads=2, max_len=8,
+        compute_dtype=jnp.float32,
+    )
+    params = model.init(
+        jax.random.key(2), jnp.zeros((1, 8), jnp.int32)
+    )["params"]
+    from mpit_tpu.models import beam_search
+
+    prompt, steps = [2, 0], 3
+    seq, score = beam_search(
+        model, params, prompt, steps=steps, beam_size=25
+    )
+
+    best_bf, best_score = None, -np.inf
+    for cont in itertools.product(range(5), repeat=steps):
+        toks = prompt + list(cont)
+        logits = model.apply(
+            {"params": params}, jnp.asarray(toks, jnp.int32)[None]
+        )[0]
+        logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+        s = sum(
+            float(logp[len(prompt) - 1 + i, cont[i]]) for i in range(steps)
+        )
+        if s > best_score:
+            best_bf, best_score = toks, s
+    assert seq == best_bf, (seq, best_bf)
+    assert score == pytest.approx(best_score, abs=1e-3)
+
+
+def test_beam_finds_no_worse_than_greedy(topo8):
+    model = _model()
+    params = model.init(
+        jax.random.key(1), jnp.zeros((1, T), jnp.int32)
+    )["params"]
+    from mpit_tpu.models import beam_search
+
+    _, s1 = beam_search(model, params, [5, 2], steps=8, beam_size=1)
+    _, s4 = beam_search(model, params, [5, 2], steps=8, beam_size=4)
+    assert s4 >= s1 - 1e-5
+
+
+def _replay_logprob(model, params, seq, p_len):
+    """Sum of log P(seq[i] | seq[:i]) over the generated positions —
+    the score beam_search must report for the sequence it returns."""
+    logits = model.apply(
+        {"params": params}, jnp.asarray(seq, jnp.int32)[None]
+    )[0]
+    logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+    return sum(
+        float(logp[i - 1, seq[i]]) for i in range(p_len, len(seq))
+    )
+
+
+def test_beam_eos_truncates_and_freezes(topo8):
+    """A beam that emits eos keeps its score frozen; the returned
+    sequence is cut just past the first eos beyond the prompt, and the
+    reported score equals the replayed log-prob of exactly the returned
+    tokens (overrun/eos padding contributing would break this). The eos
+    id is chosen as greedy's third token so it is certainly emitted."""
+    model = _model()
+    params = model.init(
+        jax.random.key(0), jnp.zeros((1, T), jnp.int32)
+    )["params"]
+    from mpit_tpu.models import beam_search, generate_fast
+
+    prompt = [1, 2]
+    eos = generate_fast(model, params, prompt, steps=3)[4]
+    seq, score = beam_search(
+        model, params, prompt, steps=10, beam_size=4, eos_id=eos
+    )
+    body = seq[len(prompt):]
+    assert eos in body, "setup broken: chosen eos never emitted"
+    assert seq[-1] == eos and eos not in body[:-1]
+    assert len(seq) < len(prompt) + 10
+    assert score == pytest.approx(
+        _replay_logprob(model, params, seq, len(prompt)), abs=1e-3
+    )
+
+
+def test_beam_score_is_replayable_at_non_pow2_budget(topo8):
+    """steps whose scan bucket overruns the budget (total-1 not a power
+    of two) must still return a score equal to the replayed log-prob of
+    the returned tokens — the overrun ticks are frozen, not expanded."""
+    model = _model()
+    params = model.init(
+        jax.random.key(1), jnp.zeros((1, T), jnp.int32)
+    )["params"]
+    from mpit_tpu.models import beam_search
+
+    prompt = [4, 4]
+    for steps in (4, 6, 9):  # total-1 = 5, 7, 10 -> buckets 8, 8, 16
+        seq, score = beam_search(
+            model, params, prompt, steps=steps, beam_size=3
+        )
+        assert len(seq) == len(prompt) + steps
+        assert score == pytest.approx(
+            _replay_logprob(model, params, seq, len(prompt)), abs=1e-3
+        ), steps
+
+
+def test_beam_validation(topo8):
+    model = _model()
+    params = model.init(
+        jax.random.key(0), jnp.zeros((1, T), jnp.int32)
+    )["params"]
+    from mpit_tpu.models import beam_search
+
+    with pytest.raises(ValueError, match="beam_size"):
+        beam_search(model, params, [1], 2, beam_size=0)
+    with pytest.raises(ValueError, match="eos_id"):
+        beam_search(model, params, [1], 2, eos_id=99)
+    with pytest.raises(ValueError, match="cannot slide"):
+        beam_search(model, params, list(range(10)), steps=T)
