@@ -17,6 +17,7 @@ from mpit_tpu.models.mlp import MLP  # noqa: F401
 from mpit_tpu.models.sampling import (  # noqa: F401
     beam_search,
     generate,
+    generate_batch,
     generate_fast,
 )
 

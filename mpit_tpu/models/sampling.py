@@ -164,56 +164,6 @@ def _zero_cache(dec, batch=1):
     )
 
 
-@functools.partial(jax.jit, static_argnums=(0, 1, 2, 3, 4))
-def _decode_scan(
-    model, scan_len, greedy, top_k, use_top_p,
-    params, cache0, buf, p_len, keys, temp, top_p,
-):
-    """The whole prompt+generation pass as ONE compiled program.
-
-    ``model`` is the decode-mode clone; ``scan_len`` the bucketed step
-    count (static — at most log₂(max_len) distinct compiles per model);
-    ``buf`` the (scan_len+1,) token buffer holding the prompt (suffix
-    arbitrary); ``p_len`` the traced prompt length. Step t feeds the
-    token at position t (prompt token while t < p_len, else the
-    previously sampled one) and samples position t+1 from the returned
-    logits with keys[t - (p_len-1)] — the same per-generated-token key
-    stream :func:`generate` uses, which is what makes the two recipes
-    comparable at a fixed seed.
-    """
-
-    def step(carry, t):
-        cache, prev = carry
-        tok = jnp.where(t < p_len, buf[t], prev)
-        logits, mut = model.apply(
-            {"params": params, "cache": cache},
-            tok[None, None],
-            mutable=["cache"],
-        )
-        logits = logits[0, 0]
-        if greedy:
-            nxt = jnp.argmax(logits).astype(jnp.int32)
-        else:
-            j = jnp.clip(t - (p_len - 1), 0, keys.shape[0] - 1)
-            # top_k must be static (lax.top_k shape); top_p is a plain
-            # elementwise threshold, kept traced so a nucleus sweep
-            # reuses ONE compiled program (use_top_p gates the branch)
-            scaled = _filter_logits(
-                logits / temp, top_k, top_p if use_top_p else None
-            )
-            nxt = jax.random.categorical(
-                keys[j], scaled
-            ).astype(jnp.int32)
-        return (mut["cache"], nxt), nxt
-
-    (_, _), nxt = jax.lax.scan(
-        step, (cache0, buf[0]), jnp.arange(scan_len)
-    )
-    # position t+1's token: prompt while t+1 < p_len, else sampled
-    out = jnp.where(jnp.arange(1, scan_len + 1) < p_len, buf[1:], nxt)
-    return jnp.concatenate([buf[:1], out])
-
-
 def generate_fast(
     model,
     params,
@@ -229,9 +179,10 @@ def generate_fast(
 
     Same sampling semantics as :func:`generate` (greedy at
     ``temperature=0``, else softmax sampling keyed per generated token),
-    but O(T·d) per token and compiled as one program — the serving path.
-    Narrower model support than :func:`generate`, which handles anything
-    dense ``apply`` can run:
+    but O(T·d) per token and compiled as one program — the serving path
+    (the N=1 row of the batched decode kernel). Narrower model support
+    than :func:`generate`, which handles anything dense ``apply`` can
+    run:
 
     - no window sliding — ``len(prompt) + steps`` must fit in
       ``model.max_len``;
@@ -244,30 +195,11 @@ def generate_fast(
     _validate(model, prompt, temperature, top_k, top_p)
     if steps <= 0:
         return [int(t) for t in prompt]  # prompt length already validated
-    dec, scan_len, buf, total = _decode_setup(model, prompt, steps)
-    cache0 = _zero_cache(dec)
     if rng is None:
         rng = jax.random.key(seed)
-    # the key STREAM must match generate()'s split(rng, steps) exactly,
-    # but the key SHAPE must depend only on the bucket — otherwise every
-    # distinct steps value would recompile the scan. Pad with repeats of
-    # the last key: padded slots are only ever indexed by discarded
-    # bucket-overrun steps (kept tokens clip j to steps-1 and below).
-    keys = jax.random.split(rng, max(steps, 1))
-    if keys.shape[0] < scan_len:
-        keys = jnp.concatenate(
-            [keys, jnp.repeat(keys[-1:], scan_len - keys.shape[0], axis=0)]
-        )
-    toks = _decode_scan(
-        dec, scan_len, temperature == 0.0, top_k, top_p is not None,
-        params, cache0, buf,
-        jnp.asarray(len(prompt), jnp.int32), keys,
-        jnp.asarray(max(temperature, 1e-9), jnp.float32),
-        jnp.asarray(
-            1.0 if top_p is None else top_p, jnp.float32
-        ),
-    )
-    return [int(t) for t in jax.device_get(toks[:total])]
+    return _generate_rows(
+        model, params, [prompt], steps, temperature, [rng], top_k, top_p
+    )[0]
 
 
 def _decode_setup(model, prompt, steps):
@@ -428,3 +360,150 @@ def beam_search(
                 seq = seq[: i + 1]
                 break
     return seq, score
+
+
+@functools.partial(jax.jit, static_argnums=(0, 1, 2, 3, 4))
+def _batch_decode_scan(
+    model, scan_len, greedy, top_k, use_top_p,
+    params, cache0, buf, p_lens, keys, temp, top_p,
+):
+    """N sequences through one compiled decode scan.
+
+    Rows share the position clock (tick t IS sequence position t for
+    every row — the cache index and positional embedding are scalars),
+    but each row transitions from prompt-feeding to sampling at its OWN
+    ``p_lens[n]``: at tick t row n feeds its prompt token while
+    ``t < p_lens[n]`` and its previous sample after. Each row draws
+    from its own key stream; generate_fast IS the N=1 case and
+    generate_batch folds the row index into the rng, which is what pins
+    each batched row equal to a single-row call. top_k must be static
+    (lax.top_k shape); top_p rides traced behind the static use_top_p
+    gate so a nucleus sweep reuses one compiled program.
+    """
+
+    def step(carry, t):
+        cache, prev = carry  # prev: (N,)
+        tok = jnp.where(t < p_lens, buf[:, t], prev)
+        logits, mut = model.apply(
+            {"params": params, "cache": cache},
+            tok[:, None],
+            mutable=["cache"],
+        )
+        logits = logits[:, 0]  # (N, V)
+        if greedy:
+            nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+        else:
+            j = jnp.clip(t - (p_lens - 1), 0, keys.shape[1] - 1)
+            row_keys = jax.vmap(lambda ks, i: ks[i])(keys, j)
+            scaled = jax.vmap(
+                lambda l: _filter_logits(
+                    l / temp, top_k, top_p if use_top_p else None
+                )
+            )(logits)
+            nxt = jax.vmap(jax.random.categorical)(
+                row_keys, scaled
+            ).astype(jnp.int32)
+        return (mut["cache"], nxt), nxt
+
+    (_, _), nxt = jax.lax.scan(
+        step, (cache0, buf[:, 0]), jnp.arange(scan_len)
+    )
+    nxt = nxt.swapaxes(0, 1)  # (N, scan_len)
+    pos = jnp.arange(1, scan_len + 1)[None, :]
+    out = jnp.where(pos < p_lens[:, None], buf[:, 1:], nxt)
+    return jnp.concatenate([buf[:, :1], out], axis=1)
+
+
+def generate_batch(
+    model,
+    params,
+    prompts: "Sequence[Sequence[int]]",
+    steps: int,
+    temperature: float = 0.0,
+    seed: int = 0,
+    rng: Optional[jax.Array] = None,
+    top_k: Optional[int] = None,
+    top_p: Optional[float] = None,
+) -> "list[list]":
+    """Continue N prompts by ``steps`` tokens each, in ONE compiled
+    decode scan over a (N, ...) K/V cache — the batched serving path.
+
+    Row ``n`` is pinned exactly equal to
+    ``generate_fast(..., prompts[n], rng=fold_in(rng, n))``: rows share
+    the position clock but transition from prompt to sampling at their
+    own lengths, and each draws from its own per-row key stream. Same
+    model restrictions as :func:`generate_fast`; the scan runs to the
+    LONGEST prompt's budget (shorter rows' overrun samples are computed
+    and discarded — batched serving's usual padding cost).
+    """
+    if len(prompts) == 0:
+        return []
+    for p in prompts:
+        _validate(model, p, temperature, top_k, top_p)
+    if steps <= 0:
+        return [[int(t) for t in p] for p in prompts]
+    if rng is None:
+        rng = jax.random.key(seed)
+    # one fold_in+split dispatch for all rows, not N
+    rngs = jax.vmap(lambda i: jax.random.fold_in(rng, i))(
+        jnp.arange(len(prompts))
+    )
+    return _generate_rows(
+        model, params, prompts, steps, temperature, rngs, top_k, top_p
+    )
+
+
+def _generate_rows(
+    model, params, prompts, steps, temperature, rngs, top_k, top_p
+):
+    """The ONE wrapper both serving entry points share: bucket the scan
+    length (power-of-two, capped at max_len) AND the row count
+    (power-of-two — every distinct N would otherwise compile its own
+    program; pad rows are dummy single-token prompts whose outputs are
+    sliced away), build the token buffer host-side in one transfer,
+    split each row's key stream from its own rng (values identical to a
+    per-row ``split(rng_n, steps)``), pad keys to the bucket, run
+    :func:`_batch_decode_scan`, and slice each row to its own
+    prompt+steps."""
+    import numpy as np
+
+    if isinstance(rngs, (list, tuple)):
+        rngs = jnp.stack(list(rngs))
+    n = len(prompts)
+    longest = max(prompts, key=len)
+    dec, scan_len, _, _ = _decode_setup(model, longest, steps)
+    nb = 1
+    while nb < n:
+        nb *= 2
+    buf_host = np.zeros((nb, scan_len + 1), np.int32)
+    for i, p in enumerate(prompts):
+        buf_host[i, : len(p)] = p
+    p_lens = np.ones((nb,), np.int32)  # pad rows: 1-token dummy prompts
+    p_lens[:n] = [len(p) for p in prompts]
+    if nb > n:  # pad rows reuse row 0's rng; their outputs are discarded
+        rngs = jnp.concatenate(
+            [rngs, jnp.repeat(rngs[:1], nb - n, axis=0)]
+        )
+    keys = jax.vmap(
+        lambda k: jax.random.split(k, max(steps, 1))
+    )(rngs)
+    # key SHAPE must depend only on the bucket (pad with repeats of the
+    # last key — only discarded bucket-overrun ticks ever index them)
+    if keys.shape[1] < scan_len:
+        keys = jnp.concatenate(
+            [keys,
+             jnp.repeat(keys[:, -1:], scan_len - keys.shape[1], axis=1)],
+            axis=1,
+        )
+    toks = _batch_decode_scan(
+        dec, scan_len, temperature == 0.0, top_k, top_p is not None,
+        params, _zero_cache(dec, nb), jnp.asarray(buf_host),
+        jnp.asarray(p_lens), keys,
+        jnp.asarray(max(temperature, 1e-9), jnp.float32),
+        jnp.asarray(1.0 if top_p is None else top_p, jnp.float32),
+    )
+    host = jax.device_get(toks)
+    return [
+        [int(t) for t in host[i, : len(prompts[i]) + steps]]
+        for i in range(n)
+    ]

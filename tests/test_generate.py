@@ -283,10 +283,10 @@ def test_top_p_sweep_shares_one_program(topo8):
     from mpit_tpu.models import generate_fast, sampling
 
     generate_fast(model, params, [1], 8, temperature=1.0, top_p=0.5)
-    n0 = sampling._decode_scan._cache_size()
+    n0 = sampling._batch_decode_scan._cache_size()
     for p in (0.6, 0.8, 0.9, 0.95):
         generate_fast(model, params, [1], 8, temperature=1.0, top_p=p)
-    assert sampling._decode_scan._cache_size() == n0
+    assert sampling._batch_decode_scan._cache_size() == n0
 
 
 # --------------------------------------------------------------- beam search
@@ -424,3 +424,65 @@ def test_beam_validation(topo8):
         beam_search(model, params, [1], 2, eos_id=99)
     with pytest.raises(ValueError, match="cannot slide"):
         beam_search(model, params, list(range(10)), steps=T)
+
+
+# ------------------------------------------------------------------ batched
+
+
+def test_batch_rows_equal_single_row_fast(topo8):
+    """Row n of generate_batch == generate_fast(prompt_n,
+    rng=fold_in(rng, n)) — greedy and sampled with filters, across
+    mixed prompt lengths."""
+    model = _model()
+    params = model.init(
+        jax.random.key(0), jnp.zeros((1, T), jnp.int32)
+    )["params"]
+    from mpit_tpu.models import generate_batch, generate_fast
+
+    prompts = [[3, 1, 4, 1, 5], [2], [7, 7, 7]]
+    got = generate_batch(model, params, prompts, steps=6)
+    for i, p in enumerate(prompts):
+        assert got[i] == generate_fast(model, params, p, steps=6), i
+
+    rng = jax.random.key(42)
+    got = generate_batch(
+        model, params, prompts, steps=6, temperature=0.9, rng=rng,
+        top_k=5,
+    )
+    for i, p in enumerate(prompts):
+        want = generate_fast(
+            model, params, p, steps=6, temperature=0.9,
+            rng=jax.random.fold_in(rng, i), top_k=5,
+        )
+        assert got[i] == want, i
+
+
+def test_batch_edge_cases(topo8):
+    model = _model()
+    params = model.init(
+        jax.random.key(0), jnp.zeros((1, T), jnp.int32)
+    )["params"]
+    from mpit_tpu.models import generate_batch
+
+    assert generate_batch(model, params, [], steps=4) == []
+    assert generate_batch(model, params, [[1, 2]], steps=0) == [[1, 2]]
+    with pytest.raises(ValueError, match="cannot slide"):
+        generate_batch(model, params, [[1], list(range(10))], steps=T)
+    with pytest.raises(ValueError, match="vocab_size"):
+        generate_batch(model, params, [[1], [999]], steps=2)
+
+
+def test_batch_size_bucketing_shares_programs(topo8):
+    """Row counts bucket to powers of two: N=3 and N=4 share one
+    compiled program (pad rows are discarded)."""
+    model = _model()
+    params = model.init(
+        jax.random.key(0), jnp.zeros((1, T), jnp.int32)
+    )["params"]
+    from mpit_tpu.models import generate_batch, sampling
+
+    generate_batch(model, params, [[1]] * 4, steps=4)
+    n0 = sampling._batch_decode_scan._cache_size()
+    out3 = generate_batch(model, params, [[1], [2], [3]], steps=4)
+    assert sampling._batch_decode_scan._cache_size() == n0
+    assert len(out3) == 3 and all(len(r) == 5 for r in out3)
