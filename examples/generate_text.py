@@ -1,0 +1,85 @@
+"""Train a small LM and decode from it four ways — the serving tour.
+
+Runs anywhere (CPU included; forces the local backend so it cannot hang
+on a dead hardware tunnel): trains a TransformerLM to memorize a
+periodic token stream with the sync-DP trainer, then continues prompts
+with each decoding recipe:
+
+  1. generate       — exact fixed-buffer decoding (slides past max_len)
+  2. generate_fast  — KV-cached, one compiled lax.scan
+  3. generate_batch — N prompts through the same kernel
+  4. beam_search    — best-scoring continuation with K beams
+
+Usage:  python examples/generate_text.py [--steps 150]
+"""
+
+import os
+import sys
+
+if os.environ.get("JAX_PLATFORMS", "").strip().lower() != "cpu":
+    os.environ["JAX_PLATFORMS"] = "cpu"
+sys.path.insert(
+    0, os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+)
+
+from mpit_tpu.utils.vmesh import repin_platform  # noqa: E402
+
+repin_platform("cpu")  # the ONE copy of the sitecustomize workaround
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+import mpit_tpu
+from mpit_tpu.models import (
+    beam_search,
+    generate,
+    generate_batch,
+    generate_fast,
+)
+from mpit_tpu.models.transformer import TransformerLM
+from mpit_tpu.parallel import DataParallelTrainer
+
+V, T = 17, 32
+
+
+def main():
+    steps = 150
+    if "--steps" in sys.argv:
+        steps = int(sys.argv[sys.argv.index("--steps") + 1])
+
+    topo = mpit_tpu.init(num_workers=1)
+    model = TransformerLM(
+        vocab_size=V, num_layers=2, d_model=32, num_heads=4, max_len=T,
+        compute_dtype=jnp.float32,
+    )
+    trainer = DataParallelTrainer(
+        model, optax.adam(3e-3), topo, donate_state=False
+    )
+    stream = np.arange(8 * T * 2, dtype=np.int32) % V
+    x = stream.reshape(-1, T)[:8]
+    y = np.roll(x, -1, axis=1).astype(np.int32)
+    state = trainer.init_state(jax.random.key(1), x[:1])
+    for i in range(steps):
+        state, m = trainer.step(state, x, y)
+    print(f"trained {steps} steps, final loss {float(m['loss']):.4f}")
+
+    prompt = list(range(8))
+    print("prompt:", prompt, "(the stream continues 8, 9, 10, ... mod 17)")
+    print("generate       :", generate(model, state.params, prompt, 8))
+    print("generate_fast  :", generate_fast(model, state.params, prompt, 8))
+    print("sampled t=0.7  :", generate_fast(
+        model, state.params, prompt, 8, temperature=0.7, top_k=4, seed=0))
+    outs = generate_batch(
+        model, state.params, [prompt, [3, 4, 5], [11, 12]], 6
+    )
+    for row in outs:
+        print("batched row    :", row)
+    seq, score = beam_search(model, state.params, prompt, 8, beam_size=4)
+    print(f"beam (K=4)     : {seq}   logprob {score:.3f}")
+    mpit_tpu.finalize()
+
+
+if __name__ == "__main__":
+    main()

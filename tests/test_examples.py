@@ -30,3 +30,16 @@ def test_parallelism_tour_runs():
                 "ring attention", "GSPMD", "gpipe", "1f1b",
                 "interleaved", "top-2 MoE", "composed"):
         assert tag in out, f"tour section missing: {tag}\n{out}"
+
+
+def test_generate_text_example_runs():
+    """The serving tour trains and decodes with all four recipes."""
+    r = subprocess.run(
+        [sys.executable, os.path.join(REPO, "examples",
+                                      "generate_text.py"),
+         "--steps", "120"],
+        cwd=REPO, capture_output=True, text=True, timeout=1800,
+    )
+    assert r.returncode == 0, r.stdout + r.stderr
+    for tag in ("generate ", "generate_fast", "batched row", "beam (K=4)"):
+        assert tag in r.stdout, f"missing: {tag}\n{r.stdout}"
