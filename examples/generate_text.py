@@ -16,8 +16,6 @@ Usage:  python examples/generate_text.py [--steps 150]
 import os
 import sys
 
-if os.environ.get("JAX_PLATFORMS", "").strip().lower() != "cpu":
-    os.environ["JAX_PLATFORMS"] = "cpu"
 sys.path.insert(
     0, os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 )
@@ -47,7 +45,14 @@ V, T = 17, 32
 def main():
     steps = 150
     if "--steps" in sys.argv:
-        steps = int(sys.argv[sys.argv.index("--steps") + 1])
+        i = sys.argv.index("--steps") + 1
+        if i >= len(sys.argv):
+            print("--steps requires an argument", file=sys.stderr)
+            raise SystemExit(2)
+        steps = int(sys.argv[i])
+        if steps < 1:
+            print("--steps must be >= 1", file=sys.stderr)
+            raise SystemExit(2)
 
     topo = mpit_tpu.init(num_workers=1)
     model = TransformerLM(
