@@ -19,6 +19,7 @@ from mpit_tpu.models.sampling import (  # noqa: F401
     generate,
     generate_batch,
     generate_fast,
+    generate_tp,
 )
 
 _REGISTRY = {"lenet": LeNet, "mlp": MLP}

@@ -155,12 +155,19 @@ def _cache_shapes(dec, batch):
     )["cache"]
 
 
-def _zero_cache(dec, batch=1):
+def _zero_cache(dec, batch=1, sharding_fn=None):
     """Fresh all-zeros cache per call: the arrays die with the request
     instead of being pinned in an lru slot (zeros are cheap; the traced
-    init shape inference is the part worth caching)."""
+    init shape inference is the part worth caching). ``sharding_fn``
+    (leaf ShapeDtypeStruct -> Sharding, generate_tp's head split): each
+    leaf is BORN in its placement — a transient full cache on one
+    device would defeat exactly the too-big-for-one-chip case."""
     return jax.tree.map(
-        lambda s: jnp.zeros(s.shape, s.dtype), _cache_shapes(dec, batch)
+        lambda s: jnp.zeros(
+            s.shape, s.dtype,
+            device=None if sharding_fn is None else sharding_fn(s),
+        ),
+        _cache_shapes(dec, batch),
     )
 
 
@@ -436,6 +443,20 @@ def generate_batch(
     LONGEST prompt's budget (shorter rows' overrun samples are computed
     and discarded — batched serving's usual padding cost).
     """
+    return _batch_impl(
+        model, params, prompts, steps, temperature, seed, rng,
+        top_k, top_p,
+    )
+
+
+def _batch_impl(
+    model, params, prompts, steps, temperature, seed, rng, top_k, top_p,
+    cache_sharding_fn=None,
+):
+    """The ONE prologue generate_batch and generate_tp share: validation,
+    trivial early returns, the per-row rng derivation (fold_in — the
+    half of the pinned-parity contract that lives outside the kernel),
+    then :func:`_generate_rows`."""
     if len(prompts) == 0:
         return []
     for p in prompts:
@@ -449,12 +470,14 @@ def generate_batch(
         jnp.arange(len(prompts))
     )
     return _generate_rows(
-        model, params, prompts, steps, temperature, rngs, top_k, top_p
+        model, params, prompts, steps, temperature, rngs, top_k, top_p,
+        cache_sharding_fn=cache_sharding_fn,
     )
 
 
 def _generate_rows(
-    model, params, prompts, steps, temperature, rngs, top_k, top_p
+    model, params, prompts, steps, temperature, rngs, top_k, top_p,
+    cache_sharding_fn=None,
 ):
     """The ONE wrapper both serving entry points share: bucket the scan
     length (power-of-two, capped at max_len) AND the row count
@@ -495,9 +518,10 @@ def _generate_rows(
              jnp.repeat(keys[:, -1:], scan_len - keys.shape[1], axis=1)],
             axis=1,
         )
+    cache0 = _zero_cache(dec, nb, sharding_fn=cache_sharding_fn)
     toks = _batch_decode_scan(
         dec, scan_len, temperature == 0.0, top_k, top_p is not None,
-        params, _zero_cache(dec, nb), jnp.asarray(buf_host),
+        params, cache0, jnp.asarray(buf_host),
         jnp.asarray(p_lens), keys,
         jnp.asarray(max(temperature, 1e-9), jnp.float32),
         jnp.asarray(1.0 if top_p is None else top_p, jnp.float32),
@@ -507,3 +531,74 @@ def _generate_rows(
         [int(t) for t in host[i, : len(prompts[i]) + steps]]
         for i in range(n)
     ]
+
+
+def generate_tp(
+    model,
+    params,
+    prompts: "Sequence[Sequence[int]]",
+    steps: int,
+    topo=None,
+    temperature: float = 0.0,
+    seed: int = 0,
+    rng: Optional[jax.Array] = None,
+    top_k: Optional[int] = None,
+    top_p: Optional[float] = None,
+) -> "list[list]":
+    """Tensor-parallel batched decode: the SAME compiled kernel as
+    :func:`generate_batch`, partitioned by GSPMD across a mesh with a
+    ``tp`` axis — Megatron serving for models too large (or too slow)
+    for one chip.
+
+    No decode-specific collectives are written anywhere: params commit
+    to the strict Megatron shardings
+    (:func:`mpit_tpu.parallel.tensor.tp_state_specs` — column/row split
+    Dense kernels), the K/V caches commit head-sharded over ``tp``, and
+    XLA's partitioner inserts the per-token psums when it compiles
+    :func:`_batch_decode_scan` for the committed layouts. Outputs are
+    pinned token-identical to :func:`generate_batch` on one device
+    (same kernel, same key streams; attention is exact either way).
+
+    ``topo``: a topology whose mesh has a ``tp`` axis (e.g.
+    ``mpit_tpu.init(axis_names=("dp", "tp"), mesh_shape=(1, T))``);
+    defaults to the current one. ``num_heads`` (and d_model/d_ff) must
+    divide by the tp extent. Pre-sharded params (a tp trainer's
+    ``state.params``) pass through unchanged; replicated or host params
+    are placed once here.
+    """
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from mpit_tpu.comm.topology import topology as _current_topology
+    from mpit_tpu.parallel.tensor import (
+        check_tp_divisibility,
+        tp_state_specs,
+    )
+
+    topo = topo if topo is not None else _current_topology()
+    mesh = topo.mesh
+    if "tp" not in mesh.axis_names:
+        raise ValueError(
+            f"generate_tp needs a mesh with a 'tp' axis; got "
+            f"{mesh.axis_names}"
+        )
+    check_tp_divisibility(model, int(mesh.shape["tp"]))
+    params = jax.device_put(
+        params,
+        jax.tree.map(
+            lambda s: NamedSharding(mesh, s), tp_state_specs(params),
+            is_leaf=lambda v: isinstance(v, P),
+        ),
+    )
+
+    # cached K/V are (batch, decode_len, heads, head_dim): heads ride tp,
+    # matching the qkv column split so cache writes stay local; the
+    # index/position scalars replicate. Each cache leaf is BORN in this
+    # placement (see _zero_cache) — never whole on one device.
+    def cache_sharding(leaf):
+        spec = P(None, None, "tp", None) if len(leaf.shape) == 4 else P()
+        return NamedSharding(mesh, spec)
+
+    return _batch_impl(
+        model, params, prompts, steps, temperature, seed, rng,
+        top_k, top_p, cache_sharding_fn=cache_sharding,
+    )

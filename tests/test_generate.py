@@ -486,3 +486,73 @@ def test_batch_size_bucketing_shares_programs(topo8):
     out3 = generate_batch(model, params, [[1], [2], [3]], steps=4)
     assert sampling._batch_decode_scan._cache_size() == n0
     assert len(out3) == 3 and all(len(r) == 5 for r in out3)
+
+
+# --------------------------------------------------------- tensor-parallel
+
+
+def test_tp_decode_matches_plain(topo8):
+    """generate_tp under a (2,4) dp x tp mesh is token-identical to
+    generate_batch on replicated params — greedy and sampled+filtered
+    (same kernel, same key streams; GSPMD just partitions it)."""
+    mpit_tpu.finalize()
+    topo = mpit_tpu.init(axis_names=("dp", "tp"), mesh_shape=(2, 4))
+    from mpit_tpu.models import generate_batch, generate_tp
+
+    model = _model()
+    params = model.init(
+        jax.random.key(0), jnp.zeros((1, T), jnp.int32)
+    )["params"]
+    prompts = [[3, 1, 4, 1, 5], [2], [7, 7, 7]]
+    assert generate_tp(
+        model, params, prompts, steps=6, topo=topo
+    ) == generate_batch(model, params, prompts, steps=6)
+    kw = dict(temperature=0.9, seed=3, top_k=5)
+    assert generate_tp(
+        model, params, prompts, steps=6, topo=topo, **kw
+    ) == generate_batch(model, params, prompts, steps=6, **kw)
+    mpit_tpu.finalize()
+
+
+def test_tp_decode_serves_tp_trainer_state(topo8):
+    """The end-to-end Megatron story: train with TensorParallelTrainer,
+    decode from its sharded state.params directly."""
+    import optax
+
+    from mpit_tpu.models import generate_fast, generate_tp
+    from mpit_tpu.parallel import TensorParallelTrainer
+
+    mpit_tpu.finalize()
+    topo = mpit_tpu.init(axis_names=("dp", "tp"), mesh_shape=(2, 4))
+    model = _model()
+    tr = TensorParallelTrainer(
+        model, optax.sgd(0.1), topo, donate_state=False
+    )
+    rng = np.random.default_rng(0)
+    x = rng.integers(0, V, (8, T)).astype(np.int32)
+    y = np.roll(x, -1, axis=1).astype(np.int32)
+    state = tr.init_state(jax.random.key(0), x[:1])
+    state, _ = tr.step(state, x, y)
+    got = generate_tp(model, state.params, [[1, 2, 3]], steps=5, topo=topo)
+    # reference: the same (gathered) params through the plain recipe
+    host = jax.tree.map(lambda a: np.asarray(a), jax.device_get(state.params))
+    want = generate_fast(model, host, [1, 2, 3], steps=5)
+    assert got[0] == want
+    mpit_tpu.finalize()
+
+
+def test_tp_decode_validation(topo8):
+    from mpit_tpu.models import generate_tp
+
+    model = _model()
+    params = model.init(
+        jax.random.key(0), jnp.zeros((1, T), jnp.int32)
+    )["params"]
+    # topo8 is the 1-D worker mesh: no tp axis
+    with pytest.raises(ValueError, match="tp"):
+        generate_tp(model, params, [[1]], steps=2)
+    mpit_tpu.finalize()
+    topo = mpit_tpu.init(axis_names=("dp", "tp"), mesh_shape=(1, 8))
+    with pytest.raises(ValueError, match="divisible"):
+        generate_tp(model, params, [[1]], steps=2, topo=topo)  # heads=4
+    mpit_tpu.finalize()
