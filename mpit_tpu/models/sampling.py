@@ -451,18 +451,22 @@ def generate_batch(
 
 def _batch_impl(
     model, params, prompts, steps, temperature, seed, rng, top_k, top_p,
-    cache_sharding_fn=None,
+    cache_sharding_fn=None, params_placer=None,
 ):
     """The ONE prologue generate_batch and generate_tp share: validation,
     trivial early returns, the per-row rng derivation (fold_in — the
     half of the pinned-parity contract that lives outside the kernel),
-    then :func:`_generate_rows`."""
+    then :func:`_generate_rows`. ``params_placer`` (generate_tp's
+    Megatron device_put) runs only AFTER validation passes — a rejected
+    request must not pay a whole-model transfer."""
     if len(prompts) == 0:
         return []
     for p in prompts:
         _validate(model, p, temperature, top_k, top_p)
     if steps <= 0:
         return [[int(t) for t in p] for p in prompts]
+    if params_placer is not None:
+        params = params_placer(params)
     if rng is None:
         rng = jax.random.key(seed)
     # one fold_in+split dispatch for all rows, not N
@@ -555,9 +559,12 @@ def generate_tp(
     (:func:`mpit_tpu.parallel.tensor.tp_state_specs` — column/row split
     Dense kernels), the K/V caches commit head-sharded over ``tp``, and
     XLA's partitioner inserts the per-token psums when it compiles
-    :func:`_batch_decode_scan` for the committed layouts. Outputs are
-    pinned token-identical to :func:`generate_batch` on one device
-    (same kernel, same key streams; attention is exact either way).
+    :func:`_batch_decode_scan` for the committed layouts. Same kernel,
+    same key streams as :func:`generate_batch` — token-identical up to
+    partitioned-reduction numerics (row-sharded matmuls accumulate via
+    psum in a different order, so a near-tie argmax can flip in the
+    last ulps on real hardware; exact equality is pinned on the virtual
+    CPU mesh).
 
     ``topo``: a topology whose mesh has a ``tp`` axis (e.g.
     ``mpit_tpu.init(axis_names=("dp", "tp"), mesh_shape=(1, T))``);
@@ -582,13 +589,15 @@ def generate_tp(
             f"{mesh.axis_names}"
         )
     check_tp_divisibility(model, int(mesh.shape["tp"]))
-    params = jax.device_put(
-        params,
-        jax.tree.map(
-            lambda s: NamedSharding(mesh, s), tp_state_specs(params),
-            is_leaf=lambda v: isinstance(v, P),
-        ),
-    )
+
+    def place_params(p):
+        return jax.device_put(
+            p,
+            jax.tree.map(
+                lambda s: NamedSharding(mesh, s), tp_state_specs(p),
+                is_leaf=lambda v: isinstance(v, P),
+            ),
+        )
 
     # cached K/V are (batch, decode_len, heads, head_dim): heads ride tp,
     # matching the qkv column split so cache writes stay local; the
@@ -601,4 +610,5 @@ def generate_tp(
     return _batch_impl(
         model, params, prompts, steps, temperature, seed, rng,
         top_k, top_p, cache_sharding_fn=cache_sharding,
+        params_placer=place_params,
     )
