@@ -19,4 +19,5 @@ run python scripts/measure_presets.py --stem space_to_depth --presets resnet50-s
 run python bench.py --preset resnet50-sync --profile /tmp/prof_r50 > /tmp/v_prof_r50.log 2>&1
 run python scripts/measure_presets.py --presets ptb-transformer-large > /tmp/v_xl.log 2>&1
 run python bench.py --decode > /tmp/v_decode.log 2>&1
+run python bench.py --decode --weights-dtype bf16 > /tmp/v_decode_bf16.log 2>&1
 echo "DONE failed=$failed" > /tmp/tpu_backlog.done

@@ -556,3 +556,39 @@ def test_tp_decode_validation(topo8):
     with pytest.raises(ValueError, match="divisible"):
         generate_tp(model, params, [[1]], steps=2, topo=topo)  # heads=4
     mpit_tpu.finalize()
+
+
+def test_weights_dtype_serving(topo8):
+    """bf16 weight serving: outputs stay faithful on a trained model
+    (the memorized stream continues identically), and the bench flag's
+    cast leaves int leaves alone."""
+    import optax
+
+    from mpit_tpu.models import generate_batch, generate_fast
+    from mpit_tpu.models.sampling import cast_weights
+    from mpit_tpu.parallel import DataParallelTrainer
+
+    mpit_tpu.finalize()
+    topo = mpit_tpu.init(num_workers=1)
+    model = _model()
+    tr = DataParallelTrainer(model, optax.adam(3e-3), topo,
+                             donate_state=False)
+    stream = np.arange(8 * T * 2, dtype=np.int32) % V
+    x = stream.reshape(-1, T)[:8]
+    y = np.roll(x, -1, axis=1).astype(np.int32)
+    state = tr.init_state(jax.random.key(1), x[:1])
+    for _ in range(150):
+        state, _ = tr.step(state, x, y)
+    prompt = list(range(8))
+    full = generate_fast(model, state.params, prompt, 8)
+    half = generate_fast(model, state.params, prompt, 8,
+                         weights_dtype=jnp.bfloat16)
+    assert half == full  # a memorized stream survives bf16 weights
+    outs = generate_batch(model, state.params, [prompt], 8,
+                          weights_dtype=jnp.bfloat16)
+    assert outs[0] == full
+    cast = cast_weights(state.params, jnp.bfloat16)
+    dtypes = {a.dtype for a in jax.tree.leaves(cast)}
+    assert jnp.dtype(jnp.bfloat16) in dtypes
+    assert jnp.dtype(jnp.float32) not in dtypes
+    mpit_tpu.finalize()
