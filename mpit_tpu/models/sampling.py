@@ -85,8 +85,14 @@ def generate(
     return toks
 
 
-def _validate(model, prompt, temperature, top_k=None, top_p=None):
-    """Shared argument checks for both recipes."""
+def _validate(
+    model, prompt, temperature, top_k=None, top_p=None, eos_id=None
+):
+    """Shared argument checks for every decoding entry point."""
+    if eos_id is not None and not 0 <= eos_id < model.vocab_size:
+        raise ValueError(
+            f"eos_id={eos_id} outside [0, vocab_size={model.vocab_size})"
+        )
     if getattr(model, "seq_axis", None) is not None:
         raise ValueError(
             "generation runs the dense model; clone(seq_axis=None) first"
@@ -182,6 +188,7 @@ def generate_fast(
     top_k: Optional[int] = None,
     top_p: Optional[float] = None,
     weights_dtype=None,
+    eos_id: Optional[int] = None,
 ) -> list:
     """KV-cached generation: continue ``prompt`` by ``steps`` tokens.
 
@@ -200,16 +207,20 @@ def generate_fast(
       flash-attention model the greedy-equality pin versus
       :func:`generate` holds only up to that kernel's numerics.
     """
-    _validate(model, prompt, temperature, top_k, top_p)
+    _validate(model, prompt, temperature, top_k, top_p, eos_id)
     if steps <= 0:
         return [int(t) for t in prompt]  # prompt length already validated
     if rng is None:
         rng = jax.random.key(seed)
     if weights_dtype is not None:
         params = cast_weights(params, weights_dtype)
-    return _generate_rows(
-        model, params, [prompt], steps, temperature, [rng], top_k, top_p
-    )[0]
+    return _truncate_at_eos(
+        _generate_rows(
+            model, params, [prompt], steps, temperature, [rng],
+            top_k, top_p,
+        )[0],
+        len(prompt), eos_id,
+    )
 
 
 def _decode_setup(model, prompt, steps):
@@ -331,6 +342,7 @@ def beam_search(
     steps: int,
     beam_size: int = 4,
     eos_id: Optional[int] = None,
+    weights_dtype=None,
 ) -> "tuple[list, float]":
     """Beam-search decoding over the KV-cached model: the highest
     log-probability continuation of ``prompt`` found with ``beam_size``
@@ -345,15 +357,13 @@ def beam_search(
     hypothesis the search is exhaustive — pinned against brute-force
     enumeration in tests.
     """
-    _validate(model, prompt, 0.0)
+    _validate(model, prompt, 0.0, eos_id=eos_id)
     if beam_size < 1:
         raise ValueError(f"beam_size={beam_size} must be >= 1")
-    if eos_id is not None and not 0 <= eos_id < model.vocab_size:
-        raise ValueError(
-            f"eos_id={eos_id} outside [0, vocab_size={model.vocab_size})"
-        )
     if steps <= 0:
         return [int(t) for t in prompt], 0.0
+    if weights_dtype is not None:
+        params = cast_weights(params, weights_dtype)
     dec, scan_len, buf, total = _decode_setup(model, prompt, steps)
     toks, scores = _beam_scan(
         dec, scan_len, beam_size, eos_id,
@@ -363,13 +373,7 @@ def beam_search(
     )
     best = int(jnp.argmax(scores))
     seq = [int(t) for t in jax.device_get(toks[best, :total])]
-    score = float(scores[best])
-    if eos_id is not None:
-        for i in range(len(prompt), len(seq)):
-            if seq[i] == eos_id:
-                seq = seq[: i + 1]
-                break
-    return seq, score
+    return _truncate_at_eos(seq, len(prompt), eos_id), float(scores[best])
 
 
 @functools.partial(jax.jit, static_argnums=(0, 1, 2, 3, 4))
@@ -435,6 +439,7 @@ def generate_batch(
     top_k: Optional[int] = None,
     top_p: Optional[float] = None,
     weights_dtype=None,
+    eos_id: Optional[int] = None,
 ) -> "list[list]":
     """Continue N prompts by ``steps`` tokens each, in ONE compiled
     decode scan over a (N, ...) K/V cache — the batched serving path.
@@ -449,7 +454,7 @@ def generate_batch(
     """
     return _batch_impl(
         model, params, prompts, steps, temperature, seed, rng,
-        top_k, top_p, weights_dtype=weights_dtype,
+        top_k, top_p, weights_dtype=weights_dtype, eos_id=eos_id,
     )
 
 
@@ -470,9 +475,21 @@ def cast_weights(params, dtype):
     )
 
 
+def _truncate_at_eos(seq, p_len, eos_id):
+    """Cut a generated row just past the first eos beyond the prompt —
+    the same rule beam_search applies (the ONE truncation convention)."""
+    if eos_id is None:
+        return seq
+    for i in range(p_len, len(seq)):
+        if seq[i] == eos_id:
+            return seq[: i + 1]
+    return seq
+
+
 def _batch_impl(
     model, params, prompts, steps, temperature, seed, rng, top_k, top_p,
     cache_sharding_fn=None, params_placer=None, weights_dtype=None,
+    eos_id=None,
 ):
     """The ONE prologue generate_batch and generate_tp share: validation,
     trivial early returns, the per-row rng derivation (fold_in — the
@@ -483,7 +500,7 @@ def _batch_impl(
     if len(prompts) == 0:
         return []
     for p in prompts:
-        _validate(model, p, temperature, top_k, top_p)
+        _validate(model, p, temperature, top_k, top_p, eos_id)
     if steps <= 0:
         return [[int(t) for t in p] for p in prompts]
     if weights_dtype is not None:
@@ -496,10 +513,14 @@ def _batch_impl(
     rngs = jax.vmap(lambda i: jax.random.fold_in(rng, i))(
         jnp.arange(len(prompts))
     )
-    return _generate_rows(
+    rows = _generate_rows(
         model, params, prompts, steps, temperature, rngs, top_k, top_p,
         cache_sharding_fn=cache_sharding_fn,
     )
+    return [
+        _truncate_at_eos(r, len(p), eos_id)
+        for r, p in zip(rows, prompts)
+    ]
 
 
 def _generate_rows(
@@ -572,6 +593,7 @@ def generate_tp(
     top_k: Optional[int] = None,
     top_p: Optional[float] = None,
     weights_dtype=None,
+    eos_id: Optional[int] = None,
 ) -> "list[list]":
     """Tensor-parallel batched decode: the SAME compiled kernel as
     :func:`generate_batch`, partitioned by GSPMD across a mesh with a
@@ -635,4 +657,5 @@ def generate_tp(
         model, params, prompts, steps, temperature, seed, rng,
         top_k, top_p, cache_sharding_fn=cache_sharding,
         params_placer=place_params, weights_dtype=weights_dtype,
+        eos_id=eos_id,
     )

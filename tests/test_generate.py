@@ -592,3 +592,28 @@ def test_weights_dtype_serving(topo8):
     assert jnp.dtype(jnp.bfloat16) in dtypes
     assert jnp.dtype(jnp.float32) not in dtypes
     mpit_tpu.finalize()
+
+
+def test_eos_truncation_on_serving_paths(topo8):
+    """eos_id cuts each returned row just past the first eos beyond its
+    prompt (beam_search's rule) on generate_fast and generate_batch,
+    and validates its range."""
+    model = _model()
+    params = model.init(
+        jax.random.key(0), jnp.zeros((1, T), jnp.int32)
+    )["params"]
+    from mpit_tpu.models import generate_batch, generate_fast
+
+    prompt = [1, 2]
+    # pick the token greedy emits at step 3 so truncation provably fires
+    eos = generate_fast(model, params, prompt, steps=3)[4]
+    full = generate_fast(model, params, prompt, steps=8)
+    cut = generate_fast(model, params, prompt, steps=8, eos_id=eos)
+    assert cut == full[: full.index(eos, len(prompt)) + 1]
+    assert cut[-1] == eos and eos not in cut[len(prompt):-1]
+    rows = generate_batch(
+        model, params, [prompt, [3]], steps=8, eos_id=eos
+    )
+    assert rows[0] == cut
+    with pytest.raises(ValueError, match="eos_id"):
+        generate_fast(model, params, prompt, steps=2, eos_id=V)
