@@ -617,3 +617,48 @@ def test_eos_truncation_on_serving_paths(topo8):
     assert rows[0] == cut
     with pytest.raises(ValueError, match="eos_id"):
         generate_fast(model, params, prompt, steps=2, eos_id=V)
+
+
+# ----------------------------------------------------------- property-based
+
+
+from hypothesis import given, settings, strategies as st  # noqa: E402
+
+_PROP_MODEL = None
+_PROP_PARAMS = None
+
+
+def _prop_setup():
+    """One model+params for every hypothesis example (init is the
+    expensive part; the property varies the REQUEST, not the weights)."""
+    global _PROP_MODEL, _PROP_PARAMS
+    if _PROP_MODEL is None:
+        _PROP_MODEL = _model()
+        _PROP_PARAMS = _PROP_MODEL.init(
+            jax.random.key(0), jnp.zeros((1, T), jnp.int32)
+        )["params"]
+    return _PROP_MODEL, _PROP_PARAMS
+
+
+@settings(max_examples=12, deadline=None)
+@given(
+    prompt=st.lists(st.integers(0, V - 1), min_size=1, max_size=10),
+    steps=st.integers(1, 12),
+    temperature=st.sampled_from([0.0, 0.7, 1.3]),
+    seed=st.integers(0, 3),
+)
+def test_property_fast_equals_slow(prompt, steps, temperature, seed):
+    """For ANY request in range (prompt x steps x temperature x seed,
+    within max_len), the KV-cached scan and the fixed-buffer recipe
+    produce the same tokens — the serving path is a pure optimization."""
+    from hypothesis import assume
+
+    from mpit_tpu.models import generate_fast
+
+    assume(len(prompt) + steps <= T)
+    model, params = _prop_setup()
+    a = generate(model, params, prompt, steps,
+                 temperature=temperature, seed=seed)
+    b = generate_fast(model, params, prompt, steps,
+                      temperature=temperature, seed=seed)
+    assert a == b, (prompt, steps, temperature, seed)
