@@ -12,14 +12,19 @@ trained LM still expect one. Two recipes, same sampling semantics:
   slides the window past ``max_len`` (positions shift; documented
   truncation, not an error).
 - :func:`generate_fast` — the serving recipe: ``decode=True`` clones
-  the model into one-token cached-attention steps (K/V cache in the
-  ``cache`` collection, ``TransformerLM.decode``) and the ENTIRE
-  prompt+generation loop runs as a single ``lax.scan`` inside one jit —
-  O(T·d) per token, no per-token host round-trips, one device fetch at
-  the end. Scan lengths are bucketed to powers of two so at most
-  log₂(max_len) programs ever compile per model. Greedy output is
-  pinned equal to :func:`generate`'s; sampled output is pinned equal
-  at the same seed (both index the same per-step key stream).
+  the model into cached-attention chunk steps (K/V cache in the
+  ``cache`` collection, ``TransformerLM.decode``) and the whole request
+  runs inside one jit: the PROMPT enters the cache as a single
+  matmul-bound chunk (:func:`_prefill_decode_scan`, ``head=False`` so
+  only one row pays the vocab projection), then each GENERATED token is
+  a ``lax.scan`` tick — no per-token host round-trips, one device fetch
+  at the end. Prefill/scan lengths and batch rows are bucketed to
+  powers of two so compiles stay logarithmic. Mixed-length batches fall
+  back to the all-ticks kernel (:func:`_batch_decode_scan`; short rows
+  sample sequentially inside the shared clock). Greedy output is pinned
+  equal to :func:`generate`'s; sampled output is pinned equal at the
+  same seed (every kernel indexes the same per-generated-token key
+  stream).
 """
 
 from __future__ import annotations
@@ -194,10 +199,10 @@ def generate_fast(
 
     Same sampling semantics as :func:`generate` (greedy at
     ``temperature=0``, else softmax sampling keyed per generated token),
-    but O(T·d) per token and compiled as one program — the serving path
-    (the N=1 row of the batched decode kernel). Narrower model support
-    than :func:`generate`, which handles anything dense ``apply`` can
-    run:
+    but compiled as one program — the serving path (the N=1 row of the
+    chunked-prefill kernel: one dense pass for the prompt, one scan
+    tick per generated token). Narrower model support than
+    :func:`generate`, which handles anything dense ``apply`` can run:
 
     - no window sliding — ``len(prompt) + steps`` must fit in
       ``model.max_len``;
@@ -376,6 +381,104 @@ def beam_search(
     return _truncate_at_eos(seq, len(prompt), eos_id), float(scores[best])
 
 
+def _fix_cache_indices(cache, p_len):
+    """Rewrite every position-counter leaf (per-block ``cache_index``,
+    the LM's ``pos_index``) to ``p_len`` after a PADDED prefill chunk:
+    the chunk ran at the bucket length, so the counters over-advanced
+    and the slots in ``[p_len, bucket)`` hold padding garbage. Decode
+    resumes at ``p_len`` and overwrites slot ``i`` in the same tick
+    whose mask first exposes it (``j <= i``), so the garbage is never
+    attended — pinned by the fast==slow equality tests."""
+    import jax.tree_util as jtu
+
+    def fix(path, leaf):
+        name = getattr(path[-1], "key", None) if path else None
+        if name in ("cache_index", "pos_index"):
+            return jnp.asarray(p_len, leaf.dtype)
+        return leaf
+
+    return jtu.tree_map_with_path(fix, cache)
+
+
+def _sample_rows(logits, row_keys, greedy, top_k, use_top_p, temp, top_p):
+    """The ONE sampling rule both decode kernels share: greedy argmax,
+    or temperature scale -> :func:`_filter_logits` -> categorical, per
+    row of ``logits`` (N, V) with ``row_keys`` (N,). A change here is a
+    change to BOTH kernels — which is what keeps the prefill==tick
+    parity pinnable."""
+    if greedy:
+        return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    scaled = jax.vmap(
+        lambda l: _filter_logits(
+            l / temp, top_k, top_p if use_top_p else None
+        )
+    )(logits)
+    return jax.vmap(jax.random.categorical)(
+        row_keys, scaled
+    ).astype(jnp.int32)
+
+
+@functools.partial(jax.jit, static_argnums=(0, 1, 2, 3, 4, 5))
+def _prefill_decode_scan(
+    model, pre_bucket, gen_len, greedy, top_k, use_top_p,
+    params, cache0, pre_buf, p_len, keys, temp, top_p,
+):
+    """Chunked-prefill decoding for rows sharing ONE prompt length: the
+    whole prompt enters the cache as a single dense pass (matmul-bound
+    — one chunk instead of p_len latency-bound ticks), then only the
+    GENERATED tokens run as scan ticks.
+
+    ``pre_buf`` is (N, pre_bucket) — prompts left-aligned, padding
+    arbitrary; the padded rows' cache writes and counter over-advance
+    are undone by :func:`_fix_cache_indices`. The prefill pass runs the
+    model with ``head=False`` and projects ONE hidden row through the
+    vocab head — never materializing (N, pre_bucket, V) f32 logits.
+    Token j is sampled with ``keys[:, j]`` — the identical
+    per-generated-token stream the tick kernel uses, which is what
+    keeps this a pure optimization (pinned fast==slow and prefill==tick
+    across the suite). ``keys`` is pre-padded to exactly ``gen_len``
+    columns by the caller.
+
+    Bucket-overrun ticks (t >= steps) may clamp their cache writes and
+    position gathers at the max_len boundary: safe because (a) they
+    strictly FOLLOW the last kept sample in the sequential scan, and
+    (b) the cache dies with this call — nothing ever reads it after
+    the scan. Reusing the returned cache would break invariant (b).
+    """
+    hidden, mut = model.clone(head=False).apply(
+        {"params": params, "cache": cache0}, pre_buf, mutable=["cache"]
+    )
+    cache = _fix_cache_indices(mut["cache"], p_len)
+    h_last = jax.vmap(lambda h: h[p_len - 1])(hidden)  # (N, d)
+    last = model.head_logits(params, h_last)  # (N, V)
+
+    tok0 = _sample_rows(
+        last, keys[:, 0], greedy, top_k, use_top_p, temp, top_p
+    )
+
+    def step(carry, t):
+        cache, prev = carry
+        logits, mut = model.apply(
+            {"params": params, "cache": cache},
+            prev[:, None],
+            mutable=["cache"],
+        )
+        nxt = _sample_rows(
+            logits[:, 0], keys[:, t + 1], greedy, top_k, use_top_p,
+            temp, top_p,
+        )
+        return (mut["cache"], nxt), nxt
+
+    if gen_len > 1:
+        (_, _), rest = jax.lax.scan(
+            step, (cache, tok0), jnp.arange(gen_len - 1)
+        )
+        rest = rest.swapaxes(0, 1)  # (N, gen_len-1)
+        return jnp.concatenate([tok0[:, None], rest], axis=1)
+    return tok0[:, None]
+
+
+
 @functools.partial(jax.jit, static_argnums=(0, 1, 2, 3, 4))
 def _batch_decode_scan(
     model, scan_len, greedy, top_k, use_top_p,
@@ -404,19 +507,14 @@ def _batch_decode_scan(
             mutable=["cache"],
         )
         logits = logits[:, 0]  # (N, V)
-        if greedy:
-            nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
-        else:
-            j = jnp.clip(t - (p_lens - 1), 0, keys.shape[1] - 1)
-            row_keys = jax.vmap(lambda ks, i: ks[i])(keys, j)
-            scaled = jax.vmap(
-                lambda l: _filter_logits(
-                    l / temp, top_k, top_p if use_top_p else None
-                )
-            )(logits)
-            nxt = jax.vmap(jax.random.categorical)(
-                row_keys, scaled
-            ).astype(jnp.int32)
+        # per-row key index: generated token j of row n uses its own
+        # keys[n, j]; the clip keeps bucket-overrun ticks (discarded)
+        # in bounds
+        j = jnp.clip(t - (p_lens - 1), 0, keys.shape[1] - 1)
+        row_keys = jax.vmap(lambda ks, i: ks[i])(keys, j)
+        nxt = _sample_rows(
+            logits, row_keys, greedy, top_k, use_top_p, temp, top_p
+        )
         return (mut["cache"], nxt), nxt
 
     (_, _), nxt = jax.lax.scan(
@@ -530,12 +628,18 @@ def _generate_rows(
     """The ONE wrapper both serving entry points share: bucket the scan
     length (power-of-two, capped at max_len) AND the row count
     (power-of-two — every distinct N would otherwise compile its own
-    program; pad rows are dummy single-token prompts whose outputs are
-    sliced away), build the token buffer host-side in one transfer,
-    split each row's key stream from its own rng (values identical to a
-    per-row ``split(rng_n, steps)``), pad keys to the bucket, run
-    :func:`_batch_decode_scan`, and slice each row to its own
-    prompt+steps."""
+    program; pad rows are dummy prompts whose outputs are sliced away),
+    build the token buffer host-side in one transfer, split each row's
+    key stream from its own rng (values identical to a per-row
+    ``split(rng_n, steps)``), pad keys to the bucket, run the kernel,
+    and slice each row to its own prompt+steps.
+
+    Kernel choice: when every row shares ONE prompt length, the prompt
+    enters the cache as a single chunked-prefill pass
+    (:func:`_prefill_decode_scan` — matmul-bound, p_len ticks saved);
+    mixed lengths fall back to the per-tick kernel
+    (:func:`_batch_decode_scan`), because a short row's tokens beyond
+    its own prompt are sequentially sampled and cannot be chunked."""
     import numpy as np
 
     if isinstance(rngs, (list, tuple)):
@@ -546,11 +650,9 @@ def _generate_rows(
     nb = 1
     while nb < n:
         nb *= 2
-    buf_host = np.zeros((nb, scan_len + 1), np.int32)
-    for i, p in enumerate(prompts):
-        buf_host[i, : len(p)] = p
-    p_lens = np.ones((nb,), np.int32)  # pad rows: 1-token dummy prompts
-    p_lens[:n] = [len(p) for p in prompts]
+    greedy = temperature == 0.0
+    temp = jnp.asarray(max(temperature, 1e-9), jnp.float32)
+    tp_val = jnp.asarray(1.0 if top_p is None else top_p, jnp.float32)
     if nb > n:  # pad rows reuse row 0's rng; their outputs are discarded
         rngs = jnp.concatenate(
             [rngs, jnp.repeat(rngs[:1], nb - n, axis=0)]
@@ -558,21 +660,55 @@ def _generate_rows(
     keys = jax.vmap(
         lambda k: jax.random.split(k, max(steps, 1))
     )(rngs)
-    # key SHAPE must depend only on the bucket (pad with repeats of the
-    # last key — only discarded bucket-overrun ticks ever index them)
-    if keys.shape[1] < scan_len:
-        keys = jnp.concatenate(
+
+    def pad_keys(to_len):
+        # key SHAPE must depend only on the bucket (pad with repeats of
+        # the last key — only discarded bucket-overrun ticks index them)
+        if keys.shape[1] >= to_len:
+            return keys
+        return jnp.concatenate(
             [keys,
-             jnp.repeat(keys[:, -1:], scan_len - keys.shape[1], axis=1)],
+             jnp.repeat(keys[:, -1:], to_len - keys.shape[1], axis=1)],
             axis=1,
         )
+
     cache0 = _zero_cache(dec, nb, sharding_fn=cache_sharding_fn)
+    p0 = len(prompts[0])
+    if all(len(q) == p0 for q in prompts):
+        pre_bucket = 1
+        while pre_bucket < p0:
+            pre_bucket *= 2
+        pre_bucket = min(pre_bucket, model.max_len)
+        gen_bucket = 1
+        while gen_bucket < steps:
+            gen_bucket *= 2
+        gen_bucket = min(gen_bucket, model.max_len)
+        pre_host = np.zeros((nb, pre_bucket), np.int32)
+        for i, q in enumerate(prompts):
+            pre_host[i] = (list(q) + [0] * pre_bucket)[:pre_bucket]
+        gen = _prefill_decode_scan(
+            dec, pre_bucket, gen_bucket, greedy, top_k,
+            top_p is not None,
+            params, cache0, jnp.asarray(pre_host),
+            jnp.asarray(p0, jnp.int32), pad_keys(gen_bucket), temp,
+            tp_val,
+        )
+        host = jax.device_get(gen)
+        return [
+            [int(t) for t in prompts[i]] + [
+                int(t) for t in host[i, :steps]
+            ]
+            for i in range(n)
+        ]
+    buf_host = np.zeros((nb, scan_len + 1), np.int32)
+    for i, q in enumerate(prompts):
+        buf_host[i, : len(q)] = q
+    p_lens = np.ones((nb,), np.int32)  # pad rows: 1-token dummy prompts
+    p_lens[:n] = [len(q) for q in prompts]
     toks = _batch_decode_scan(
-        dec, scan_len, temperature == 0.0, top_k, top_p is not None,
+        dec, scan_len, greedy, top_k, top_p is not None,
         params, cache0, jnp.asarray(buf_host),
-        jnp.asarray(p_lens), keys,
-        jnp.asarray(max(temperature, 1e-9), jnp.float32),
-        jnp.asarray(1.0 if top_p is None else top_p, jnp.float32),
+        jnp.asarray(p_lens), pad_keys(scan_len), temp, tp_val,
     )
     host = jax.device_get(toks)
     return [

@@ -98,15 +98,21 @@ class Block(nn.Module):
         return x
 
     def _cached_attention(self, q, k, v):
-        """One-token causal attention over the persistent K/V cache.
+        """Causal attention of a T-token CHUNK over the persistent K/V
+        cache (T = 1 per-token decode; T > 1 chunked prefill — the
+        prompt lands in the cache as one matmul-bound pass instead of T
+        latency-bound ticks).
 
         The cache lives in the ``cache`` variable collection (flax's
         standard decode recipe): ``cached_key``/``cached_value`` hold the
         first ``cache_index`` positions' keys/values; each call appends
-        the current token's K/V at ``cache_index`` and attends the one
-        query over every filled slot. Static shapes throughout — the
+        the chunk's K/V at ``[cache_index, cache_index+T)`` and the
+        chunk's query at local row ``r`` (global position
+        ``cache_index + r``) attends cache positions ``<= cache_index +
+        r`` — exactly the causal rule. Static shapes throughout — the
         cache is allocated at ``decode_len`` and masked, so the whole
-        generation loop compiles once per bucket (sampling.generate_fast).
+        generation loop compiles once per bucket
+        (sampling.generate_fast).
 
         Numerics match :func:`dense_attention`: f32 scores/softmax/
         accumulation, inputs left in compute dtype for the einsums.
@@ -116,9 +122,9 @@ class Block(nn.Module):
                 f"decode=True needs decode_len > 0, got {self.decode_len}"
             )
         b, t, h, d = q.shape
-        if t != 1:
+        if t > self.decode_len:
             raise ValueError(
-                f"decode mode consumes one token per call, got T={t}"
+                f"chunk of {t} exceeds the {self.decode_len}-slot cache"
             )
         # has_variable BEFORE self.variable: during model.init the cache
         # is created on this very call, and mutating it then would leak
@@ -146,13 +152,17 @@ class Block(nn.Module):
         )
         if ready:
             ck.value, cv.value = key_cache, val_cache
-            idx.value = i + 1
+            idx.value = i + t
         s = jnp.einsum(
             "bqhd,bkhd->bhqk", q, key_cache,
             preferred_element_type=jnp.float32,
         ) / (d ** 0.5)
-        mask = jnp.arange(self.decode_len)[None, None, None, :] <= i
-        s = jnp.where(mask, s, -jnp.inf)
+        # row r may see cache positions <= i + r
+        mask = (
+            jnp.arange(self.decode_len)[None, :]
+            <= i + jnp.arange(t)[:, None]
+        )
+        s = jnp.where(mask[None, None], s, -jnp.inf)
         p = jax.nn.softmax(s, axis=-1)
         return jnp.einsum(
             "bhqk,bkhd->bqhd", p, val_cache,
@@ -288,10 +298,14 @@ class TransformerLM(nn.Module):
     # (all_to_all head<->sequence re-shard around dense attention —
     # moderate T, needs num_heads % axis == 0). Both exact.
     seq_impl: str = "ring"
-    # serving path: decode=True turns every block into a one-token-per-call
-    # cached-attention step (see Block.decode); params are IDENTICAL to the
+    # serving path: decode=True turns every block into a cached-attention
+    # chunk step (see Block.decode); params are IDENTICAL to the
     # training configuration — only the "cache" collection is added
     decode: bool = False
+    # head=False returns the final-norm hidden states (B, T, d_model)
+    # instead of logits — chunked prefill projects ONE row through the
+    # vocab head (head_logits) rather than materializing (B, T, V) f32
+    head: bool = True
 
     @nn.compact
     def __call__(self, tokens):
@@ -358,7 +372,18 @@ class TransformerLM(nn.Module):
                 name=f"Block_{i}",
             )(x)
         x = nn.LayerNorm(dtype=dt)(x)
+        if not self.head:
+            return x
         # tied output head, genuinely in f32: Embed.attend would promote the
         # query back to compute_dtype, quantizing large-vocab logits to bf16
         table = embed.embedding.astype(jnp.float32)
         return jnp.einsum("btd,vd->btv", x.astype(jnp.float32), table)
+
+    def head_logits(self, params, h):
+        """The tied vocab head applied to (B, d_model) hidden rows —
+        the SAME f32 projection ``__call__`` ends with, for callers
+        that ran ``head=False`` and kept only the rows they need
+        (chunked prefill). The embed table's param path is pinned by a
+        test against a full forward."""
+        table = params["Embed_0"]["embedding"].astype(jnp.float32)
+        return jnp.einsum("bd,vd->bv", h.astype(jnp.float32), table)

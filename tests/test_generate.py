@@ -283,10 +283,12 @@ def test_top_p_sweep_shares_one_program(topo8):
     from mpit_tpu.models import generate_fast, sampling
 
     generate_fast(model, params, [1], 8, temperature=1.0, top_p=0.5)
-    n0 = sampling._batch_decode_scan._cache_size()
+    # generate_fast routes through the chunked-prefill kernel (single
+    # prompt == uniform length); count compiles there
+    n0 = sampling._prefill_decode_scan._cache_size()
     for p in (0.6, 0.8, 0.9, 0.95):
         generate_fast(model, params, [1], 8, temperature=1.0, top_p=p)
-    assert sampling._batch_decode_scan._cache_size() == n0
+    assert sampling._prefill_decode_scan._cache_size() == n0
 
 
 # --------------------------------------------------------------- beam search
@@ -481,11 +483,18 @@ def test_batch_size_bucketing_shares_programs(topo8):
     )["params"]
     from mpit_tpu.models import generate_batch, sampling
 
+    # uniform-length prompts route through the prefill kernel; N=3 and
+    # N=4 share its bucket
     generate_batch(model, params, [[1]] * 4, steps=4)
-    n0 = sampling._batch_decode_scan._cache_size()
+    n0 = sampling._prefill_decode_scan._cache_size()
     out3 = generate_batch(model, params, [[1], [2], [3]], steps=4)
-    assert sampling._batch_decode_scan._cache_size() == n0
+    assert sampling._prefill_decode_scan._cache_size() == n0
     assert len(out3) == 3 and all(len(r) == 5 for r in out3)
+    # mixed lengths fall back to the per-tick kernel; N buckets there too
+    generate_batch(model, params, [[1], [2, 3], [4], [5, 6]], steps=4)
+    n1 = sampling._batch_decode_scan._cache_size()
+    generate_batch(model, params, [[1], [2, 3], [4]], steps=4)
+    assert sampling._batch_decode_scan._cache_size() == n1
 
 
 # --------------------------------------------------------- tensor-parallel
@@ -662,3 +671,24 @@ def test_property_fast_equals_slow(prompt, steps, temperature, seed):
     b = generate_fast(model, params, prompt, steps,
                       temperature=temperature, seed=seed)
     assert a == b, (prompt, steps, temperature, seed)
+
+
+def test_head_logits_matches_full_forward(topo8):
+    """head=False hidden states projected through head_logits equal the
+    full forward's logits at every position — pins the embed table's
+    param path the helper reaches into."""
+    model = _model()
+    params = model.init(
+        jax.random.key(0), jnp.zeros((1, T), jnp.int32)
+    )["params"]
+    x = jnp.asarray(
+        np.random.default_rng(1).integers(0, V, (2, 8)), jnp.int32
+    )
+    full = model.apply({"params": params}, x)
+    hidden = model.clone(head=False).apply({"params": params}, x)
+    for pos in (0, 3, 7):
+        got = model.head_logits(params, hidden[:, pos])
+        np.testing.assert_allclose(
+            np.asarray(got), np.asarray(full[:, pos]),
+            rtol=1e-6, atol=1e-6,
+        )
