@@ -228,12 +228,20 @@ def generate_fast(
     )
 
 
+def _bucket(n, cap):
+    """The ONE power-of-two bucket rule every decode dimension uses
+    (scan/prefill/generation lengths, batch rows): smallest power of two
+    >= n, capped at ``cap`` so cache writes and positional gathers stay
+    in bounds (enlarging past the cap would clamp silently — don't)."""
+    b = 1
+    while b < n:
+        b *= 2
+    return min(b, cap)
+
+
 def _decode_setup(model, prompt, steps):
-    """Shared generate_fast/beam_search setup: the decode-mode clone,
-    the power-of-two-bucketed scan length (capped at max_len so every
-    cache write and positional gather stays strictly in bounds — enlarge
-    the bucket past max_len and both would clamp silently, so don't),
-    and the prompt buffer. ONE copy of the overflow contract."""
+    """Shared serving setup: the overflow contract (ONE copy) and the
+    decode-mode clone."""
     total = len(prompt) + steps
     if total > model.max_len:
         raise ValueError(
@@ -243,39 +251,38 @@ def _decode_setup(model, prompt, steps):
     dec = model.clone(
         decode=True, remat=False, seq_axis=None, attn_impl="xla"
     )
-    scan_len = 1
-    while scan_len < total - 1:
-        scan_len *= 2
-    scan_len = min(scan_len, model.max_len)
-    buf = jnp.zeros((scan_len + 1,), jnp.int32)
-    buf = buf.at[: len(prompt)].set(jnp.asarray(prompt, jnp.int32))
-    return dec, scan_len, buf, total
+    return dec, total
 
 
-@functools.partial(jax.jit, static_argnums=(0, 1, 2, 3))
+@functools.partial(jax.jit, static_argnums=(0, 1, 2, 3, 4))
 def _beam_scan(
-    model, scan_len, beam, eos_id, params, cache0, buf, p_len, limit
+    model, pre_bucket, gen_len, beam, eos_id,
+    params, cache1, pre_buf, p_len, limit,
 ):
-    """Fixed-budget beam search as ONE compiled program.
+    """Fixed-budget beam search with chunked prefill, as ONE program.
 
-    Beams ride the decode batch dimension: the K/V caches are (beam, ...)
-    and every survivor-selection step REORDERS them by parent beam with a
+    The prompt runs ONCE at batch 1 (``head=False`` dense chunk — the
+    same prefill recipe as :func:`_prefill_decode_scan`); the filled
+    cache then broadcasts across the beam batch dimension (every beam
+    shares the prompt by definition), and only EXPANSIONS tick. Each
+    survivor-selection step REORDERS the caches by parent beam with a
     plain gather (the standard recipe — cheap relative to the matmuls).
-    During the prompt ticks every beam is forced onto the prompt token
-    and scores stay [0, -inf, ...], so the first free expansion picks
-    the ``beam`` best distinct continuations of beam 0, exactly the
-    textbook initialization. ``eos_id`` (static; None = fixed-length): a
-    finished beam's only allowed continuation is another ``eos_id`` at
-    zero cost, freezing its score while the budget runs out. ``limit``
-    (traced, = p_len + steps): bucket-overrun ticks at or past the
-    budget freeze EVERYTHING — parents, scores, done — so the final
-    ranking reflects exactly ``steps`` expansions, not the bucket's
-    horizon (the _decode_scan analogue merely discards outputs; a beam
-    ranking must be frozen, not just ignored).
+    Expansion 0 scores candidates from the prefill logits with beam 0
+    alone live ([0, -inf, ...]), picking the ``beam`` best distinct
+    continuations — the textbook initialization.
 
-    Returns ``(tokens (beam, scan_len+1), scores (beam,))`` sorted by
-    construction of the final top-k (row 0 need not be best — the caller
-    argmaxes over scores).
+    ``eos_id`` (static; None = fixed-length): a finished beam's only
+    allowed continuation is another ``eos_id`` at zero cost, freezing
+    its score while the budget runs out. ``limit`` (traced, = steps):
+    bucket-overrun expansions at or past the budget freeze EVERYTHING —
+    parents, scores, done — so the final ranking reflects exactly
+    ``steps`` expansions (a beam ranking must be frozen, not just
+    ignored). Bucket-overrun cache writes may clamp at the max_len
+    boundary: safe because they strictly follow the last kept expansion
+    and the cache dies with this call.
+
+    Returns ``(gen_tokens (beam, gen_len), scores (beam,))`` — the
+    caller prepends the prompt and argmaxes over scores.
     """
     vocab = model.vocab_size
 
@@ -287,12 +294,50 @@ def _beam_scan(
             tree,
         )
 
-    toks0 = jnp.broadcast_to(buf, (beam, buf.shape[0])).astype(jnp.int32)
+    def expand(logp, scores, done):
+        """Score (beam, vocab) candidates and pick the survivors."""
+        cand = scores[:, None] + logp
+        if eos_id is not None:
+            # finished beams may only emit eos again, at zero cost
+            pad_row = jnp.full((vocab,), -jnp.inf).at[eos_id].set(0.0)
+            cand = jnp.where(
+                done[:, None], scores[:, None] + pad_row[None, :], cand
+            )
+        top_scores, top_idx = jax.lax.top_k(cand.reshape(-1), beam)
+        return top_scores, top_idx // vocab, (
+            top_idx % vocab
+        ).astype(jnp.int32)
+
+    # --- prefill at batch 1, broadcast the cache across the beams
+    hidden, mut = model.clone(head=False).apply(
+        {"params": params, "cache": cache1}, pre_buf, mutable=["cache"]
+    )
+    cache = _fix_cache_indices(mut["cache"], p_len)
+    cache = jax.tree.map(
+        lambda a: jnp.repeat(a, beam, axis=0)
+        if getattr(a, "ndim", 0) >= 1 and a.shape[0] == 1
+        else a,
+        cache,
+    )
+    logp0 = jax.nn.log_softmax(
+        model.head_logits(params, hidden[:, p_len - 1])[0].astype(
+            jnp.float32
+        )
+    )
     scores0 = jnp.full((beam,), -jnp.inf).at[0].set(0.0)
     done0 = jnp.zeros((beam,), bool)
-    prev0 = jnp.broadcast_to(buf[0], (beam,)).astype(jnp.int32)
+    scores, parents, chosen = expand(
+        jnp.broadcast_to(logp0, (beam, vocab)), scores0, done0
+    )
+    # no cache gather here: every row is still the identical broadcast
+    # prefill cache, so gathering by parents is a value-level no-op XLA
+    # cannot elide (it would copy the whole beam-wide K/V cache)
+    toks = jnp.zeros((beam, gen_len), jnp.int32).at[:, 0].set(chosen)
+    done = (
+        (chosen == eos_id) if eos_id is not None else done0
+    )
 
-    def step(carry, t):
+    def step(carry, e):
         cache, toks, scores, done, prev = carry
         logits, mut = model.apply(
             {"params": params, "cache": cache},
@@ -303,40 +348,24 @@ def _beam_scan(
         logp = jax.nn.log_softmax(
             logits[:, 0].astype(jnp.float32), axis=-1
         )
-        cand = scores[:, None] + logp  # (beam, vocab)
-        if eos_id is not None:
-            # finished beams may only emit eos again, at zero cost
-            pad_row = jnp.full((vocab,), -jnp.inf).at[eos_id].set(0.0)
-            cand = jnp.where(
-                done[:, None], scores[:, None] + pad_row[None, :], cand
-            )
-        top_scores, top_idx = jax.lax.top_k(cand.reshape(-1), beam)
-        parents = top_idx // vocab
-        chosen = (top_idx % vocab).astype(jnp.int32)
-        # prompt ticks: every beam stays itself and feeds the known
-        # token; overrun ticks (budget exhausted): freeze entirely
-        in_prefill = t + 1 < p_len
-        # generated positions are t+1 in [p_len, limit-1]; at t+1 >= limit
-        # the steps budget is spent
-        frozen = t + 1 >= limit
-        keep = in_prefill | frozen
-        parents = jnp.where(keep, jnp.arange(beam), parents)
-        chosen = jnp.where(
-            in_prefill, buf[t + 1], jnp.where(frozen, prev, chosen)
-        )
-        scores = jnp.where(keep, scores, top_scores)
+        new_scores, parents, chosen = expand(logp, scores, done)
+        frozen = e >= limit  # the steps budget is spent
+        parents = jnp.where(frozen, jnp.arange(beam), parents)
+        chosen = jnp.where(frozen, prev, chosen)
+        scores = jnp.where(frozen, scores, new_scores)
         cache = gather_beams(cache, parents)
-        toks = toks[parents].at[:, t + 1].set(chosen)
+        toks = toks[parents].at[:, e].set(chosen)
         if eos_id is not None:
             done = jnp.where(
-                keep, done, done[parents] | (chosen == eos_id)
+                frozen, done, done[parents] | (chosen == eos_id)
             )
         return (cache, toks, scores, done, chosen), None
 
-    (cache, toks, scores, done, _), _ = jax.lax.scan(
-        step, (cache0, toks0, scores0, done0, prev0),
-        jnp.arange(scan_len),
-    )
+    if gen_len > 1:
+        (cache, toks, scores, done, _), _ = jax.lax.scan(
+            step, (cache, toks, scores, done, chosen),
+            jnp.arange(1, gen_len),
+        )
     return toks, scores
 
 
@@ -369,15 +398,22 @@ def beam_search(
         return [int(t) for t in prompt], 0.0
     if weights_dtype is not None:
         params = cast_weights(params, weights_dtype)
-    dec, scan_len, buf, total = _decode_setup(model, prompt, steps)
+    dec, _ = _decode_setup(model, prompt, steps)
+    p0 = len(prompt)
+    pre_bucket = _bucket(p0, model.max_len)
+    gen_bucket = _bucket(steps, model.max_len)
+    pre_buf = jnp.zeros((1, pre_bucket), jnp.int32)
+    pre_buf = pre_buf.at[0, :p0].set(jnp.asarray(prompt, jnp.int32))
     toks, scores = _beam_scan(
-        dec, scan_len, beam_size, eos_id,
-        params, _zero_cache(dec, beam_size), buf,
-        jnp.asarray(len(prompt), jnp.int32),
-        jnp.asarray(total, jnp.int32),
+        dec, pre_bucket, gen_bucket, beam_size, eos_id,
+        params, _zero_cache(dec, 1), pre_buf,
+        jnp.asarray(p0, jnp.int32),
+        jnp.asarray(steps, jnp.int32),
     )
     best = int(jnp.argmax(scores))
-    seq = [int(t) for t in jax.device_get(toks[best, :total])]
+    seq = [int(t) for t in prompt] + [
+        int(t) for t in jax.device_get(toks[best, :steps])
+    ]
     return _truncate_at_eos(seq, len(prompt), eos_id), float(scores[best])
 
 
@@ -646,10 +682,9 @@ def _generate_rows(
         rngs = jnp.stack(list(rngs))
     n = len(prompts)
     longest = max(prompts, key=len)
-    dec, scan_len, _, _ = _decode_setup(model, longest, steps)
-    nb = 1
-    while nb < n:
-        nb *= 2
+    dec, total = _decode_setup(model, longest, steps)
+    scan_len = _bucket(total - 1, model.max_len)
+    nb = _bucket(n, 1 << 30)  # rows have no cap — pad rows are sliced away
     greedy = temperature == 0.0
     temp = jnp.asarray(max(temperature, 1e-9), jnp.float32)
     tp_val = jnp.asarray(1.0 if top_p is None else top_p, jnp.float32)
@@ -675,14 +710,8 @@ def _generate_rows(
     cache0 = _zero_cache(dec, nb, sharding_fn=cache_sharding_fn)
     p0 = len(prompts[0])
     if all(len(q) == p0 for q in prompts):
-        pre_bucket = 1
-        while pre_bucket < p0:
-            pre_bucket *= 2
-        pre_bucket = min(pre_bucket, model.max_len)
-        gen_bucket = 1
-        while gen_bucket < steps:
-            gen_bucket *= 2
-        gen_bucket = min(gen_bucket, model.max_len)
+        pre_bucket = _bucket(p0, model.max_len)
+        gen_bucket = _bucket(steps, model.max_len)
         pre_host = np.zeros((nb, pre_bucket), np.int32)
         for i, q in enumerate(prompts):
             pre_host[i] = (list(q) + [0] * pre_bucket)[:pre_bucket]
