@@ -316,7 +316,13 @@ class TransformerLM(nn.Module):
             )
         dt = self.compute_dtype
         t_local = tokens.shape[1]
-        embed = nn.Embed(self.vocab_size, self.d_model, dtype=dt)
+        # name pinned explicitly (matches the flax auto-name so existing
+        # checkpoints/param trees are unchanged): head_logits() reaches the
+        # tied table via params["Embed_0"]["embedding"], so reordering or
+        # renaming this module must not move that path
+        embed = nn.Embed(
+            self.vocab_size, self.d_model, dtype=dt, name="Embed_0"
+        )
         pos_table = self.param(
             "pos_embedding",
             nn.initializers.normal(0.02),

@@ -382,6 +382,39 @@ def _check_resume_layout(cfg: TrainConfig) -> None:
     )
     if saved.get("algo") != cfg.algo:
         return  # cross-algo restore fails on structure already
+    # EVERY resuming trainer checkpoints an optax opt_state whose pytree
+    # STRUCTURE depends on: the optimizer (adam's two moments vs sgd's
+    # trace), whether the lr is a SCHEDULE (scale_by_schedule carries a
+    # count leaf; a constant lr doesn't), and — where build_optimizer
+    # chains it — whether clip_norm is set (the chain's state tuple gains
+    # an element). from_bytes reports any of these as an opaque structure
+    # error, so catch them here for ALL algos, not just pp-sync. Value-
+    # only changes (lr, clip threshold, cosine<->warmup-cosine, momentum:
+    # optax.sgd builds a TraceState for any non-None float, 0.0 included)
+    # are structure-identical and stay resumable.
+    clip_chained = cfg.resolved_algo() not in (
+        "moe-sync", "zero-sync", "pp-sync"  # these take clip_norm on the
+    )  # trainer, outside opt_state (build_optimizer's chain comment)
+    structure_of = lambda opt, sched, clip: {
+        "optimizer": opt,
+        "lr_is_schedule": sched != "constant",
+        **({"clip_chained": clip is not None} if clip_chained else {}),
+    }
+    cur = structure_of(cfg.optimizer, cfg.lr_schedule, cfg.clip_norm)
+    # old metadata-less fields: compare only what the checkpoint recorded
+    sav = structure_of(
+        saved.get("optimizer", cfg.optimizer),
+        saved.get("lr_schedule", cfg.lr_schedule),
+        saved.get("clip_norm", cfg.clip_norm),
+    )
+    if sav != cur:
+        diff = {k: (sav[k], cur[k]) for k in cur if sav[k] != cur[k]}
+        raise ValueError(
+            f"resume layout mismatch: checkpoint in {cfg.ckpt_dir!r} was "
+            f"written with a different optimizer-state structure "
+            f"{diff} (saved, requested) — restore with the original "
+            "optimizer/lr_schedule/clip_norm configuration or start fresh"
+        )
     if cfg.algo != "pp-sync":
         return
     # state-LAYOUT generation check: the pipeline state moved from
@@ -417,7 +450,8 @@ def _check_resume_layout(cfg: TrainConfig) -> None:
     # layers are globally ordered, so a different pp extent re-shards
     # soundly on restore and a gpipe<->1f1b flip is layout-identical.
     # layers always matters (it changes the array shapes — fail clearly
-    # here, not inside from_bytes).
+    # here, not inside from_bytes). Optimizer structure was checked above
+    # for every algo.
     fields = ["layers", "pp_schedule"]
     if "interleaved" in (saved.get("pp_schedule"), cfg.pp_schedule):
         fields += ["pp", "pp_virtual"]
@@ -434,8 +468,8 @@ def _check_resume_layout(cfg: TrainConfig) -> None:
         raise ValueError(
             f"resume layout mismatch: checkpoint in {cfg.ckpt_dir!r} was "
             f"written with {mismatched} (saved, requested) — the pipeline "
-            "param layout depends on these; restore with the original "
-            "config or start fresh"
+            "param/opt-state layout depends on these; restore with the "
+            "original config or start fresh"
         )
 
 

@@ -8,6 +8,9 @@ import os
 
 import pytest
 
+# integration tier — excluded from the smoke run (full driver runs over every preset)
+pytestmark = pytest.mark.slow
+
 from mpit_tpu.run import run
 from mpit_tpu.utils.config import TrainConfig
 
@@ -144,6 +147,27 @@ class TestPresets:
 
 
 class TestDriverPlumbing:
+    def test_optimizer_mismatch_rejected_any_algo(self, tmp_path):
+        """An sgd checkpoint resumed with adam must fail the layout guard
+        with a clear message on EVERY algo (the opt_state structure
+        differs), not die inside from_bytes — the guard is not
+        pp-sync-only."""
+        base = _cfg("mnist-easgd", train_size=256, global_batch=64,
+                    epochs=1, ckpt_dir=str(tmp_path / "ck"))
+        run(base)
+        with pytest.raises(ValueError, match="optimizer"):
+            run(dataclasses.replace(
+                base, resume=True, epochs=2, optimizer="adam"))
+        # a SCHEDULE flips the opt_state between scale (empty) and
+        # scale_by_schedule (count leaf); clip_norm None->value grows the
+        # chain's state tuple — both must fail the guard, not from_bytes
+        with pytest.raises(ValueError, match="layout mismatch"):
+            run(dataclasses.replace(
+                base, resume=True, epochs=2, lr_schedule="cosine"))
+        with pytest.raises(ValueError, match="layout mismatch"):
+            run(dataclasses.replace(
+                base, resume=True, epochs=2, clip_norm=0.5))
+
     def test_metrics_and_checkpoint(self, tmp_path):
         cfg = _cfg(
             "mnist-easgd", train_size=512, global_batch=64, epochs=1,
@@ -224,6 +248,12 @@ class TestDriverPlumbing:
         with pytest.raises(ValueError, match="layout mismatch"):
             run(dataclasses.replace(
                 base, resume=True, epochs=2, pp_virtual=1))
+        # a different optimizer changes the opt_state STRUCTURE (adam's
+        # moments vs sgd's trace) — guard must catch it here, not let
+        # from_bytes fail with an opaque structure error
+        with pytest.raises(ValueError, match="layout mismatch"):
+            run(dataclasses.replace(
+                base, resume=True, epochs=2, optimizer="adam"))
         # the original config resumes fine
         r = run(dataclasses.replace(base, resume=True, epochs=2))
         assert r["resumed_from"] == 2
