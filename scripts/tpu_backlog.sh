@@ -1,6 +1,9 @@
 #!/bin/bash
-# Remaining round-3 measurement backlog (docs/PERF.md "moment the tunnel
-# returns" list, minus the legs already measured 2026-07-31 morning).
+# Remaining measurement backlog (docs/PERF.md "moment the tunnel returns"
+# list, minus the legs already measured 2026-07-31 morning). Ordered by
+# value-per-minute: the MFU-ceiling row (ptb-transformer-large) and the
+# ResNet-50 profile attribution are VERDICT r3 items 2-3 and run FIRST so
+# a tunnel that dies mid-backlog still leaves the decisive evidence.
 # Safe to re-run; each leg overwrites its own log under /tmp. The DONE
 # sentinel records how many legs failed — "DONE failed=0" is the only
 # all-clear (a flapping tunnel can fail every leg and still reach the
@@ -8,16 +11,24 @@
 cd "$(dirname "$0")/.."
 set -x
 failed=0
-run() { timeout 1800 "$@" || failed=$((failed+1)); }
+# 2-preset measure_presets legs now run each preset in its OWN subprocess
+# (fresh jax init + compile, up to 1800s per child, plus settle gaps and
+# repeats=3 timed legs), so the outer budget must cover BOTH children
+run() { timeout 3900 "$@" || failed=$((failed+1)); }
+# -- decisive legs first (VERDICT r3 items 2-3) --
+run python scripts/measure_presets.py --presets ptb-transformer-large > /tmp/v_xl.log 2>&1
+run python bench.py --preset resnet50-sync --profile /tmp/prof_r50 > /tmp/v_prof_r50.log 2>&1
+run python bench.py --preset ptb-transformer-seq --profile /tmp/prof_tseq > /tmp/v_prof_tseq.log 2>&1
+# -- serving numbers (VERDICT r3 item 8) --
+run python bench.py --decode > /tmp/v_decode.log 2>&1
+run python bench.py --decode --weights-dtype bf16 > /tmp/v_decode_bf16.log 2>&1
+run python bench.py --decode --mixed > /tmp/v_decode_mixed.log 2>&1
+# -- variant axes --
 run python scripts/measure_presets.py --remat --presets resnet50-sync,ptb-transformer-seq > /tmp/v_remat.log 2>&1
 run python scripts/measure_presets.py --set algo=zero-sync --presets mnist-easgd,cifar-vgg-sync > /tmp/v_zero.log 2>&1
 run python scripts/measure_presets.py --set optimizer=adam --presets mnist-easgd > /tmp/v_adam.log 2>&1
 run python scripts/measure_presets.py --set attn_impl=flash --presets ptb-transformer-seq > /tmp/v_flash.log 2>&1
 run python scripts/measure_presets.py --presets ptb-transformer-pp --set pp_schedule=1f1b > /tmp/v_1f1b.log 2>&1
-run python scripts/sweep_lenet.py > /tmp/v_sweep_lenet.log 2>&1
 run python scripts/measure_presets.py --stem space_to_depth --presets resnet50-sync > /tmp/v_s2d_r50.log 2>&1
-run python bench.py --preset resnet50-sync --profile /tmp/prof_r50 > /tmp/v_prof_r50.log 2>&1
-run python scripts/measure_presets.py --presets ptb-transformer-large > /tmp/v_xl.log 2>&1
-run python bench.py --decode > /tmp/v_decode.log 2>&1
-run python bench.py --decode --weights-dtype bf16 > /tmp/v_decode_bf16.log 2>&1
+run python scripts/sweep_lenet.py > /tmp/v_sweep_lenet.log 2>&1
 echo "DONE failed=$failed" > /tmp/tpu_backlog.done

@@ -1,0 +1,56 @@
+#!/bin/bash
+# Tunnel watcher (round-4 first action, VERDICT r3 item 1): probe the
+# hardware backend in a BOUNDED subprocess every ~5 min; the moment it
+# answers, capture the driver-format bench JSON first (the official
+# record three rounds of outages have blocked), then run the full
+# measurement backlog. Touches /tmp/tpu_alive while hardware is usable so
+# interactive sessions can avoid stacking host load on a live sweep
+# (the 35% cifar-vgg outlier class, PERF.md).
+cd "$(dirname "$0")/.."
+log() { echo "$(date -Is) $*" >> /tmp/tpu_watcher.log; }
+# single-instance guard: two watchers would double-run the backlog and
+# stack host load on the live window they exist to protect
+if [ -f /tmp/tpu_watcher.pid ] && kill -0 "$(cat /tmp/tpu_watcher.pid)" 2>/dev/null; then
+  log "watcher already running (pid $(cat /tmp/tpu_watcher.pid)) — exiting"
+  exit 0
+fi
+echo $$ > /tmp/tpu_watcher.pid
+trap 'rm -f /tmp/tpu_alive /tmp/tpu_watcher.pid' EXIT
+log "watcher start (pid $$)"
+bench_json_good() {
+  # a captured record counts only if it is valid JSON from a TPU run
+  python - <<'EOF' >/dev/null 2>&1
+import json
+d = json.load(open("/tmp/bench_tpu.json"))
+assert d.get("platform") not in (None, "cpu")
+EOF
+}
+while true; do
+  if timeout 180 python -c "import jax; assert jax.devices()[0].platform != 'cpu'" >/dev/null 2>&1; then
+    touch /tmp/tpu_alive
+    log "tunnel ALIVE"
+    if bench_json_good; then
+      log "bench JSON already captured — skipping straight to backlog"
+    else
+      log "running bench.py (official record)"
+      # temp + mv: a tunnel dying mid-bench must not destroy an earlier
+      # successful capture with a truncating redirect
+      if timeout 1800 python bench.py > /tmp/bench_tpu.json.part 2>/tmp/bench_tpu.err \
+          && [ -s /tmp/bench_tpu.json.part ]; then
+        mv /tmp/bench_tpu.json.part /tmp/bench_tpu.json
+      fi
+      log "bench.py done: $(head -c 300 /tmp/bench_tpu.json 2>/dev/null)"
+    fi
+    bash scripts/tpu_backlog.sh >> /tmp/tpu_watcher.log 2>&1
+    log "backlog sentinel: $(cat /tmp/tpu_backlog.done 2>/dev/null)"
+    rm -f /tmp/tpu_alive
+    # keep watching: a later window can re-run any failed legs
+    if bench_json_good && grep -q "failed=0" /tmp/tpu_backlog.done 2>/dev/null; then
+      log "all legs clean — watcher exiting"
+      break
+    fi
+  else
+    log "tunnel dead"
+  fi
+  sleep 300
+done
