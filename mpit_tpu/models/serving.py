@@ -1,0 +1,207 @@
+"""Continuous-batching serving loop over the KV-cached decode kernels.
+
+The reference trains models but cannot sample from them at all; this is
+the beyond-parity serving tier above :func:`~mpit_tpu.models.sampling.
+generate_batch`: a scheduler that keeps a decode batch full while
+requests arrive and finish at different times.
+
+Design (TPU-first, built ENTIRELY on the existing compiled kernels — no
+new model code, no per-row cache clocks):
+
+- Decoding advances in fixed **segments** of ticks. Each segment is one
+  call into the shared batched kernel path (``_batch_impl``), so the
+  whole segment is one (or two: prefill + scan) XLA program — the host
+  only intervenes at segment boundaries.
+- At a segment boundary the server retires finished rows (budget
+  exhausted or ``eos_id`` emitted) and **admits** queued requests into
+  the freed slots. Admission re-enters every in-flight row's KNOWN
+  tokens (prompt + generated so far) as that row's "prompt": the mixed-
+  length chunked prefill then rebuilds all caches in one matmul-bound
+  dense pass. That re-prefill is the price of admission — O(L) extra
+  FLOPs per admission event, paid on the MXU-friendly path — and what
+  it buys is a decode batch that never runs with dead rows. (True
+  in-place admission needs per-row cache clocks, a Block-level change;
+  this scheduler is deliberately kernel-reusing instead.)
+- **Exact parity**: every request's result is bit-equal to its solo
+  ``generate_fast(prompt, max_new, rng=request_rng)`` call. Sampling
+  keys are pre-split per request (``split(rng, max_new)``) and each
+  segment feeds the kernel the UNUSED SLICE of each row's stream
+  (``_batch_impl(key_streams=...)``), so token k of a request is always
+  drawn with stream key k no matter how segments and batch compositions
+  fell. Greedy is parity-trivial; the key plumbing makes sampled
+  serving parity hold too — pinned in tests/test_serving.py.
+
+Row independence (each row's outputs depend only on its own tokens —
+the property the batch==solo tests pin) is what makes retirement and
+admission invisible to the surviving rows.
+"""
+
+from __future__ import annotations
+
+from collections import deque
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from mpit_tpu.models import sampling
+
+
+class Server:
+    """Continuous-batching decode server for one model + params.
+
+    Args:
+      model: a dense ``TransformerLM`` (same restrictions as
+        :func:`~mpit_tpu.models.sampling.generate_fast`).
+      params: trained parameters. With ``weights_dtype="bf16"`` they are
+        cast ONCE here (serving is HBM-bound; see ``cast_weights``).
+      max_batch: decode-slot count; queued requests wait for a free slot.
+      segment: ticks per kernel call between scheduling points. Large
+        segments amortize dispatch; small segments admit/retire sooner.
+      temperature/top_k/top_p/eos_id: the sampling rule, shared by every
+        request this server runs (per-request rules would recompile per
+        combination; serve different rules from different Servers).
+    """
+
+    def __init__(
+        self,
+        model,
+        params,
+        max_batch: int = 8,
+        segment: int = 32,
+        temperature: float = 0.0,
+        top_k: Optional[int] = None,
+        top_p: Optional[float] = None,
+        eos_id: Optional[int] = None,
+        weights_dtype=None,
+        seed: int = 0,
+    ):
+        if max_batch < 1:
+            raise ValueError("max_batch must be >= 1")
+        if segment < 1:
+            raise ValueError("segment must be >= 1")
+        self.model = model
+        self.params = (
+            sampling.cast_weights(params, jnp.bfloat16)
+            if weights_dtype in ("bf16", jnp.bfloat16) else params
+        )
+        self.max_batch = int(max_batch)
+        self.segment = int(segment)
+        self.temperature = float(temperature)
+        self.top_k = top_k
+        self.top_p = top_p
+        self.eos_id = eos_id
+        self._rng = jax.random.key(seed)
+        self._next_id = 0
+        self._waiting: deque[dict] = deque()
+        self._active: list[dict] = []
+        self._results: dict[int, list[int]] = {}
+        self.segments_run = 0
+
+    # ------------------------------------------------------------- intake
+
+    def submit(
+        self, prompt, max_new_tokens: int, rng=None, seed=None
+    ) -> int:
+        """Queue a request; returns its id. The request's sampling stream
+        is fixed HERE (``rng``, or ``fold_in(server_rng, id)`` — matching
+        ``generate_batch``'s per-row derivation), so results are
+        reproducible regardless of scheduling."""
+        sampling._validate(
+            self.model, prompt, self.temperature, self.top_k, self.top_p,
+            self.eos_id,
+        )
+        if max_new_tokens < 1:
+            raise ValueError("max_new_tokens must be >= 1")
+        if len(prompt) + max_new_tokens > self.model.max_len:
+            raise ValueError(
+                f"prompt ({len(prompt)}) + max_new_tokens "
+                f"({max_new_tokens}) exceeds max_len={self.model.max_len} "
+                "(the cached decode cannot slide)"
+            )
+        rid = self._next_id
+        self._next_id += 1
+        if rng is None:
+            rng = (
+                jax.random.key(seed) if seed is not None
+                else jax.random.fold_in(self._rng, rid)
+            )
+        self._waiting.append({
+            "id": rid,
+            "known": [int(t) for t in prompt],
+            "p0": len(prompt),
+            "max_new": int(max_new_tokens),
+            "gen": 0,
+            # the request's ENTIRE stream, split once: segment k draws
+            # keys [gen, gen+steps) from it — solo-call parity
+            "stream": jax.random.split(rng, max_new_tokens),
+        })
+        return rid
+
+    # ---------------------------------------------------------- scheduling
+
+    @property
+    def pending(self) -> int:
+        return len(self._waiting) + len(self._active)
+
+    def step(self) -> None:
+        """One scheduling round: admit into free slots, run one segment,
+        retire finished rows."""
+        while self._waiting and len(self._active) < self.max_batch:
+            self._active.append(self._waiting.popleft())
+        if not self._active:
+            return
+        # a row at the max_len frontier caps the segment for everyone —
+        # transient: such a row's budget ends within those ticks
+        steps = min(
+            self.segment,
+            min(self.model.max_len - len(r["known"])
+                for r in self._active),
+        )
+        keys = jnp.stack([
+            self._stream_slice(r, steps) for r in self._active
+        ])
+        rows = sampling._batch_impl(
+            self.model, self.params,
+            [r["known"] for r in self._active], steps,
+            self.temperature, 0, None, self.top_k, self.top_p,
+            key_streams=keys,
+        )
+        self.segments_run += 1
+        survivors = []
+        for r, row in zip(self._active, rows):
+            new = row[len(r["known"]):]
+            take = min(len(new), r["max_new"] - r["gen"])
+            done = False
+            for j in range(take):
+                tok = int(new[j])
+                r["known"].append(tok)
+                r["gen"] += 1
+                if self.eos_id is not None and tok == self.eos_id:
+                    done = True
+                    break
+            if done or r["gen"] >= r["max_new"]:
+                self._results[r["id"]] = r["known"]
+            else:
+                survivors.append(r)
+        self._active = survivors
+
+    def _stream_slice(self, r: dict, steps: int):
+        """keys [gen, gen+steps) of the request's stream, padded by
+        repeating the last key (pad positions are only ever consumed by
+        ticks whose samples this server discards — beyond the budget)."""
+        s = r["stream"][r["gen"]: r["gen"] + steps]
+        if s.shape[0] < steps:
+            s = jnp.concatenate(
+                [s, jnp.repeat(s[-1:], steps - s.shape[0], axis=0)]
+            )
+        return s
+
+    def drain(self) -> dict:
+        """Run until every submitted request finished; returns
+        {id: tokens} (prompt included; truncated just past eos if one was
+        emitted — the shared truncation convention)."""
+        while self._waiting or self._active:
+            self.step()
+        out, self._results = self._results, {}
+        return out

@@ -306,6 +306,7 @@ def test_beam_one_is_greedy(topo8):
     assert np.isfinite(score)
 
 
+@pytest.mark.slow
 def test_beam_matches_brute_force(topo8):
     """With beam_size >= V^(steps-1) the search is exhaustive: its best
     sequence must equal the argmax over ALL V^steps continuations scored
@@ -392,6 +393,7 @@ def test_beam_eos_truncates_and_freezes(topo8):
     )
 
 
+@pytest.mark.slow
 def test_beam_score_is_replayable_at_non_pow2_budget(topo8):
     """steps whose scan bucket overruns the budget (total-1 not a power
     of two) must still return a score equal to the replayed log-prob of
@@ -490,11 +492,89 @@ def test_batch_size_bucketing_shares_programs(topo8):
     out3 = generate_batch(model, params, [[1], [2], [3]], steps=4)
     assert sampling._prefill_decode_scan._cache_size() == n0
     assert len(out3) == 3 and all(len(r) == 5 for r in out3)
-    # mixed lengths fall back to the per-tick kernel; N buckets there too
+    # mixed lengths with a 1-token shortest prompt fall back to the
+    # per-tick kernel; N buckets there too
     generate_batch(model, params, [[1], [2, 3], [4], [5, 6]], steps=4)
     n1 = sampling._batch_decode_scan._cache_size()
     generate_batch(model, params, [[1], [2, 3], [4]], steps=4)
     assert sampling._batch_decode_scan._cache_size() == n1
+
+
+def test_mixed_prefill_common_prefix(topo8, monkeypatch):
+    """Mixed-length batches keep the matmul-bound prompt path: the
+    common prefix (largest power of two <= the shortest prompt) enters
+    the cache as one dense pass, the all-ticks kernel never runs
+    (path pin), and every row stays equal to its solo generate_fast
+    call — greedy and sampled with filters."""
+    model = _model()
+    params = model.init(
+        jax.random.key(0), jnp.zeros((1, T), jnp.int32)
+    )["params"]
+    from mpit_tpu.models import generate_batch, generate_fast, sampling
+
+    prompts = [[3, 1, 4, 1, 5], [2, 6], [7, 7, 7]]  # lens 5,2,3 -> chunk 2
+
+    def boom(*a, **k):
+        raise AssertionError(
+            "all-ticks fallback used for a chunkable mixed batch"
+        )
+
+    monkeypatch.setattr(sampling, "_batch_decode_scan", boom)
+    got = generate_batch(model, params, prompts, steps=6)
+    for i, p in enumerate(prompts):
+        assert got[i] == generate_fast(model, params, p, steps=6), i
+
+    rng = jax.random.key(7)
+    got = generate_batch(
+        model, params, prompts, steps=6, temperature=0.8, rng=rng,
+        top_k=5,
+    )
+    for i, p in enumerate(prompts):
+        want = generate_fast(
+            model, params, p, steps=6, temperature=0.8,
+            rng=jax.random.fold_in(rng, i), top_k=5,
+        )
+        assert got[i] == want, i
+
+
+def test_mixed_prefill_degenerate_falls_back(topo8, monkeypatch):
+    """A 1-token shortest prompt has no chunkable prefix (chunk would
+    be 1 tick — not worth a second program): the mixed-prefill kernel
+    must NOT run; the per-tick kernel handles the batch."""
+    model = _model()
+    params = model.init(
+        jax.random.key(0), jnp.zeros((1, T), jnp.int32)
+    )["params"]
+    from mpit_tpu.models import generate_batch, generate_fast, sampling
+
+    def boom(*a, **k):
+        raise AssertionError("mixed-prefill used on a 1-token prompt")
+
+    monkeypatch.setattr(sampling, "_mixed_prefill_decode_scan", boom)
+    prompts = [[5], [2, 6, 3]]
+    got = generate_batch(model, params, prompts, steps=4)
+    for i, p in enumerate(prompts):
+        assert got[i] == generate_fast(model, params, p, steps=4), i
+
+
+def test_mixed_prefill_pad_rows_keep_chunk(topo8, monkeypatch):
+    """Bucket pad rows (N=3 -> 4) are dummies at the shortest REAL
+    length — they must not drag the common-prefix chunk down to 1 and
+    silently lose the prefill path."""
+    model = _model()
+    params = model.init(
+        jax.random.key(0), jnp.zeros((1, T), jnp.int32)
+    )["params"]
+    from mpit_tpu.models import generate_batch, generate_fast, sampling
+
+    def boom(*a, **k):
+        raise AssertionError("pad rows dragged the batch off prefill")
+
+    monkeypatch.setattr(sampling, "_batch_decode_scan", boom)
+    prompts = [[3, 1, 4, 1], [2, 6], [7, 7, 7]]  # N=3 pads to 4
+    got = generate_batch(model, params, prompts, steps=5)
+    for i, p in enumerate(prompts):
+        assert got[i] == generate_fast(model, params, p, steps=5), i
 
 
 # --------------------------------------------------------- tensor-parallel
@@ -656,6 +736,7 @@ def _prop_setup():
     temperature=st.sampled_from([0.0, 0.7, 1.3]),
     seed=st.integers(0, 3),
 )
+@pytest.mark.slow
 def test_property_fast_equals_slow(prompt, steps, temperature, seed):
     """For ANY request in range (prompt x steps x temperature x seed,
     within max_len), the KV-cached scan and the fixed-buffer recipe
