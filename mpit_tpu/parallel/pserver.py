@@ -33,6 +33,7 @@ from a declared-dead client revives it.
 
 from __future__ import annotations
 
+import os
 import threading
 import time
 from typing import Optional, Sequence
@@ -78,10 +79,23 @@ class PServer:
         server_lr: float = 1.0,
         client_ranks: Optional[Sequence[int]] = None,
         client_timeout: Optional[float] = None,
+        ckpt_path: Optional[str] = None,
+        ckpt_every: Optional[int] = 100,
     ):
         """``client_timeout``: seconds of per-client silence before the
         watchdog declares it dead (requires ``client_ranks``); None keeps
-        the reference's wait-forever semantics."""
+        the reference's wait-forever semantics.
+
+        ``ckpt_path``: elastic recovery (SURVEY.md §5 — optional
+        do-better; the reference loses the center with the process).
+        When set, the center chunk is persisted atomically every
+        ``ckpt_every`` center updates (``None`` = only at clean
+        teardown) and at clean teardown; a server constructed with an
+        existing file RESTORES it (``self.restored``) instead of taking
+        ``center_chunk``, so a restarted server resumes where the dead
+        one left off. A shape mismatch (different model or server count)
+        fails loudly — re-chunking across topologies is a layout change,
+        not a resume."""
         self.transport = transport
         self.center = np.array(center_chunk, dtype=np.float32, copy=True)
         self.num_clients = num_clients
@@ -104,6 +118,26 @@ class PServer:
         self._stopped: set[int] = set()
         self.error: Optional[BaseException] = None
         self._lock = threading.Lock()
+        if ckpt_every is not None and ckpt_every < 1:
+            raise ValueError(
+                "ckpt_every must be >= 1 (None = persist only at teardown)"
+            )
+        self.ckpt_path = ckpt_path
+        self.ckpt_every = None if ckpt_every is None else int(ckpt_every)
+        self._updates_since_save = 0
+        self.restored = False
+        if ckpt_path is not None and os.path.exists(ckpt_path):
+            with open(ckpt_path, "rb") as f:
+                saved = np.load(f)
+            if saved.shape != self.center.shape:
+                raise ValueError(
+                    f"persisted center chunk {ckpt_path!r} has shape "
+                    f"{saved.shape}, this server owns {self.center.shape} "
+                    "— resuming across a model/server-count change is not "
+                    "supported"
+                )
+            self.center = saved.astype(np.float32, copy=True)
+            self.restored = True
 
     def start(self) -> None:
         """Recv loop; stores any exception in ``self.error`` (a daemon
@@ -145,10 +179,14 @@ class PServer:
                         np.asarray(msg.payload) - self.center
                     )
                     self.counts["push_easgd"] += 1
+                    self._updates_since_save += 1
+                self._maybe_persist()
             elif msg.tag == TAG_PUSH_DELTA:
                 with self._lock:
                     self.center += self.server_lr * np.asarray(msg.payload)
                     self.counts["push_delta"] += 1
+                    self._updates_since_save += 1
+                self._maybe_persist()
             elif msg.tag == TAG_HEARTBEAT:
                 with self._lock:
                     self.counts["heartbeat"] += 1
@@ -158,6 +196,30 @@ class PServer:
                 raise ValueError(f"pserver: unknown tag {msg.tag}")
             if watchdog:
                 self._expire(last_seen)
+        self.persist()  # clean teardown: the final center is never lost
+
+    def _maybe_persist(self) -> None:
+        if (
+            self.ckpt_path is None
+            or self.ckpt_every is None  # teardown-only mode
+            or self._updates_since_save < self.ckpt_every
+        ):
+            return
+        self.persist()
+
+    def persist(self) -> None:
+        """Atomically write the center chunk (tmp + rename — a server
+        killed mid-write leaves the previous snapshot intact). Opened
+        file handles keep ``np.save`` from appending its own ``.npy``."""
+        if self.ckpt_path is None:
+            return
+        with self._lock:
+            snap = self.center.copy()
+            self._updates_since_save = 0
+        tmp = self.ckpt_path + ".tmp"
+        with open(tmp, "wb") as f:
+            np.save(f, snap)
+        os.replace(tmp, self.ckpt_path)
 
     def _expire(self, last_seen: dict) -> None:
         now = time.monotonic()

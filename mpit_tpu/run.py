@@ -622,19 +622,20 @@ def _run_async_ps(cfg, model, opt, x_tr, y_tr, x_te, y_te, log, results):
     """The reference's literal pclient/pserver shape (BASELINE.json:7).
 
     Aux-flag support in this mode (round-1 advisor: these used to be silent
-    no-ops): ``profile_dir`` traces the whole async run; ``ckpt_dir`` writes
-    a final center checkpoint; ``log_every`` logs the per-step client losses
-    post-hoc (there is no global step during the run — clients are
-    asynchronous by design). ``resume``/``ckpt_every``/``grad_accum``
-    have no meaning here and WARN instead of silently ignoring."""
+    no-ops): ``profile_dir`` traces the whole async run; ``ckpt_dir`` makes
+    every server persist its center chunk (elastic recovery — every
+    ``ckpt_every`` updates and at teardown) plus the final msgpack center
+    checkpoint; ``resume`` restores the persisted chunks so a restarted
+    job continues from the last center; ``log_every`` logs the per-step
+    client losses post-hoc (there is no global step during the run —
+    clients are asynchronous by design). ``grad_accum`` has no meaning
+    here and WARNs instead of silently ignoring."""
     import warnings
 
     from mpit_tpu.parallel import AsyncPSTrainer
     from mpit_tpu.utils import save_checkpoint, trace
 
     for flag, on in (
-        ("resume", cfg.resume),
-        ("ckpt_every", cfg.ckpt_every),
         ("grad_accum", cfg.grad_accum > 1),
     ):
         if on:
@@ -663,6 +664,11 @@ def _run_async_ps(cfg, model, opt, x_tr, y_tr, x_te, y_te, log, results):
         alpha=alpha, tau=cfg.tau,
         transport=cfg.transport,
         client_timeout=cfg.client_timeout,
+        ckpt_dir=cfg.ckpt_dir or None,
+        # config semantics: ckpt_every=0 means "no periodic writes" —
+        # servers then persist only at teardown, never every-100 default
+        ckpt_every=cfg.ckpt_every or None,
+        resume=cfg.resume,
     )
     per_client = max(cfg.global_batch // cfg.clients, 1)
     t0 = time.perf_counter()
@@ -693,6 +699,7 @@ def _run_async_ps(cfg, model, opt, x_tr, y_tr, x_te, y_te, log, results):
         final_loss=stats["mean_final_loss"],
         server_counts=stats["server_counts"],
         dead_clients=stats["dead_clients"],
+        center_restored=stats["center_restored"],
         samples=samples,
         wall_s=wall,
         samples_per_sec=samples / wall,

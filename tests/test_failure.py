@@ -120,6 +120,98 @@ class TestWatchdog:
             )
 
 
+class TestElasticRecovery:
+    """SURVEY.md §5's optional do-better: checkpoint-restart for the PS
+    center + client rejoin. The reference loses everything with any dead
+    process."""
+
+    def test_server_persists_and_restores_center(self, tmp_path):
+        path = str(tmp_path / "center_0.npy")
+        broker = Broker(2)
+        tps = broker.transports()
+        server = PServer(
+            tps[0], np.zeros(DIM, np.float32), num_clients=1,
+            alpha=0.5, ckpt_path=path, ckpt_every=1,
+        )
+        thread = spawn_server_thread(server)
+        tps[1].send(0, TAG_PUSH_EASGD, np.ones(DIM, np.float32))
+        tps[1].send(0, TAG_STOP, None)
+        thread.join(timeout=10)
+        assert not thread.is_alive() and server.error is None
+        want = server.snapshot()
+        assert want[0] == pytest.approx(0.5)  # the elastic move landed
+
+        # a RESTARTED server on the same path resumes the persisted center
+        revived = PServer(
+            Broker(2).transports()[0], np.zeros(DIM, np.float32),
+            num_clients=1, ckpt_path=path,
+        )
+        assert revived.restored
+        np.testing.assert_array_equal(revived.snapshot(), want)
+
+        # resuming across a layout change must fail loudly, not corrupt
+        with pytest.raises(ValueError, match="shape"):
+            PServer(
+                Broker(2).transports()[0],
+                np.zeros(DIM + 1, np.float32),
+                num_clients=1, ckpt_path=path,
+            )
+
+    def test_trainer_resume_continues_from_persisted_center(self, tmp_path):
+        import jax.numpy as jnp
+        import optax
+
+        from mpit_tpu.data.synthetic import synthetic_image_classification
+        from mpit_tpu.models import MLP
+        from mpit_tpu.parallel import AsyncPSTrainer
+
+        x, y, *_ = synthetic_image_classification(
+            256, 64, (8, 8, 1), 10, seed=0
+        )
+        kw = dict(
+            num_clients=2, num_servers=2, tau=4, transport="inproc",
+            ckpt_dir=str(tmp_path), ckpt_every=1,
+        )
+        mk = lambda **extra: AsyncPSTrainer(
+            MLP(hidden=(16,), compute_dtype=jnp.float32),
+            optax.sgd(0.1), **kw, **extra,
+        )
+        _, stats = mk().train(x, y, steps=8, batch_size=32)
+        assert stats["center_restored"] is False  # nothing to restore yet
+        assert sorted(p.name for p in tmp_path.glob("center_*.npy")) == [
+            "center_0.npy", "center_1.npy"
+        ]
+        # a restarted job (same dir) picks the persisted center up
+        _, stats = mk().train(x, y, steps=8, batch_size=32)
+        assert stats["center_restored"] is True
+        # a deliberate fresh start drops the stale chunks instead
+        _, stats = mk(resume=False).train(x, y, steps=8, batch_size=32)
+        assert stats["center_restored"] is False
+
+    def test_replacement_client_rejoins_after_death(self):
+        """A REPLACEMENT client on a dead client's rank needs no state:
+        it fetches the live center, pushes, and its first message revives
+        the rank — the job ends cleanly with no dead clients."""
+        tps, server, thread = _world(2, client_timeout=1.0)
+        keeper = PClient(tps[2], [0], DIM, heartbeat_interval=0.05)
+        keeper.push_easgd(np.full(DIM, 2.0, np.float32))
+        deadline = time.monotonic() + 10
+        while 1 not in server.dead_clients and time.monotonic() < deadline:
+            time.sleep(0.02)
+        assert 1 in server.dead_clients
+        # rejoin: fresh PClient object over the dead rank's transport
+        replacement = PClient(tps[1], [0], DIM)
+        center = replacement.fetch()
+        assert center[0] == pytest.approx(1.0)  # sees keeper's live push
+        replacement.push_easgd(np.zeros(DIM, np.float32))
+        replacement.stop()
+        keeper.stop()
+        thread.join(timeout=5)
+        assert not thread.is_alive()
+        assert server.dead_clients == set()
+        assert server.counts["push_easgd"] == 2
+
+
 class TestTrainerIntegration:
     def test_training_with_watchdog_completes_cleanly(self):
         import jax.numpy as jnp
