@@ -13,6 +13,9 @@ import numpy as np
 import optax
 import pytest
 
+# integration tier — excluded from the smoke run (dp x tp x sp factorization sweeps)
+pytestmark = pytest.mark.slow
+
 import mpit_tpu
 from mpit_tpu.models.transformer import TransformerLM
 from mpit_tpu.parallel import ComposedParallelTrainer, SeqParallelTrainer

@@ -9,6 +9,10 @@ invoke it.
 import os
 import subprocess
 import sys
+import pytest
+
+# integration tier — excluded from the smoke run (end-to-end example scripts)
+pytestmark = pytest.mark.slow
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 

@@ -107,6 +107,7 @@ class TestFlashAttention:
                 rtol=tol, atol=tol,
             )
 
+    @pytest.mark.slow
     def test_training_step_matches_xla(self):
         """One SGD step of the flash-attention model equals the xla
         model's step — the kernel is trainable, not forward-only."""

@@ -1,6 +1,10 @@
 """Keep the driver entry points green: entry() compiles, dryrun runs."""
 
 import jax
+import pytest
+
+# integration tier — excluded from the smoke run (driver entry dryruns (3+ min each))
+pytestmark = pytest.mark.slow
 
 
 def test_entry_compiles_and_runs():

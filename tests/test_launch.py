@@ -6,6 +6,10 @@ itself never had)."""
 import os
 import subprocess
 import sys
+import pytest
+
+# integration tier — excluded from the smoke run (real OS-process worlds + jax.distributed)
+pytestmark = pytest.mark.slow
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 

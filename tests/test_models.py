@@ -31,6 +31,7 @@ def test_forward_shapes(name, kwargs, x_shape, out_shape):
     assert out.dtype == jnp.float32
 
 
+@pytest.mark.slow
 def test_resnet50_forward_and_param_count():
     model = get_model("resnet50", num_classes=10, compute_dtype=jnp.float32)
     x = np.zeros((1, 64, 64, 3), np.float32)
@@ -49,6 +50,7 @@ def test_unknown_model_raises():
         get_model("transformer9000")
 
 
+@pytest.mark.slow
 class TestSpaceToDepthStem:
     """The MLPerf-style stem reformulation must compute EXACTLY the
     textbook 7x7/2 conv (same kernel, float32)."""
@@ -166,6 +168,7 @@ class TestSpaceToDepthStem:
             )
 
 
+@pytest.mark.slow
 class TestRemat:
     def test_transformer_remat_same_function(self):
         import jax.numpy as jnp

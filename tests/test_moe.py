@@ -11,6 +11,9 @@ import jax.numpy as jnp
 import numpy as np
 import pytest
 
+# integration tier — excluded from the smoke run (MoE trainer equivalences)
+pytestmark = pytest.mark.slow
+
 import mpit_tpu
 from conftest import moe_dense_per_shard, run_moe_sharded
 from jax.sharding import PartitionSpec as P

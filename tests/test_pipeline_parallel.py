@@ -10,6 +10,9 @@ import jax.numpy as jnp
 import numpy as np
 import pytest
 
+# integration tier — excluded from the smoke run (schedule-trajectory equivalences)
+pytestmark = pytest.mark.slow
+
 import mpit_tpu
 from mpit_tpu.parallel.pipeline import (
     PipelineParallelTrainer,

@@ -8,6 +8,9 @@ interleavings and pytree shapes instead of hand-picked cases.
 
 import numpy as np
 import pytest
+
+# integration tier — excluded from the smoke run (hypothesis property sweeps)
+pytestmark = pytest.mark.slow
 from hypothesis import given, settings, strategies as st
 
 from mpit_tpu import native

@@ -58,6 +58,7 @@ def _run_steps(mesh_shape, steps=3):
 
 
 class TestMeshShapeInvariance:
+    @pytest.mark.slow
     def test_dp_sp_factorizations_match(self):
         ref_losses, ref_params, ref_eval = _run_steps((8, 1))
         for shape in ((2, 4), (1, 8)):

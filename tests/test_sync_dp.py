@@ -88,6 +88,7 @@ def test_grad_accumulation_matches_full_batch(topo8):
         tr3.step(st3, x, y)
 
 
+@pytest.mark.slow
 def test_sync_dp_trains_mnist(topo8, mnist):
     x_tr, y_tr, x_te, y_te = mnist
     model = LeNet(compute_dtype=jnp.float32)

@@ -70,6 +70,7 @@ class TestTensorParallel:
         assert all(s is None for s in emb.sharding.spec)
         mpit_tpu.finalize()
 
+    @pytest.mark.slow
     def test_tp_factorizations_match_each_other_and_dp(self):
         ref_losses, ref_params, ref_ev = _run_tp((8, 1))
         for shape in ((2, 4), (1, 8)):

@@ -108,6 +108,7 @@ class TestUlyssesTrainer:
         mpit_tpu.finalize()
         return losses, params
 
+    @pytest.mark.slow
     def test_ulysses_matches_ring_trajectory(self):
         """Scheme choice is pure communication: identical training."""
         ring = self._run("ring")

@@ -149,6 +149,7 @@ class TestZero:
         )
 
 
+@pytest.mark.slow
 class TestClipNorm:
     def test_clip_matches_optax_chain_on_plain_dp(self, topo8):
         """clip_norm through the chunked update == optax.clip_by_global_norm
