@@ -735,6 +735,77 @@ def bench_decode(
     }
 
 
+def bench_serve(cpu_smoke: bool = False, weights_dtype: str = None) -> dict:
+    """Continuous-batching throughput: sustained generated tokens/sec of
+    ``models.serving.Server`` draining a queue of unequal requests
+    (prompt lengths AND budgets spread) through a fixed slot count —
+    the serving metric with retirement + admission in the loop, where
+    ``--decode`` measures one static batch. Completion is by
+    construction: every generated token is host-fetched by the drain.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    from mpit_tpu.models import Server
+    from mpit_tpu.models.transformer import TransformerLM
+
+    if cpu_smoke:
+        dims = dict(vocab_size=101, num_layers=2, d_model=32,
+                    num_heads=4, max_len=64)
+        reqs = [(6 + (i * 3) % 10, 8 + (i * 5) % 12) for i in range(6)]
+        max_batch, segment, legs = 2, 8, 1
+    else:
+        dims = dict(vocab_size=10_000, num_layers=6, d_model=768,
+                    num_heads=12, max_len=512)
+        # 24 requests over 8 slots: prompts 32..128, budgets 128..320
+        reqs = [
+            (32 + (i * 13) % 97, 128 + (i * 29) % 193) for i in range(24)
+        ]
+        max_batch, segment, legs = 8, 64, 3
+    model = TransformerLM(**dims)
+    params = model.init(
+        jax.random.key(0), jnp.zeros((1, 8), jnp.int32)
+    )["params"]
+    if weights_dtype == "bf16":
+        from mpit_tpu.models.sampling import cast_weights
+
+        params = cast_weights(params, jnp.bfloat16)
+    rng = np.random.default_rng(0)
+    prompts = [
+        rng.integers(0, dims["vocab_size"], p).tolist() for p, _ in reqs
+    ]
+
+    def drain_once():
+        srv = Server(model, params, max_batch=max_batch, segment=segment)
+        for q, (_, mn) in zip(prompts, reqs):
+            srv.submit(q, mn)
+        out = srv.drain()
+        return sum(mn for _, mn in reqs), srv.segments_run, out
+
+    drain_once()  # compile + warmup (all bucket shapes)
+    leg_rates, segments = [], 0
+    for _ in range(legs):
+        t0 = time.perf_counter()
+        tokens, segments, _ = drain_once()
+        leg_rates.append(tokens / (time.perf_counter() - t0))
+    rate = float(np.median(leg_rates))
+    spread = (
+        round((max(leg_rates) - min(leg_rates)) / rate, 4)
+        if len(leg_rates) > 1 else None
+    )
+    return {
+        "tokens_per_sec": rate,
+        "spread": spread,
+        "variance_flagged": bool(spread is not None and spread > 0.10),
+        "requests": len(reqs),
+        "max_batch": max_batch,
+        "segment": segment,
+        "segments_per_drain": segments,
+        "model": "transformer-large" if not cpu_smoke else "tiny",
+        **({"weights_dtype": weights_dtype} if weights_dtype else {}),
+    }
+
+
 def bench_torch_cpu(
     batch: int = 256, steps: int = 12, target_seconds: float = 2.0
 ) -> float:
@@ -832,38 +903,68 @@ def main():
         {"input_dtype": input_dtype} if input_dtype != "float32" else {}
     )
 
-    if "--decode" in sys.argv:
-        wd = flag_arg("--weights-dtype")
-        if wd is not None and wd != "bf16":
-            print("--weights-dtype supports: bf16", file=sys.stderr)
-            raise SystemExit(2)
-        mixed = "--mixed" in sys.argv
-        with trace(profile_dir):
-            res = bench_decode(cpu_smoke=cpu, weights_dtype=wd, mixed=mixed)
-        key = "decode" + ("-bf16" if wd else "") + ("-mixed" if mixed else "")
+    def emit_tokens_metric(
+        metric, key, res, fields, opt_fields, latest_extra=()
+    ):
+        """THE reporting contract every tokens/sec bench shares
+        (--decode, --serve): variance-gated LATEST.json admission, the
+        dead-tunnel evidence trail, one JSON line. A change to the
+        recording rules lands here once."""
         if not cpu and not profile_dir and not res.get("variance_flagged"):
             update_latest_measurement(key, {
                 "tokens_per_sec": round(res["tokens_per_sec"], 1),
-                "per_token_ms": round(res["per_token_ms"], 3),
+                **{k: round(res[k], 3) for k in latest_extra},
                 **({"spread": res["spread"]}
                    if res.get("spread") is not None else {}),
-                "source": "bench.py --decode",
+                "source": f"bench.py {metric}",
             })
         last = last_tpu_measurement(key) if platform_note else None
         print(json.dumps({
-            "metric": "decode_tokens_per_sec",
+            "metric": metric,
             "value": round(res["tokens_per_sec"], 1),
             "unit": "tokens/sec/chip",
             "vs_baseline": None,  # the reference cannot sample at all
-            **{k: res[k] for k in
-               ("batch", "prompt_len", "steps", "per_token_ms", "model")},
-            **{k: res[k] for k in
-               ("weights_dtype", "spread", "mixed_prompt_lens")
-               if res.get(k) is not None},
+            **{k: res[k] for k in fields},
+            **{k: res[k] for k in opt_fields if res.get(k) is not None},
             **({"platform_note": platform_note} if platform_note else {}),
             **({"last_tpu_measurement": last} if last else {}),
             **profiled,
         }))
+
+    def weights_dtype_flag():
+        wd = flag_arg("--weights-dtype")
+        if wd is not None and wd != "bf16":
+            print("--weights-dtype supports: bf16", file=sys.stderr)
+            raise SystemExit(2)
+        return wd
+
+    if "--serve" in sys.argv:
+        wd = weights_dtype_flag()
+        with trace(profile_dir):
+            res = bench_serve(cpu_smoke=cpu, weights_dtype=wd)
+        emit_tokens_metric(
+            "serve_tokens_per_sec",
+            "serve" + ("-bf16" if wd else ""),
+            res,
+            ("requests", "max_batch", "segment", "segments_per_drain",
+             "model"),
+            ("weights_dtype", "spread"),
+        )
+        return
+
+    if "--decode" in sys.argv:
+        wd = weights_dtype_flag()
+        mixed = "--mixed" in sys.argv
+        with trace(profile_dir):
+            res = bench_decode(cpu_smoke=cpu, weights_dtype=wd, mixed=mixed)
+        emit_tokens_metric(
+            "decode_tokens_per_sec",
+            "decode" + ("-bf16" if wd else "") + ("-mixed" if mixed else ""),
+            res,
+            ("batch", "prompt_len", "steps", "per_token_ms", "model"),
+            ("weights_dtype", "spread", "mixed_prompt_lens"),
+            latest_extra=("per_token_ms",),
+        )
         return
 
     name = flag_arg("--preset")
