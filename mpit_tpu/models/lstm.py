@@ -11,10 +11,12 @@ gates ride the MXU), params float32.
 
 from __future__ import annotations
 
+import functools
 from typing import Any
 
 import flax.linen as nn
 import jax.numpy as jnp
+from jax import lax
 
 
 class LSTMLM(nn.Module):
@@ -33,5 +35,15 @@ class LSTMLM(nn.Module):
             x = nn.RNN(
                 nn.OptimizedLSTMCell(self.hidden, dtype=self.compute_dtype)
             )(x)
-        logits = nn.Dense(self.vocab_size, dtype=self.compute_dtype)(x)
+        # vocab head: operands stay in compute_dtype (MXU fast path) but
+        # ACCUMULATE in f32 — the large-vocab logits never get quantized
+        # to bf16 on the way out (the plain Dense+astype recipe computed
+        # a bf16 output first). Param tree unchanged: same Dense module,
+        # only its dot_general carries preferred_element_type.
+        logits = nn.Dense(
+            self.vocab_size, dtype=self.compute_dtype,
+            dot_general=functools.partial(
+                lax.dot_general, preferred_element_type=jnp.float32
+            ),
+        )(x)
         return logits.astype(jnp.float32)

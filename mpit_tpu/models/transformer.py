@@ -380,16 +380,30 @@ class TransformerLM(nn.Module):
         x = nn.LayerNorm(dtype=dt)(x)
         if not self.head:
             return x
-        # tied output head, genuinely in f32: Embed.attend would promote the
-        # query back to compute_dtype, quantizing large-vocab logits to bf16
-        table = embed.embedding.astype(jnp.float32)
-        return jnp.einsum("btd,vd->btv", x.astype(jnp.float32), table)
+        # tied output head: operands in compute_dtype, ACCUMULATION in f32
+        # (preferred_element_type). What must not happen is large-vocab
+        # logits quantized to bf16 on output (Embed.attend's behavior);
+        # f32 accumulation prevents that while keeping the matmul on the
+        # MXU's bf16 fast path — an f32xf32 head at GPT-2-small shapes is
+        # ~16% of forward FLOPs running at a fraction of MXU rate, which
+        # taxes exactly the MFU-ceiling preset built to prove the
+        # framework isn't the bottleneck. For compute_dtype=float32
+        # models (the equivalence-test configuration) this is bit-
+        # identical to the previous all-f32 head.
+        table = embed.embedding.astype(dt)
+        return jnp.einsum(
+            "btd,vd->btv", x, table, preferred_element_type=jnp.float32
+        )
 
     def head_logits(self, params, h):
         """The tied vocab head applied to (B, d_model) hidden rows —
-        the SAME f32 projection ``__call__`` ends with, for callers
-        that ran ``head=False`` and kept only the rows they need
-        (chunked prefill). The embed table's param path is pinned by a
-        test against a full forward."""
-        table = params["Embed_0"]["embedding"].astype(jnp.float32)
-        return jnp.einsum("bd,vd->bv", h.astype(jnp.float32), table)
+        the SAME projection ``__call__`` ends with (compute_dtype
+        operands, f32 accumulation), for callers that ran ``head=False``
+        and kept only the rows they need (chunked prefill). The embed
+        table's param path is pinned by a test against a full forward."""
+        dt = self.compute_dtype
+        table = params["Embed_0"]["embedding"].astype(dt)
+        return jnp.einsum(
+            "bd,vd->bv", h.astype(dt), table,
+            preferred_element_type=jnp.float32,
+        )
