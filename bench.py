@@ -643,8 +643,9 @@ def bench_decode(
 
     ``mixed=True`` is the realistic serving shape: prompt lengths spread
     across the batch (rows get p_len, p_len-7, p_len-13, ... down to
-    ~p_len/2), exercising the common-prefix chunked prefill instead of
-    the equal-length fast path. tokens/sec counts GENERATED tokens, and
+    ~p_len/2). Per-row cache clocks prefill every row's entire prompt in
+    the same dense pass, so this measures the same kernel as the uniform
+    run on an unequal batch. tokens/sec counts GENERATED tokens, and
     every row generates ``steps``, so the metric is comparable to the
     uniform run.
 
@@ -678,12 +679,13 @@ def bench_decode(
     rng = np.random.default_rng(0)
     if mixed:
         # spread lengths over [p_len/2, p_len]: realistic unequal prompts
-        # whose common prefix still chunks (shortest row sets the chunk)
         lens = [
             max(p_len // 2, p_len - 1 - (7 * i) % (p_len // 2 + 1))
             for i in range(nb)
         ]
-        lens[0] = p_len  # keep the scan bucket identical to the uniform run
+        # longest row at p_len keeps the prefill/scan buckets identical
+        # to the uniform run, so the two metrics compare like for like
+        lens[0] = p_len
     else:
         lens = [p_len] * nb
     prompts = [

@@ -16,15 +16,16 @@ trained LM still expect one. Two recipes, same sampling semantics:
   ``cache`` collection, ``TransformerLM.decode``) and the whole request
   runs inside one jit: the PROMPT enters the cache as a single
   matmul-bound chunk (:func:`_prefill_decode_scan`, ``head=False`` so
-  only one row pays the vocab projection), then each GENERATED token is
-  a ``lax.scan`` tick — no per-token host round-trips, one device fetch
-  at the end. Prefill/scan lengths and batch rows are bucketed to
-  powers of two so compiles stay logarithmic. Mixed-length batches fall
-  back to the all-ticks kernel (:func:`_batch_decode_scan`; short rows
-  sample sequentially inside the shared clock). Greedy output is pinned
-  equal to :func:`generate`'s; sampled output is pinned equal at the
-  same seed (every kernel indexes the same per-generated-token key
-  stream).
+  only one row per batch row pays the vocab projection), then each
+  GENERATED token is a ``lax.scan`` tick — no per-token host
+  round-trips, one device fetch at the end. Cache position clocks are
+  PER ROW, so mixed-length batches prefill every row's entire prompt
+  in the same dense pass and every tick is pure sampling — the one
+  kernel serves equal and unequal prompts alike. Prefill/scan lengths
+  and batch rows are bucketed to powers of two so compiles stay
+  logarithmic. Greedy output is pinned equal to :func:`generate`'s;
+  sampled output is pinned equal at the same seed (every kernel
+  indexes the same per-generated-token key stream).
 """
 
 from __future__ import annotations
@@ -248,10 +249,9 @@ def _decode_setup(model, prompt, steps):
             f"prompt+steps = {total} exceeds max_len={model.max_len}; "
             "the KV cache cannot slide — use generate() for overflow"
         )
-    dec = model.clone(
+    return model.clone(
         decode=True, remat=False, seq_axis=None, attn_impl="xla"
     )
-    return dec, total
 
 
 @functools.partial(jax.jit, static_argnums=(0, 1, 2, 3, 4))
@@ -404,7 +404,7 @@ def beam_search(
         return [int(t) for t in prompt], 0.0
     if weights_dtype is not None:
         params = cast_weights(params, weights_dtype)
-    dec, _ = _decode_setup(model, prompt, steps)
+    dec = _decode_setup(model, prompt, steps)
     p0 = len(prompt)
     pre_bucket = _bucket(p0, model.max_len)
     gen_bucket = _bucket(steps, model.max_len)
@@ -430,13 +430,19 @@ def _fix_cache_indices(cache, p_len):
     and the slots in ``[p_len, bucket)`` hold padding garbage. Decode
     resumes at ``p_len`` and overwrites slot ``i`` in the same tick
     whose mask first exposes it (``j <= i``), so the garbage is never
-    attended — pinned by the fast==slow equality tests."""
+    attended — pinned by the fast==slow equality tests.
+
+    Counter leaves are per-row (B,); ``p_len`` may be a scalar (every
+    row at the same position) or a (B,) vector (per-row prefill — each
+    row's clock lands at ITS OWN prompt length)."""
     import jax.tree_util as jtu
 
     def fix(path, leaf):
         name = getattr(path[-1], "key", None) if path else None
         if name in ("cache_index", "pos_index"):
-            return jnp.asarray(p_len, leaf.dtype)
+            return jnp.broadcast_to(
+                jnp.asarray(p_len, leaf.dtype), leaf.shape
+            )
         return leaf
 
     return jtu.tree_map_with_path(fix, cache)
@@ -463,35 +469,40 @@ def _sample_rows(logits, row_keys, greedy, top_k, use_top_p, temp, top_p):
 @functools.partial(jax.jit, static_argnums=(0, 1, 2, 3, 4, 5))
 def _prefill_decode_scan(
     model, pre_bucket, gen_len, greedy, top_k, use_top_p,
-    params, cache0, pre_buf, p_len, keys, temp, top_p,
+    params, cache0, pre_buf, p_lens, keys, temp, top_p,
 ):
-    """Chunked-prefill decoding for rows sharing ONE prompt length: the
-    whole prompt enters the cache as a single dense pass (matmul-bound
-    — one chunk instead of p_len latency-bound ticks), then only the
-    GENERATED tokens run as scan ticks.
+    """Chunked-prefill decoding, per-row clocks: EVERY row's ENTIRE
+    prompt enters the cache in one dense pass (matmul-bound — one chunk
+    instead of p_len latency-bound ticks), each row's position counters
+    land at its OWN ``p_lens[n]``, and then every scan tick is pure
+    sampling for every row — ticks == gen_len, the minimum any shared
+    program can spend, for equal AND mixed prompt lengths alike (the
+    equal-length batch is just the all-rows-equal special case).
 
     ``pre_buf`` is (N, pre_bucket) — prompts left-aligned, padding
-    arbitrary; the padded rows' cache writes and counter over-advance
-    are undone by :func:`_fix_cache_indices`. The prefill pass runs the
-    model with ``head=False`` and projects ONE hidden row through the
-    vocab head — never materializing (N, pre_bucket, V) f32 logits.
-    Token j is sampled with ``keys[:, j]`` — the identical
-    per-generated-token stream the tick kernel uses, which is what
-    keeps this a pure optimization (pinned fast==slow and prefill==tick
-    across the suite). ``keys`` is pre-padded to exactly ``gen_len``
-    columns by the caller.
+    arbitrary; padded rows' cache writes and counter over-advance are
+    undone by :func:`_fix_cache_indices` (vector ``p_lens``). The
+    prefill pass runs the model with ``head=False`` and projects ONE
+    hidden row per batch row through the vocab head (each row's own
+    ``p_lens[n]-1`` position) — never materializing (N, pre_bucket, V)
+    f32 logits. Token j of every row is sampled with ``keys[:, j]`` —
+    the per-generated-token stream contract that pins every batched row
+    equal to its solo :func:`generate_fast` call. ``keys`` is
+    pre-padded to exactly ``gen_len`` columns by the caller.
 
-    Bucket-overrun ticks (t >= steps) may clamp their cache writes and
-    position gathers at the max_len boundary: safe because (a) they
-    strictly FOLLOW the last kept sample in the sequential scan, and
-    (b) the cache dies with this call — nothing ever reads it after
-    the scan. Reusing the returned cache would break invariant (b).
+    Bucket-overrun ticks (t >= a row's remaining budget) may clamp
+    their cache writes and position gathers at the max_len boundary:
+    safe because (a) they strictly FOLLOW the last kept sample in the
+    sequential scan, and (b) the cache dies with this call — nothing
+    ever reads it after the scan. Reusing the returned cache would
+    break invariant (b).
     """
     hidden, mut = model.clone(head=False).apply(
         {"params": params, "cache": cache0}, pre_buf, mutable=["cache"]
     )
-    cache = _fix_cache_indices(mut["cache"], p_len)
-    h_last = jax.vmap(lambda h: h[p_len - 1])(hidden)  # (N, d)
+    cache = _fix_cache_indices(mut["cache"], p_lens)
+    # each row's last PROMPT hidden state — at its own position
+    h_last = jax.vmap(lambda h, n: h[n - 1])(hidden, p_lens)  # (N, d)
     last = model.head_logits(params, h_last)  # (N, V)
 
     tok0 = _sample_rows(
@@ -520,124 +531,6 @@ def _prefill_decode_scan(
     return tok0[:, None]
 
 
-
-@functools.partial(jax.jit, static_argnums=(0, 1, 2, 3, 4, 5))
-def _mixed_prefill_decode_scan(
-    model, chunk, scan_len, greedy, top_k, use_top_p,
-    params, cache0, buf, p_lens, keys, temp, top_p,
-):
-    """Chunked prefill for MIXED prompt lengths: the shared position
-    clock (tick t IS position t for every row — the cache index is a
-    scalar) means no row can prefill past another row's sampling
-    frontier, but every row's first ``chunk`` positions are prompt
-    (``chunk <= min(p_lens)``), so that prefix enters the cache as ONE
-    dense matmul-bound pass and the per-tick kernel resumes at
-    ``t = chunk``. The realistic serving case (similar-but-unequal
-    prompts) thus keeps most of the prompt on the prefill path instead
-    of falling back to all-ticks (VERDICT r3 missing-item 5).
-
-    ``chunk`` is an exact power of two <= min(p_lens), chosen by the
-    caller: the dense pass needs NO padding (cache counters land at
-    exactly ``chunk``; no :func:`_fix_cache_indices` fix-up) and the
-    compiled-program diversity stays log-bounded in (chunk, scan_len).
-
-    Rows whose whole prompt was chunked (``p_lens == chunk``) sample
-    their first token from the chunk's last logits with ``keys[:, 0]``
-    — the identical key the tick kernel would have used at
-    ``t = p_len - 1`` (j = 0), which keeps every row pinned equal to
-    its :func:`generate_fast` solo call. Longer rows ignore ``tok0``:
-    the scan's ``t < p_lens`` select feeds their remaining prompt
-    tokens until their own frontier.
-    """
-    hidden, mut = model.clone(head=False).apply(
-        {"params": params, "cache": cache0},
-        buf[:, :chunk],
-        mutable=["cache"],
-    )
-    last = model.head_logits(params, hidden[:, -1])  # logits at chunk-1
-    row_keys0 = jax.vmap(lambda ks: ks[0])(keys)
-    tok0 = _sample_rows(
-        last, row_keys0, greedy, top_k, use_top_p, temp, top_p
-    )
-
-    def step(carry, t):
-        cache, prev = carry
-        tok = jnp.where(t < p_lens, buf[:, t], prev)
-        logits, mut = model.apply(
-            {"params": params, "cache": cache},
-            tok[:, None],
-            mutable=["cache"],
-        )
-        j = jnp.clip(t - (p_lens - 1), 0, keys.shape[1] - 1)
-        row_keys = jax.vmap(lambda ks, i: ks[i])(keys, j)
-        nxt = _sample_rows(
-            logits[:, 0], row_keys, greedy, top_k, use_top_p, temp, top_p
-        )
-        return (mut["cache"], nxt), nxt
-
-    (_, _), nxt = jax.lax.scan(
-        step, (mut["cache"], tok0), jnp.arange(chunk, scan_len)
-    )
-    nxt = nxt.swapaxes(0, 1)  # (N, scan_len - chunk)
-    # assemble the full (N, scan_len + 1) token matrix: positions
-    # [1, chunk) are prompt for every row; position chunk is prompt for
-    # longer rows, else the chunk-sampled tok0; beyond that, prompt
-    # until each row's own p_len, then the scan's samples
-    mid = jnp.where(chunk < p_lens, buf[:, chunk], tok0)[:, None]
-    tail_pos = jnp.arange(chunk + 1, scan_len + 1)[None, :]
-    tail = jnp.where(tail_pos < p_lens[:, None], buf[:, chunk + 1:], nxt)
-    return jnp.concatenate(
-        [buf[:, : chunk], mid, tail], axis=1
-    )
-
-
-@functools.partial(jax.jit, static_argnums=(0, 1, 2, 3, 4))
-def _batch_decode_scan(
-    model, scan_len, greedy, top_k, use_top_p,
-    params, cache0, buf, p_lens, keys, temp, top_p,
-):
-    """N sequences through one compiled decode scan.
-
-    Rows share the position clock (tick t IS sequence position t for
-    every row — the cache index and positional embedding are scalars),
-    but each row transitions from prompt-feeding to sampling at its OWN
-    ``p_lens[n]``: at tick t row n feeds its prompt token while
-    ``t < p_lens[n]`` and its previous sample after. Each row draws
-    from its own key stream; generate_fast IS the N=1 case and
-    generate_batch folds the row index into the rng, which is what pins
-    each batched row equal to a single-row call. top_k must be static
-    (lax.top_k shape); top_p rides traced behind the static use_top_p
-    gate so a nucleus sweep reuses one compiled program.
-    """
-
-    def step(carry, t):
-        cache, prev = carry  # prev: (N,)
-        tok = jnp.where(t < p_lens, buf[:, t], prev)
-        logits, mut = model.apply(
-            {"params": params, "cache": cache},
-            tok[:, None],
-            mutable=["cache"],
-        )
-        logits = logits[:, 0]  # (N, V)
-        # per-row key index: generated token j of row n uses its own
-        # keys[n, j]; the clip keeps bucket-overrun ticks (discarded)
-        # in bounds
-        j = jnp.clip(t - (p_lens - 1), 0, keys.shape[1] - 1)
-        row_keys = jax.vmap(lambda ks, i: ks[i])(keys, j)
-        nxt = _sample_rows(
-            logits, row_keys, greedy, top_k, use_top_p, temp, top_p
-        )
-        return (mut["cache"], nxt), nxt
-
-    (_, _), nxt = jax.lax.scan(
-        step, (cache0, buf[:, 0]), jnp.arange(scan_len)
-    )
-    nxt = nxt.swapaxes(0, 1)  # (N, scan_len)
-    pos = jnp.arange(1, scan_len + 1)[None, :]
-    out = jnp.where(pos < p_lens[:, None], buf[:, 1:], nxt)
-    return jnp.concatenate([buf[:, :1], out], axis=1)
-
-
 def generate_batch(
     model,
     params,
@@ -655,12 +548,11 @@ def generate_batch(
     decode scan over a (N, ...) K/V cache — the batched serving path.
 
     Row ``n`` is pinned exactly equal to
-    ``generate_fast(..., prompts[n], rng=fold_in(rng, n))``: rows share
-    the position clock but transition from prompt to sampling at their
-    own lengths, and each draws from its own per-row key stream. Same
-    model restrictions as :func:`generate_fast`; the scan runs to the
-    LONGEST prompt's budget (shorter rows' overrun samples are computed
-    and discarded — batched serving's usual padding cost).
+    ``generate_fast(..., prompts[n], rng=fold_in(rng, n))``: per-row
+    cache clocks prefill every row's ENTIRE prompt in one dense pass
+    (equal or mixed lengths), each row draws from its own key stream,
+    and the scan spends exactly bucket(steps) sampling ticks. Same
+    model restrictions as :func:`generate_fast`.
     """
     return _batch_impl(
         model, params, prompts, steps, temperature, seed, rng,
@@ -747,33 +639,27 @@ def _generate_rows(
     model, params, prompts, steps, temperature, rngs, top_k, top_p,
     cache_sharding_fn=None, key_streams=None,
 ):
-    """The ONE wrapper both serving entry points share: bucket the scan
-    length (power-of-two, capped at max_len) AND the row count
-    (power-of-two — every distinct N would otherwise compile its own
-    program; pad rows are dummy prompts whose outputs are sliced away),
-    build the token buffer host-side in one transfer, split each row's
-    key stream from its own rng (values identical to a per-row
-    ``split(rng_n, steps)``), pad keys to the bucket, run the kernel,
-    and slice each row to its own prompt+steps.
+    """The ONE wrapper both serving entry points share: bucket the
+    prefill and generation lengths (power-of-two, capped at max_len)
+    AND the row count (power-of-two — every distinct N would otherwise
+    compile its own program; pad rows are dummy prompts whose outputs
+    are sliced away), build the token buffer host-side in one transfer,
+    split each row's key stream from its own rng (values identical to a
+    per-row ``split(rng_n, steps)``), pad keys to the bucket, run the
+    kernel, and slice each row to its own prompt+steps.
 
-    Kernel choice: when every row shares ONE prompt length, the whole
-    prompt enters the cache as a single chunked-prefill pass
-    (:func:`_prefill_decode_scan` — matmul-bound, p_len ticks saved);
-    mixed lengths chunk their COMMON PREFIX — the largest power of two
-    <= the shortest prompt — and tick from there
-    (:func:`_mixed_prefill_decode_scan`), because a short row's tokens
-    beyond its own prompt are sequentially sampled and cap every
-    longer row's chunkable prefix at the shared clock. Only a
-    degenerate shortest prompt (1 token) falls back to the all-ticks
-    kernel (:func:`_batch_decode_scan`)."""
+    ONE kernel for every batch shape (:func:`_prefill_decode_scan`):
+    per-row cache clocks let each row's ENTIRE prompt prefill in the
+    single dense pass — equal and mixed lengths alike — so the scan
+    spends exactly bucket(steps) latency-bound ticks, all of them
+    sampling."""
     import numpy as np
 
     if isinstance(rngs, (list, tuple)):
         rngs = jnp.stack(list(rngs))
     n = len(prompts)
     longest = max(prompts, key=len)
-    dec, total = _decode_setup(model, longest, steps)
-    scan_len = _bucket(total - 1, model.max_len)
+    dec = _decode_setup(model, longest, steps)
     nb = _bucket(n, 1 << 30)  # rows have no cap — pad rows are sliced away
     greedy = temperature == 0.0
     temp = jnp.asarray(max(temperature, 1e-9), jnp.float32)
@@ -809,57 +695,23 @@ def _generate_rows(
         )
 
     cache0 = _zero_cache(dec, nb, sharding_fn=cache_sharding_fn)
-    p0 = len(prompts[0])
-    if all(len(q) == p0 for q in prompts):
-        pre_bucket = _bucket(p0, model.max_len)
-        gen_bucket = _bucket(steps, model.max_len)
-        pre_host = np.zeros((nb, pre_bucket), np.int32)
-        for i, q in enumerate(prompts):
-            pre_host[i] = (list(q) + [0] * pre_bucket)[:pre_bucket]
-        gen = _prefill_decode_scan(
-            dec, pre_bucket, gen_bucket, greedy, top_k,
-            top_p is not None,
-            params, cache0, jnp.asarray(pre_host),
-            jnp.asarray(p0, jnp.int32), pad_keys(gen_bucket), temp,
-            tp_val,
-        )
-        host = jax.device_get(gen)
-        return [
-            [int(t) for t in prompts[i]] + [
-                int(t) for t in host[i, :steps]
-            ]
-            for i in range(n)
-        ]
-    buf_host = np.zeros((nb, scan_len + 1), np.int32)
+    pre_bucket = _bucket(len(longest), model.max_len)
+    gen_bucket = _bucket(steps, model.max_len)
+    pre_host = np.zeros((nb, pre_bucket), np.int32)
     for i, q in enumerate(prompts):
-        buf_host[i, : len(q)] = q
-    real_min = min(len(q) for q in prompts)
-    # pad rows are DISCARDED dummy prompts — give them the shortest real
-    # length (all-zero tokens), not length 1, so they never drag the
-    # common-prefix chunk below what the real rows allow
-    p_lens = np.full((nb,), real_min, np.int32)
+        pre_host[i, : len(q)] = q
+    # pad rows are DISCARDED 1-token dummy prompts (any length works
+    # under per-row clocks; their outputs are sliced away)
+    p_lens = np.ones((nb,), np.int32)
     p_lens[:n] = [len(q) for q in prompts]
-    # mixed lengths still chunk their COMMON PREFIX (every row's first
-    # min(p_lens) positions are prompt): largest power of two <= the
-    # shortest prompt — exact, so the dense pass needs no padding and
-    # program diversity stays log-bounded
-    min_p = int(p_lens.min())
-    chunk = 1 << (min_p.bit_length() - 1)
-    if chunk >= 2:
-        toks = _mixed_prefill_decode_scan(
-            dec, chunk, scan_len, greedy, top_k, top_p is not None,
-            params, cache0, jnp.asarray(buf_host),
-            jnp.asarray(p_lens), pad_keys(scan_len), temp, tp_val,
-        )
-    else:
-        toks = _batch_decode_scan(
-            dec, scan_len, greedy, top_k, top_p is not None,
-            params, cache0, jnp.asarray(buf_host),
-            jnp.asarray(p_lens), pad_keys(scan_len), temp, tp_val,
-        )
-    host = jax.device_get(toks)
+    gen = _prefill_decode_scan(
+        dec, pre_bucket, gen_bucket, greedy, top_k, top_p is not None,
+        params, cache0, jnp.asarray(pre_host), jnp.asarray(p_lens),
+        pad_keys(gen_bucket), temp, tp_val,
+    )
+    host = jax.device_get(gen)
     return [
-        [int(t) for t in host[i, : len(prompts[i]) + steps]]
+        [int(t) for t in prompts[i]] + [int(t) for t in host[i, :steps]]
         for i in range(n)
     ]
 
@@ -888,7 +740,7 @@ def generate_tp(
     (:func:`mpit_tpu.parallel.tensor.tp_state_specs` — column/row split
     Dense kernels), the K/V caches commit head-sharded over ``tp``, and
     XLA's partitioner inserts the per-token psums when it compiles
-    :func:`_batch_decode_scan` for the committed layouts. Same kernel,
+    :func:`_prefill_decode_scan` for the committed layouts. Same kernel,
     same key streams as :func:`generate_batch` — token-identical up to
     partitioned-reduction numerics (row-sharded matmuls accumulate via
     psum in a different order, so a near-tie argmax can flip in the

@@ -114,6 +114,15 @@ class Block(nn.Module):
         generation loop compiles once per bucket
         (sampling.generate_fast).
 
+        ``cache_index`` is PER ROW, shape (B,): each batch row carries
+        its own position clock, so a mixed-length batch prefills every
+        row's ENTIRE prompt in one dense pass and ticks from there
+        (sampling's batched kernel) — rows no longer share a scalar
+        frontier. The K/V append becomes a per-row dynamic_update_slice
+        (vmapped) and the causal mask compares against each row's own
+        index; with all rows' indices equal this is exactly the old
+        shared-clock behavior.
+
         Numerics match :func:`dense_attention`: f32 scores/softmax/
         accumulation, inputs left in compute dtype for the einsums.
         """
@@ -141,15 +150,15 @@ class Block(nn.Module):
         )
         idx = self.variable(
             "cache", "cache_index",
-            lambda: jnp.zeros((), jnp.int32),
+            lambda: jnp.zeros((b,), jnp.int32),
         )
-        i = idx.value
-        key_cache = jax.lax.dynamic_update_slice(
-            ck.value, k, (0, i, 0, 0)
+        i = idx.value  # (b,) per-row position clocks
+        row_update = jax.vmap(
+            lambda cache_row, chunk_row, start:
+            jax.lax.dynamic_update_slice(cache_row, chunk_row, (start, 0, 0))
         )
-        val_cache = jax.lax.dynamic_update_slice(
-            cv.value, v, (0, i, 0, 0)
-        )
+        key_cache = row_update(ck.value, k, i)
+        val_cache = row_update(cv.value, v, i)
         if ready:
             ck.value, cv.value = key_cache, val_cache
             idx.value = i + t
@@ -157,12 +166,12 @@ class Block(nn.Module):
             "bqhd,bkhd->bhqk", q, key_cache,
             preferred_element_type=jnp.float32,
         ) / (d ** 0.5)
-        # row r may see cache positions <= i + r
+        # row r of batch row n may see cache positions <= i[n] + r
         mask = (
-            jnp.arange(self.decode_len)[None, :]
-            <= i + jnp.arange(t)[:, None]
-        )
-        s = jnp.where(mask[None, None], s, -jnp.inf)
+            jnp.arange(self.decode_len)[None, None, :]
+            <= i[:, None, None] + jnp.arange(t)[None, :, None]
+        )  # (b, t, L)
+        s = jnp.where(mask[:, None], s, -jnp.inf)
         p = jax.nn.softmax(s, axis=-1)
         return jnp.einsum(
             "bhqk,bkhd->bqhd", p, val_cache,
@@ -336,13 +345,14 @@ class TransformerLM(nn.Module):
                 raise ValueError("decode mode requires seq_axis=None")
             # the LM's own position counter (each block keeps its own
             # cache_index; this one feeds the positional embedding) —
-            # same create-before-mutate discipline as Block's cache
+            # same create-before-mutate discipline as Block's cache, and
+            # PER ROW like cache_index (each batch row at its own position)
             ready = self.has_variable("cache", "pos_index")
             pidx = self.variable(
                 "cache", "pos_index",
-                lambda: jnp.zeros((), jnp.int32),
+                lambda: jnp.zeros((tokens.shape[0],), jnp.int32),
             )
-            offset = pidx.value
+            offset = pidx.value  # (B,)
             if ready:
                 pidx.value = offset + t_local
             total_len = 1  # bounds are the caller's contract in decode
@@ -355,7 +365,9 @@ class TransformerLM(nn.Module):
             raise ValueError(
                 f"sequence of {total_len} exceeds max_len={self.max_len}"
             )
-        pos = offset + jnp.arange(t_local)
+        # scalar offset -> (t,) positions; per-row decode offset (B,) ->
+        # (B, t) positions — the table gather broadcasts either way
+        pos = jnp.asarray(offset)[..., None] + jnp.arange(t_local)
         x = embed(tokens) + pos_table[pos].astype(dt)
         # explicit names: nn.remat renames the wrapped class (Checkpoint
         # Block), which would fork the param tree between remat modes

@@ -478,53 +478,53 @@ def test_batch_edge_cases(topo8):
 
 def test_batch_size_bucketing_shares_programs(topo8):
     """Row counts bucket to powers of two: N=3 and N=4 share one
-    compiled program (pad rows are discarded)."""
+    compiled program (pad rows are discarded) — and mixed-length
+    batches run the SAME per-row-prefill kernel as uniform ones (no
+    separate all-ticks program to compile)."""
     model = _model()
     params = model.init(
         jax.random.key(0), jnp.zeros((1, T), jnp.int32)
     )["params"]
     from mpit_tpu.models import generate_batch, sampling
 
-    # uniform-length prompts route through the prefill kernel; N=3 and
-    # N=4 share its bucket
     generate_batch(model, params, [[1]] * 4, steps=4)
     n0 = sampling._prefill_decode_scan._cache_size()
     out3 = generate_batch(model, params, [[1], [2], [3]], steps=4)
     assert sampling._prefill_decode_scan._cache_size() == n0
     assert len(out3) == 3 and all(len(r) == 5 for r in out3)
-    # mixed lengths with a 1-token shortest prompt fall back to the
-    # per-tick kernel; N buckets there too
+    # mixed lengths share the kernel too: same buckets as a UNIFORM
+    # batch at the longest prompt's bucket -> NO new compile
+    generate_batch(model, params, [[1, 2]] * 4, steps=4)
+    n1 = sampling._prefill_decode_scan._cache_size()
     generate_batch(model, params, [[1], [2, 3], [4], [5, 6]], steps=4)
-    n1 = sampling._batch_decode_scan._cache_size()
-    generate_batch(model, params, [[1], [2, 3], [4]], steps=4)
-    assert sampling._batch_decode_scan._cache_size() == n1
+    assert sampling._prefill_decode_scan._cache_size() == n1
 
 
-def test_mixed_prefill_common_prefix(topo8, monkeypatch):
-    """Mixed-length batches keep the matmul-bound prompt path: the
-    common prefix (largest power of two <= the shortest prompt) enters
-    the cache as one dense pass, the all-ticks kernel never runs
-    (path pin), and every row stays equal to its solo generate_fast
-    call — greedy and sampled with filters."""
+def test_mixed_lengths_prefill_per_row(topo8):
+    """Per-row cache clocks: every row of a mixed-length batch prefills
+    its ENTIRE prompt in the dense pass and stays bit-equal to its solo
+    generate_fast call — greedy and sampled with filters, including
+    1-token prompts and bucket pad rows (N=3 pads to 4 with dummy rows
+    at the shortest real length)."""
     model = _model()
     params = model.init(
         jax.random.key(0), jnp.zeros((1, T), jnp.int32)
     )["params"]
-    from mpit_tpu.models import generate_batch, generate_fast, sampling
+    from mpit_tpu.models import generate_batch, generate_fast
 
-    prompts = [[3, 1, 4, 1, 5], [2, 6], [7, 7, 7]]  # lens 5,2,3 -> chunk 2
-
-    def boom(*a, **k):
-        raise AssertionError(
-            "all-ticks fallback used for a chunkable mixed batch"
-        )
-
-    monkeypatch.setattr(sampling, "_batch_decode_scan", boom)
-    got = generate_batch(model, params, prompts, steps=6)
-    for i, p in enumerate(prompts):
-        assert got[i] == generate_fast(model, params, p, steps=6), i
+    for prompts, steps in [
+        ([[3, 1, 4, 1, 5], [2, 6], [7, 7, 7]], 6),   # mixed, N pads to 4
+        ([[5], [2, 6, 3]], 4),                       # 1-token shortest
+        ([[3, 1, 4, 1], [2, 6], [7, 7, 7]], 5),      # pad-row case
+    ]:
+        got = generate_batch(model, params, prompts, steps=steps)
+        for i, p in enumerate(prompts):
+            assert got[i] == generate_fast(model, params, p, steps), (
+                prompts, i
+            )
 
     rng = jax.random.key(7)
+    prompts = [[3, 1, 4, 1, 5], [2, 6], [7, 7, 7]]
     got = generate_batch(
         model, params, prompts, steps=6, temperature=0.8, rng=rng,
         top_k=5,
@@ -535,46 +535,6 @@ def test_mixed_prefill_common_prefix(topo8, monkeypatch):
             rng=jax.random.fold_in(rng, i), top_k=5,
         )
         assert got[i] == want, i
-
-
-def test_mixed_prefill_degenerate_falls_back(topo8, monkeypatch):
-    """A 1-token shortest prompt has no chunkable prefix (chunk would
-    be 1 tick — not worth a second program): the mixed-prefill kernel
-    must NOT run; the per-tick kernel handles the batch."""
-    model = _model()
-    params = model.init(
-        jax.random.key(0), jnp.zeros((1, T), jnp.int32)
-    )["params"]
-    from mpit_tpu.models import generate_batch, generate_fast, sampling
-
-    def boom(*a, **k):
-        raise AssertionError("mixed-prefill used on a 1-token prompt")
-
-    monkeypatch.setattr(sampling, "_mixed_prefill_decode_scan", boom)
-    prompts = [[5], [2, 6, 3]]
-    got = generate_batch(model, params, prompts, steps=4)
-    for i, p in enumerate(prompts):
-        assert got[i] == generate_fast(model, params, p, steps=4), i
-
-
-def test_mixed_prefill_pad_rows_keep_chunk(topo8, monkeypatch):
-    """Bucket pad rows (N=3 -> 4) are dummies at the shortest REAL
-    length — they must not drag the common-prefix chunk down to 1 and
-    silently lose the prefill path."""
-    model = _model()
-    params = model.init(
-        jax.random.key(0), jnp.zeros((1, T), jnp.int32)
-    )["params"]
-    from mpit_tpu.models import generate_batch, generate_fast, sampling
-
-    def boom(*a, **k):
-        raise AssertionError("pad rows dragged the batch off prefill")
-
-    monkeypatch.setattr(sampling, "_batch_decode_scan", boom)
-    prompts = [[3, 1, 4, 1], [2, 6], [7, 7, 7]]  # N=3 pads to 4
-    got = generate_batch(model, params, prompts, steps=5)
-    for i, p in enumerate(prompts):
-        assert got[i] == generate_fast(model, params, p, steps=5), i
 
 
 # --------------------------------------------------------- tensor-parallel
