@@ -21,6 +21,7 @@ from mpit_tpu.models.sampling import (  # noqa: F401
     generate_fast,
     generate_tp,
 )
+from mpit_tpu.models.rnn_sampling import generate_rnn  # noqa: F401
 from mpit_tpu.models.serving import Server  # noqa: F401
 
 _REGISTRY = {"lenet": LeNet, "mlp": MLP}

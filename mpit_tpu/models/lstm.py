@@ -7,14 +7,24 @@ whole unroll is one compiled loop — no per-timestep dispatch) → tied-size
 projection to the vocab. Takes (B, T) int tokens, returns (B, T, V) float32
 logits for next-token prediction; compute in bfloat16 (the matmul-heavy
 gates ride the MXU), params float32.
+
+Serving: ``decode=True`` is the RNN analogue of the transformer's KV-cache
+mode — the per-layer LSTM carries persist in the ``cache`` variable
+collection, so the prompt enters in ONE compiled RNN pass (per-row
+``seq_lengths``: each row's carry freezes at its own prompt length — the
+RNN-native equivalent of per-row cache clocks) and each generated token is
+a single-step call. Params are IDENTICAL between modes (the cells and the
+head are the same submodules), so a trained checkpoint serves directly —
+:func:`mpit_tpu.models.rnn_sampling.generate_rnn`.
 """
 
 from __future__ import annotations
 
 import functools
-from typing import Any
+from typing import Any, Optional
 
 import flax.linen as nn
+import jax
 import jax.numpy as jnp
 from jax import lax
 
@@ -25,16 +35,50 @@ class LSTMLM(nn.Module):
     hidden: int = 512
     num_layers: int = 2
     compute_dtype: Any = jnp.bfloat16
+    # serving mode: carries live in the "cache" collection and survive
+    # across calls (prefill chunk, then one-token ticks)
+    decode: bool = False
+    # head=False returns the top layer's hidden states (B, T, H) — the
+    # decode prefill projects ONE row per batch row through the vocab
+    # head (head_logits) instead of materializing (B, T, V) f32 logits
+    head: bool = True
 
     @nn.compact
-    def __call__(self, tokens):
-        x = nn.Embed(
-            self.vocab_size, self.embed_dim, dtype=self.compute_dtype
-        )(tokens)
-        for _ in range(self.num_layers):
-            x = nn.RNN(
-                nn.OptimizedLSTMCell(self.hidden, dtype=self.compute_dtype)
-            )(x)
+    def __call__(self, tokens, seq_lengths: Optional[jax.Array] = None):
+        """``seq_lengths`` (decode prefill only): per-row true prompt
+        lengths — carries freeze beyond each row's own length, so a
+        padded (B, bucket) prompt buffer yields the carry of the TRUE
+        prompt per row."""
+        if seq_lengths is not None and not self.decode:
+            raise ValueError("seq_lengths is a decode-mode argument")
+        dt = self.compute_dtype
+        x = nn.Embed(self.vocab_size, self.embed_dim, dtype=dt)(tokens)
+        for i in range(self.num_layers):
+            cell = nn.OptimizedLSTMCell(self.hidden, dtype=dt)
+            if not self.decode:
+                x = nn.RNN(cell)(x)
+                continue
+            # decode: resume from the stored carry; create-before-mutate
+            # like the transformer cache (init must not leak a post-step
+            # carry into the initial state)
+            ready = self.has_variable("cache", f"carry_{i}")
+            var = self.variable(
+                "cache", f"carry_{i}",
+                lambda: cell.initialize_carry(
+                    jax.random.key(0), x[:, 0].shape
+                ),
+            )
+            carry, x = nn.RNN(cell)(
+                x, initial_carry=var.value, return_carry=True,
+                seq_lengths=seq_lengths,
+            )
+            if ready:
+                var.value = carry
+        if not self.head:
+            return x
+        return self._head(x)
+
+    def _head(self, x):
         # vocab head: operands stay in compute_dtype (MXU fast path) but
         # ACCUMULATE in f32 — the large-vocab logits never get quantized
         # to bf16 on the way out (the plain Dense+astype recipe computed
@@ -47,3 +91,21 @@ class LSTMLM(nn.Module):
             ),
         )(x)
         return logits.astype(jnp.float32)
+
+    def head_logits(self, params, h):
+        """The vocab head applied to (B, H) hidden rows — the SAME
+        projection ``__call__`` ends with (compute-dtype operands, f32
+        accumulation), for decode prefill callers that ran ``head=False``
+        and kept only each row's last prompt position."""
+        dt = self.compute_dtype
+        kernel = params["Dense_0"]["kernel"].astype(dt)
+        # bias quantized to compute_dtype BEFORE the add — exactly what
+        # flax Dense's promote_dtype does, so prefill logits match the
+        # tick path bit for bit (a f32 bias here would shift near-tie
+        # argmaxes on the default bf16 model)
+        bias = params["Dense_0"]["bias"].astype(dt)
+        out = lax.dot_general(
+            h.astype(dt), kernel, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        return out + bias

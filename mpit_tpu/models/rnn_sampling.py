@@ -1,0 +1,144 @@
+"""Autoregressive decoding for :class:`~mpit_tpu.models.lstm.LSTMLM`.
+
+The RNN analogue of the transformer serving path
+(:mod:`mpit_tpu.models.sampling`): the reference's PTB LSTM (BASELINE
+config 5) is a headline training family, and a trained LM deserves a
+sampling tier. Same architecture as the transformer kernel, with the
+carry replacing the KV cache:
+
+- the PROMPT enters in ONE compiled ``nn.RNN`` pass (matmul-bound; the
+  per-layer carries land at each row's OWN prompt length via
+  ``seq_lengths`` — the RNN-native equivalent of per-row cache clocks,
+  so mixed-length batches prefill fully too);
+- each GENERATED token is a one-step carry update inside a ``lax.scan``
+  — O(H²) per token, no re-reading the history;
+- prompt/generation lengths and batch rows bucket to powers of two
+  (compiles stay logarithmic), token j of every row samples with key j
+  of that row's own stream (``fold_in(rng, row)``) — the same
+  per-generated-token contract that pins every batched row equal to a
+  solo call, and both pinned equal to the full-forward slow reference.
+
+Shares the sampling rule (:func:`sampling._sample_rows`), filters,
+validation, eos truncation, and ``weights_dtype`` with the transformer
+path — one convention across model families.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+
+from mpit_tpu.models import sampling
+
+
+@functools.partial(jax.jit, static_argnums=(0, 1, 2, 3, 4, 5))
+def _rnn_prefill_decode_scan(
+    model, pre_bucket, gen_len, greedy, top_k, use_top_p,
+    params, cache0, pre_buf, p_lens, keys, temp, top_p,
+):
+    """One program: prompt pass (carries frozen at each row's own
+    length), head on each row's last prompt position only, then
+    ``gen_len`` one-token ticks — every tick pure sampling for every
+    row."""
+    hidden, mut = model.clone(head=False).apply(
+        {"params": params, "cache": cache0}, pre_buf,
+        seq_lengths=p_lens, mutable=["cache"],
+    )
+    cache = mut["cache"]
+    h_last = jax.vmap(lambda h, n: h[n - 1])(hidden, p_lens)  # (N, H)
+    last = model.head_logits(params, h_last)  # (N, V)
+    tok0 = sampling._sample_rows(
+        last, keys[:, 0], greedy, top_k, use_top_p, temp, top_p
+    )
+
+    def step(carry, t):
+        cache, prev = carry
+        logits, mut = model.apply(
+            {"params": params, "cache": cache},
+            prev[:, None],
+            mutable=["cache"],
+        )
+        nxt = sampling._sample_rows(
+            logits[:, 0], keys[:, t + 1], greedy, top_k, use_top_p,
+            temp, top_p,
+        )
+        return (mut["cache"], nxt), nxt
+
+    if gen_len > 1:
+        (_, _), rest = jax.lax.scan(
+            step, (cache, tok0), jnp.arange(gen_len - 1)
+        )
+        rest = rest.swapaxes(0, 1)
+        return jnp.concatenate([tok0[:, None], rest], axis=1)
+    return tok0[:, None]
+
+
+def generate_rnn(
+    model,
+    params,
+    prompts,
+    steps: int,
+    temperature: float = 0.0,
+    seed: int = 0,
+    rng: Optional[jax.Array] = None,
+    top_k: Optional[int] = None,
+    top_p: Optional[float] = None,
+    weights_dtype=None,
+    eos_id: Optional[int] = None,
+):
+    """Continue prompt(s) by ``steps`` tokens with a carry-decode LSTM.
+
+    ``prompts`` is either one prompt (a flat sequence of ints — returns
+    one token list, the :func:`sampling.generate_fast` shape) or a list
+    of prompts (returns a list of rows, the
+    :func:`sampling.generate_batch` shape; row n pinned equal to its
+    solo call at ``fold_in(rng, n)``). Unlike the transformer there is
+    no ``max_len`` — an RNN carry has no positional horizon.
+    """
+    solo = len(prompts) > 0 and not hasattr(prompts[0], "__len__")
+    batch = [prompts] if solo else list(prompts)
+    if len(batch) == 0:
+        return []
+    for q in batch:
+        sampling._validate(model, q, temperature, top_k, top_p, eos_id)
+    if steps <= 0:
+        rows = [[int(t) for t in q] for q in batch]
+        return rows[0] if solo else rows
+    if weights_dtype is not None:
+        params = sampling.cast_weights(params, weights_dtype)
+    if rng is None:
+        rng = jax.random.key(seed)
+    if solo:
+        rngs = rng[None] if hasattr(rng, "ndim") else jnp.stack([rng])
+    else:
+        rngs = jax.vmap(lambda i: jax.random.fold_in(rng, i))(
+            jnp.arange(len(batch))
+        )
+
+    n = len(batch)
+    # the shared prep (buckets, prompt buffer, pad rows, key streams) —
+    # the SAME parity invariants as the transformer path, one copy.
+    # RNNs have no positional horizon, so the length cap is unbounded.
+    nb, pre_bucket, gen_bucket, pre_buf, p_lens, keys = (
+        sampling._prep_rows(batch, steps, rngs, None, 1 << 30)
+    )
+    dec = model.clone(decode=True)
+    gen = _rnn_prefill_decode_scan(
+        dec, pre_bucket, gen_bucket, temperature == 0.0, top_k,
+        top_p is not None,
+        params, sampling._zero_cache(dec, nb), pre_buf, p_lens, keys,
+        jnp.asarray(max(temperature, 1e-9), jnp.float32),
+        jnp.asarray(1.0 if top_p is None else top_p, jnp.float32),
+    )
+    host = jax.device_get(gen)
+    rows = [
+        sampling._truncate_at_eos(
+            [int(t) for t in batch[i]] + [int(t) for t in host[i, :steps]],
+            len(batch[i]), eos_id,
+        )
+        for i in range(n)
+    ]
+    return rows[0] if solo else rows

@@ -103,10 +103,11 @@ def _validate(
         raise ValueError(
             "generation runs the dense model; clone(seq_axis=None) first"
         )
-    if not 0 < len(prompt) <= model.max_len:
+    max_len = getattr(model, "max_len", None)  # RNN LMs have no cap
+    if len(prompt) < 1 or (max_len is not None and len(prompt) > max_len):
         raise ValueError(
             f"prompt of {len(prompt)} tokens must be in [1, "
-            f"max_len={model.max_len}]"
+            f"max_len={max_len}]"
         )
     if temperature < 0:
         raise ValueError(f"temperature={temperature} must be >= 0")
@@ -653,17 +654,42 @@ def _generate_rows(
     single dense pass — equal and mixed lengths alike — so the scan
     spends exactly bucket(steps) latency-bound ticks, all of them
     sampling."""
+    n = len(prompts)
+    dec = _decode_setup(model, max(prompts, key=len), steps)
+    nb, pre_bucket, gen_bucket, pre_buf, p_lens, keys = _prep_rows(
+        prompts, steps, rngs, key_streams, model.max_len
+    )
+    gen = _prefill_decode_scan(
+        dec, pre_bucket, gen_bucket, temperature == 0.0, top_k,
+        top_p is not None,
+        params, _zero_cache(dec, nb, sharding_fn=cache_sharding_fn),
+        pre_buf, p_lens, keys,
+        jnp.asarray(max(temperature, 1e-9), jnp.float32),
+        jnp.asarray(1.0 if top_p is None else top_p, jnp.float32),
+    )
+    host = jax.device_get(gen)
+    return [
+        [int(t) for t in prompts[i]] + [int(t) for t in host[i, :steps]]
+        for i in range(n)
+    ]
+
+
+def _prep_rows(prompts, steps, rngs, key_streams, max_len_cap):
+    """The batching prep every decode family shares (transformer KV
+    kernel AND the LSTM carry kernel — rnn_sampling imports this): the
+    power-of-two buckets, the left-aligned prompt buffer, per-row true
+    lengths (pad rows are DISCARDED 1-token dummies), and the per-row
+    key streams — derived from ``fold_in`` rngs, or taken verbatim from
+    ``key_streams`` (the serving loop's resume hook) — padded to the
+    generation bucket by repeating the last key (only discarded
+    bucket-overrun ticks ever index the padding). The invariants here
+    ARE the batch==solo parity contract; keep them in one place."""
     import numpy as np
 
     if isinstance(rngs, (list, tuple)):
         rngs = jnp.stack(list(rngs))
     n = len(prompts)
-    longest = max(prompts, key=len)
-    dec = _decode_setup(model, longest, steps)
     nb = _bucket(n, 1 << 30)  # rows have no cap — pad rows are sliced away
-    greedy = temperature == 0.0
-    temp = jnp.asarray(max(temperature, 1e-9), jnp.float32)
-    tp_val = jnp.asarray(1.0 if top_p is None else top_p, jnp.float32)
     if key_streams is not None:  # serving loop: rows bring their own
         keys = key_streams    # (sliced) streams — no derivation here
         if keys.shape[0] != n or keys.shape[1] < max(steps, 1):
@@ -682,38 +708,23 @@ def _generate_rows(
         keys = jax.vmap(
             lambda k: jax.random.split(k, max(steps, 1))
         )(rngs)
-
-    def pad_keys(to_len):
-        # key SHAPE must depend only on the bucket (pad with repeats of
-        # the last key — only discarded bucket-overrun ticks index them)
-        if keys.shape[1] >= to_len:
-            return keys
-        return jnp.concatenate(
+    pre_bucket = _bucket(max(len(q) for q in prompts), max_len_cap)
+    gen_bucket = _bucket(steps, max_len_cap)
+    if keys.shape[1] < gen_bucket:
+        keys = jnp.concatenate(
             [keys,
-             jnp.repeat(keys[:, -1:], to_len - keys.shape[1], axis=1)],
+             jnp.repeat(keys[:, -1:], gen_bucket - keys.shape[1], axis=1)],
             axis=1,
         )
-
-    cache0 = _zero_cache(dec, nb, sharding_fn=cache_sharding_fn)
-    pre_bucket = _bucket(len(longest), model.max_len)
-    gen_bucket = _bucket(steps, model.max_len)
     pre_host = np.zeros((nb, pre_bucket), np.int32)
     for i, q in enumerate(prompts):
         pre_host[i, : len(q)] = q
-    # pad rows are DISCARDED 1-token dummy prompts (any length works
-    # under per-row clocks; their outputs are sliced away)
     p_lens = np.ones((nb,), np.int32)
     p_lens[:n] = [len(q) for q in prompts]
-    gen = _prefill_decode_scan(
-        dec, pre_bucket, gen_bucket, greedy, top_k, top_p is not None,
-        params, cache0, jnp.asarray(pre_host), jnp.asarray(p_lens),
-        pad_keys(gen_bucket), temp, tp_val,
+    return (
+        nb, pre_bucket, gen_bucket, jnp.asarray(pre_host),
+        jnp.asarray(p_lens), keys,
     )
-    host = jax.device_get(gen)
-    return [
-        [int(t) for t in prompts[i]] + [int(t) for t in host[i, :steps]]
-        for i in range(n)
-    ]
 
 
 def generate_tp(
