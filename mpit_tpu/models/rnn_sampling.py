@@ -123,7 +123,7 @@ def generate_rnn(
     # the SAME parity invariants as the transformer path, one copy.
     # RNNs have no positional horizon, so the length cap is unbounded.
     nb, pre_bucket, gen_bucket, pre_buf, p_lens, keys = (
-        sampling._prep_rows(batch, steps, rngs, None, 1 << 30)
+        sampling._prep_rows(batch, steps, rngs, 1 << 30)
     )
     dec = model.clone(decode=True)
     gen = _rnn_prefill_decode_scan(

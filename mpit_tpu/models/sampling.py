@@ -592,21 +592,14 @@ def _truncate_at_eos(seq, p_len, eos_id):
 def _batch_impl(
     model, params, prompts, steps, temperature, seed, rng, top_k, top_p,
     cache_sharding_fn=None, params_placer=None, weights_dtype=None,
-    eos_id=None, key_streams=None,
+    eos_id=None,
 ):
     """The ONE prologue generate_batch and generate_tp share: validation,
     trivial early returns, the per-row rng derivation (fold_in — the
     half of the pinned-parity contract that lives outside the kernel),
     then :func:`_generate_rows`. ``params_placer`` (generate_tp's
     Megatron device_put) runs only AFTER validation passes — a rejected
-    request must not pay a whole-model transfer.
-
-    ``key_streams`` (the serving loop's hook): pre-derived per-row key
-    arrays, shape (N, >= steps) of PRNG keys, used VERBATIM instead of
-    the fold_in+split derivation — this is how a re-batched in-flight
-    request keeps drawing from ITS OWN original stream (sliced past the
-    tokens already generated), preserving exact solo-call parity across
-    segment boundaries."""
+    request must not pay a whole-model transfer."""
     if len(prompts) == 0:
         return []
     for p in prompts:
@@ -617,18 +610,15 @@ def _batch_impl(
         params = cast_weights(params, weights_dtype)
     if params_placer is not None:
         params = params_placer(params)
-    if key_streams is None:
-        if rng is None:
-            rng = jax.random.key(seed)
-        # one fold_in+split dispatch for all rows, not N
-        rngs = jax.vmap(lambda i: jax.random.fold_in(rng, i))(
-            jnp.arange(len(prompts))
-        )
-    else:
-        rngs = None
+    if rng is None:
+        rng = jax.random.key(seed)
+    # one fold_in+split dispatch for all rows, not N
+    rngs = jax.vmap(lambda i: jax.random.fold_in(rng, i))(
+        jnp.arange(len(prompts))
+    )
     rows = _generate_rows(
         model, params, prompts, steps, temperature, rngs, top_k, top_p,
-        cache_sharding_fn=cache_sharding_fn, key_streams=key_streams,
+        cache_sharding_fn=cache_sharding_fn,
     )
     return [
         _truncate_at_eos(r, len(p), eos_id)
@@ -638,7 +628,7 @@ def _batch_impl(
 
 def _generate_rows(
     model, params, prompts, steps, temperature, rngs, top_k, top_p,
-    cache_sharding_fn=None, key_streams=None,
+    cache_sharding_fn=None,
 ):
     """The ONE wrapper both serving entry points share: bucket the
     prefill and generation lengths (power-of-two, capped at max_len)
@@ -657,7 +647,7 @@ def _generate_rows(
     n = len(prompts)
     dec = _decode_setup(model, max(prompts, key=len), steps)
     nb, pre_bucket, gen_bucket, pre_buf, p_lens, keys = _prep_rows(
-        prompts, steps, rngs, key_streams, model.max_len
+        prompts, steps, rngs, model.max_len
     )
     gen = _prefill_decode_scan(
         dec, pre_bucket, gen_bucket, temperature == 0.0, top_k,
@@ -674,40 +664,28 @@ def _generate_rows(
     ]
 
 
-def _prep_rows(prompts, steps, rngs, key_streams, max_len_cap):
+def _prep_rows(prompts, steps, rngs, max_len_cap):
     """The batching prep every decode family shares (transformer KV
     kernel AND the LSTM carry kernel — rnn_sampling imports this): the
     power-of-two buckets, the left-aligned prompt buffer, per-row true
     lengths (pad rows are DISCARDED 1-token dummies), and the per-row
-    key streams — derived from ``fold_in`` rngs, or taken verbatim from
-    ``key_streams`` (the serving loop's resume hook) — padded to the
-    generation bucket by repeating the last key (only discarded
-    bucket-overrun ticks ever index the padding). The invariants here
-    ARE the batch==solo parity contract; keep them in one place."""
+    key streams (``split(rng_n, steps)``) padded to the generation
+    bucket by repeating the last key (only discarded bucket-overrun
+    ticks ever index the padding). The invariants here ARE the
+    batch==solo parity contract; keep them in one place."""
     import numpy as np
 
     if isinstance(rngs, (list, tuple)):
         rngs = jnp.stack(list(rngs))
     n = len(prompts)
     nb = _bucket(n, 1 << 30)  # rows have no cap — pad rows are sliced away
-    if key_streams is not None:  # serving loop: rows bring their own
-        keys = key_streams    # (sliced) streams — no derivation here
-        if keys.shape[0] != n or keys.shape[1] < max(steps, 1):
-            raise ValueError(
-                f"key_streams {keys.shape} must cover ({n}, >={steps})"
-            )
-        if nb > n:  # pad rows reuse row 0's keys; outputs discarded
-            keys = jnp.concatenate(
-                [keys, jnp.repeat(keys[:1], nb - n, axis=0)]
-            )
-    else:
-        if nb > n:  # pad rows reuse row 0's rng; outputs are discarded
-            rngs = jnp.concatenate(
-                [rngs, jnp.repeat(rngs[:1], nb - n, axis=0)]
-            )
-        keys = jax.vmap(
-            lambda k: jax.random.split(k, max(steps, 1))
-        )(rngs)
+    if nb > n:  # pad rows reuse row 0's rng; outputs are discarded
+        rngs = jnp.concatenate(
+            [rngs, jnp.repeat(rngs[:1], nb - n, axis=0)]
+        )
+    keys = jax.vmap(
+        lambda k: jax.random.split(k, max(steps, 1))
+    )(rngs)
     pre_bucket = _bucket(max(len(q) for q in prompts), max_len_cap)
     gen_bucket = _bucket(steps, max_len_cap)
     if keys.shape[1] < gen_bucket:

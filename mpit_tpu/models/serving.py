@@ -5,39 +5,39 @@ the beyond-parity serving tier above :func:`~mpit_tpu.models.sampling.
 generate_batch`: a scheduler that keeps a decode batch full while
 requests arrive and finish at different times.
 
-Design (TPU-first, built ENTIRELY on the existing compiled kernels — no
-new model code, no per-row cache clocks):
+Design (TPU-first, resident cache — enabled by the per-row cache clocks
+in :class:`~mpit_tpu.models.transformer.Block`):
 
-- Decoding advances in fixed **segments** of ticks. Each segment is one
-  call into the shared batched kernel path (``_batch_impl``), so the
-  whole segment is one (or two: prefill + scan) XLA program — the host
-  only intervenes at segment boundaries.
-- At a segment boundary the server retires finished rows (budget
-  exhausted or ``eos_id`` emitted) and **admits** queued requests into
-  the freed slots. Admission re-enters every in-flight row's KNOWN
-  tokens (prompt + generated so far) as that row's "prompt": the mixed-
-  length chunked prefill then rebuilds all caches in one matmul-bound
-  dense pass. That re-prefill is the price of admission — O(L) extra
-  FLOPs per admission event, paid on the MXU-friendly path — and what
-  it buys is a decode batch that never runs with dead rows. (True
-  in-place admission needs per-row cache clocks, a Block-level change;
-  this scheduler is deliberately kernel-reusing instead.)
+- The K/V cache is RESIDENT on device: one (NB, ...) cache tree lives
+  across the server's whole life, one slot per decode row. Decoding
+  advances in fixed **segments** of ticks — each segment is ONE XLA
+  program over the whole batch (donated cache in/out, no host copies) —
+  and the host intervenes only at segment boundaries.
+- At a boundary the server retires finished rows (budget exhausted or
+  ``eos_id`` emitted) and **admits** queued requests into freed slots:
+  a batch-1 chunked prefill builds the newcomer's cache rows and
+  counters (its per-row clock lands at its own prompt length), which
+  are written in place into the resident tree. In-flight rows are
+  UNTOUCHED — admission costs one prompt prefill for the newcomer and
+  nothing for anyone else. Free slots keep ticking garbage (discarded;
+  their clamped cache writes can never be attended by occupied rows,
+  whose masks stop at their own clocks).
 - **Exact parity**: every request's result is bit-equal to its solo
   ``generate_fast(prompt, max_new, rng=request_rng)`` call. Sampling
-  keys are pre-split per request (``split(rng, max_new)``) and each
-  segment feeds the kernel the UNUSED SLICE of each row's stream
-  (``_batch_impl(key_streams=...)``), so token k of a request is always
-  drawn with stream key k no matter how segments and batch compositions
-  fell. Greedy is parity-trivial; the key plumbing makes sampled
-  serving parity hold too — pinned in tests/test_serving.py.
+  keys are pre-split per request (``split(rng, max_new)``); generated
+  token j is always drawn with stream key j — token 0 at admission
+  (from the prefill logits), the rest inside segments — no matter how
+  segments, slots, and batch composition fell. Pinned in
+  tests/test_serving.py, greedy and sampled.
 
-Row independence (each row's outputs depend only on its own tokens —
-the property the batch==solo tests pin) is what makes retirement and
-admission invisible to the surviving rows.
+Row independence (each row's outputs depend only on its own tokens and
+clock — the property the batch==solo tests pin) is what makes
+retirement and admission invisible to the surviving rows.
 """
 
 from __future__ import annotations
 
+import functools
 from collections import deque
 from typing import Optional
 
@@ -45,6 +45,73 @@ import jax
 import jax.numpy as jnp
 
 from mpit_tpu.models import sampling
+
+
+@functools.partial(jax.jit, static_argnums=(0, 1, 2, 3, 4))
+def _prefill_one(
+    model, pre_bucket, greedy, top_k, use_top_p,
+    params, cache0, pre_buf, p_len, key0, temp, top_p,
+):
+    """Admission: ONE request's prompt through the dense chunked
+    prefill (batch 1) — returns its cache rows (counters at ``p_len``)
+    and its first sampled token (stream key 0, the same key the batch
+    kernel would have used)."""
+    hidden, mut = model.clone(head=False).apply(
+        {"params": params, "cache": cache0}, pre_buf, mutable=["cache"]
+    )
+    cache = sampling._fix_cache_indices(mut["cache"], p_len)
+    h_last = jax.vmap(lambda h, n: h[n - 1])(hidden, p_len)
+    last = model.head_logits(params, h_last)  # (1, V)
+    tok0 = sampling._sample_rows(
+        last, key0, greedy, top_k, use_top_p, temp, top_p
+    )
+    return cache, tok0[0]
+
+
+@functools.partial(jax.jit, donate_argnums=(0,))
+def _insert_row(big, row, slot):
+    """Write a batch-1 cache tree into slot ``slot`` of the resident
+    (NB, ...) tree — every leaf is batch-leading, index counters
+    included, so one in-place dynamic update per leaf (the resident
+    tree is DONATED: admission writes in place, no full-cache copy)."""
+    return jax.tree.map(
+        lambda b, r: jax.lax.dynamic_update_slice_in_dim(
+            b, r.astype(b.dtype), slot, axis=0
+        ),
+        big, row,
+    )
+
+
+@functools.partial(
+    jax.jit, static_argnums=(0, 1, 2, 3, 4), donate_argnums=(6, 7)
+)
+def _serve_segment(
+    model, seg, greedy, top_k, use_top_p,
+    params, cache, prev, keys, temp, top_p,
+):
+    """``seg`` decode ticks over the whole resident batch as one
+    program: every tick feeds each slot its previous sample and draws
+    its next from that slot's key column. The cache and prev-token
+    buffers are DONATED — the segment updates them in place, no
+    per-segment reallocation or host round-trips."""
+
+    def step(carry, t):
+        cache, prev = carry
+        logits, mut = model.apply(
+            {"params": params, "cache": cache},
+            prev[:, None],
+            mutable=["cache"],
+        )
+        nxt = sampling._sample_rows(
+            logits[:, 0], keys[:, t], greedy, top_k, use_top_p,
+            temp, top_p,
+        )
+        return (mut["cache"], nxt), nxt
+
+    (cache, prev), toks = jax.lax.scan(
+        step, (cache, prev), jnp.arange(seg)
+    )
+    return cache, prev, toks.swapaxes(0, 1)  # (NB, seg)
 
 
 class Server:
@@ -94,9 +161,23 @@ class Server:
         self._rng = jax.random.key(seed)
         self._next_id = 0
         self._waiting: deque[dict] = deque()
-        self._active: list[dict] = []
         self._results: dict[int, list[int]] = {}
         self.segments_run = 0
+        # resident decode state: one slot per row of the bucketed batch
+        self._dec = model.clone(
+            decode=True, remat=False, seq_axis=None, attn_impl="xla"
+        )
+        self._nb = sampling._bucket(self.max_batch, 1 << 30)
+        self._slots: list = [None] * self._nb
+        self._cache = None  # built lazily at first admission
+        self._prev = None
+        self._greedy = self.temperature == 0.0
+        self._temp = jnp.asarray(
+            max(self.temperature, 1e-9), jnp.float32
+        )
+        self._tp = jnp.asarray(
+            1.0 if top_p is None else top_p, jnp.float32
+        )
 
     # ------------------------------------------------------------- intake
 
@@ -132,8 +213,8 @@ class Server:
             "p0": len(prompt),
             "max_new": int(max_new_tokens),
             "gen": 0,
-            # the request's ENTIRE stream, split once: segment k draws
-            # keys [gen, gen+steps) from it — solo-call parity
+            # the request's ENTIRE stream, split once: generated token j
+            # draws key j — solo-call parity under any scheduling
             "stream": jax.random.split(rng, max_new_tokens),
         })
         return rid
@@ -142,39 +223,85 @@ class Server:
 
     @property
     def pending(self) -> int:
-        return len(self._waiting) + len(self._active)
+        occupied = sum(1 for s in self._slots if s is not None)
+        return len(self._waiting) + occupied
+
+    def _occupied(self):
+        return [s for s in self._slots if s is not None]
+
+    def _admit(self, r: dict, slot: int) -> None:
+        """Prefill ONE newcomer and write its cache rows + first token
+        into the resident tree; in-flight slots are untouched."""
+        import numpy as np
+
+        if self._cache is None:
+            self._cache = sampling._zero_cache(self._dec, self._nb)
+            self._prev = jnp.zeros((self._nb,), jnp.int32)
+        p_len = len(r["known"])
+        pre_bucket = sampling._bucket(p_len, self.model.max_len)
+        pre_buf = np.zeros((1, pre_bucket), np.int32)
+        pre_buf[0, :p_len] = r["known"]
+        row_cache, tok0 = _prefill_one(
+            self._dec, pre_bucket, self._greedy, self.top_k,
+            self.top_p is not None,
+            self.params, sampling._zero_cache(self._dec, 1),
+            jnp.asarray(pre_buf), jnp.asarray([p_len], jnp.int32),
+            r["stream"][:1], self._temp, self._tp,
+        )
+        self._cache = _insert_row(
+            self._cache, row_cache, jnp.asarray(slot, jnp.int32)
+        )
+        tok0 = int(tok0)
+        self._prev = self._prev.at[slot].set(tok0)
+        r["known"].append(tok0)
+        r["gen"] = 1
+        if (
+            (self.eos_id is not None and tok0 == self.eos_id)
+            or r["gen"] >= r["max_new"]
+        ):
+            self._results[r["id"]] = r["known"]  # done at admission
+        else:
+            self._slots[slot] = r
 
     def step(self) -> None:
         """One scheduling round: admit into free slots, run one segment,
         retire finished rows."""
-        while self._waiting and len(self._active) < self.max_batch:
-            self._active.append(self._waiting.popleft())
-        if not self._active:
+        for slot in range(self._nb):
+            if not self._waiting:
+                break
+            if self._slots[slot] is None and slot < self.max_batch:
+                self._admit(self._waiting.popleft(), slot)
+        occ = self._occupied()
+        if not occ:
             return
         # a row at the max_len frontier caps the segment for everyone —
-        # transient: such a row's budget ends within those ticks
-        steps = min(
+        # transient: such a row's budget ends within those ticks. Round
+        # DOWN to a power of two so compiled programs stay log-bounded.
+        cap = min(
             self.segment,
-            min(self.model.max_len - len(r["known"])
-                for r in self._active),
+            min(self.model.max_len - len(r["known"]) for r in occ),
         )
+        seg = 1 << (cap.bit_length() - 1)
+        dummy = self._stream_slice(occ[0], seg)
         keys = jnp.stack([
-            self._stream_slice(r, steps) for r in self._active
+            self._stream_slice(r, seg) if r is not None else dummy
+            for r in self._slots
         ])
-        rows = sampling._batch_impl(
-            self.model, self.params,
-            [r["known"] for r in self._active], steps,
-            self.temperature, 0, None, self.top_k, self.top_p,
-            key_streams=keys,
+        self._cache, self._prev, toks = _serve_segment(
+            self._dec, seg, self._greedy, self.top_k,
+            self.top_p is not None,
+            self.params, self._cache, self._prev, keys,
+            self._temp, self._tp,
         )
         self.segments_run += 1
-        survivors = []
-        for r, row in zip(self._active, rows):
-            new = row[len(r["known"]):]
-            take = min(len(new), r["max_new"] - r["gen"])
+        host = jax.device_get(toks)
+        for slot, r in enumerate(self._slots):
+            if r is None:
+                continue
+            take = min(seg, r["max_new"] - r["gen"])
             done = False
             for j in range(take):
-                tok = int(new[j])
+                tok = int(host[slot, j])
                 r["known"].append(tok)
                 r["gen"] += 1
                 if self.eos_id is not None and tok == self.eos_id:
@@ -182,9 +309,7 @@ class Server:
                     break
             if done or r["gen"] >= r["max_new"]:
                 self._results[r["id"]] = r["known"]
-            else:
-                survivors.append(r)
-        self._active = survivors
+                self._slots[slot] = None
 
     def _stream_slice(self, r: dict, steps: int):
         """keys [gen, gen+steps) of the request's stream, padded by
@@ -201,7 +326,7 @@ class Server:
         """Run until every submitted request finished; returns
         {id: tokens} (prompt included; truncated just past eos if one was
         emitted — the shared truncation convention)."""
-        while self._waiting or self._active:
+        while self._waiting or self._occupied():
             self.step()
         out, self._results = self._results, {}
         return out

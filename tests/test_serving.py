@@ -118,6 +118,31 @@ def test_eos_retires_early_and_matches_solo(topo8):
     assert got[b] == want_b
 
 
+def test_admission_never_reprefills_inflight_rows(topo8, monkeypatch):
+    """The resident cache makes admission O(one prompt): exactly ONE
+    prefill per request over the whole run, no matter how arrivals
+    interleave with in-flight decoding."""
+    from mpit_tpu.models import serving
+
+    model, params = _model_params()
+    calls = []
+    real = serving._prefill_one
+
+    def counting(*a, **k):
+        calls.append(1)
+        return real(*a, **k)
+
+    monkeypatch.setattr(serving, "_prefill_one", counting)
+    srv = Server(model, params, max_batch=2, segment=3)
+    srv.submit(*REQS[0])
+    srv.submit(*REQS[1])
+    srv.step()
+    srv.submit(*REQS[2])  # arrives mid-flight
+    srv.submit(*REQS[3])
+    srv.drain()
+    assert len(calls) == 4  # one per request — never one per segment
+
+
 def test_validation(topo8):
     model, params = _model_params()
     srv = Server(model, params)
