@@ -98,10 +98,14 @@ def generate_rnn(
     solo call at ``fold_in(rng, n)``). Unlike the transformer there is
     no ``max_len`` — an RNN carry has no positional horizon.
     """
-    solo = len(prompts) > 0 and not hasattr(prompts[0], "__len__")
+    # A flat empty sequence is treated as a SOLO empty prompt and
+    # rejected by the shared validator below — the same error
+    # generate/generate_fast raise — so a caller bug cannot silently
+    # come back as []. ([] cannot mean "empty batch" here: that call is
+    # a degenerate no-op better served by generate_batch's []->[]
+    # contract on the transformer path.)
+    solo = len(prompts) == 0 or not hasattr(prompts[0], "__len__")
     batch = [prompts] if solo else list(prompts)
-    if len(batch) == 0:
-        return []
     for q in batch:
         sampling._validate(model, q, temperature, top_k, top_p, eos_id)
     if steps <= 0:

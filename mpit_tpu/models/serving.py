@@ -159,6 +159,7 @@ class Server:
         self.top_p = top_p
         self.eos_id = eos_id
         self._rng = jax.random.key(seed)
+        self._poisoned: Optional[BaseException] = None
         self._next_id = 0
         self._waiting: deque[dict] = deque()
         self._results: dict[int, list[int]] = {}
@@ -200,6 +201,7 @@ class Server:
                 f"({max_new_tokens}) exceeds max_len={self.model.max_len} "
                 "(the cached decode cannot slide)"
             )
+        self._check_poisoned()
         rid = self._next_id
         self._next_id += 1
         if rng is None:
@@ -220,6 +222,32 @@ class Server:
         return rid
 
     # ---------------------------------------------------------- scheduling
+
+    def _check_poisoned(self) -> None:
+        """The resident cache/prev buffers are DONATED into the segment
+        and admission kernels; if such a call raised (or was interrupted
+        mid-flight), the donated buffers are invalidated while
+        ``self._cache``/``self._prev`` still point at them. Rather than
+        letting a later step fail with an opaque 'array has been
+        deleted', the first failure marks the server poisoned and every
+        subsequent call reports it clearly. In-flight requests are lost
+        (build a new Server and resubmit; prompts are host-side), but
+        ALREADY-completed results are plain host ints — they stay
+        retrievable via :meth:`results`."""
+        if self._poisoned is not None:
+            raise RuntimeError(
+                "Server is poisoned: a donated-buffer kernel failed or "
+                "was interrupted, invalidating the resident decode "
+                "state. Completed results remain available via "
+                "results(); build a new Server to resubmit the rest."
+            ) from self._poisoned
+
+    def results(self) -> dict:
+        """Pop every COMPLETED request's tokens ({id: tokens}) without
+        running anything — works on a poisoned server too (finished
+        results are host-side and unaffected by lost device state)."""
+        out, self._results = self._results, {}
+        return out
 
     @property
     def pending(self) -> int:
@@ -265,7 +293,17 @@ class Server:
 
     def step(self) -> None:
         """One scheduling round: admit into free slots, run one segment,
-        retire finished rows."""
+        retire finished rows. Any failure mid-round poisons the server
+        (see :meth:`_check_poisoned`) — donated resident buffers may be
+        gone, so there is no safe partial state to continue from."""
+        self._check_poisoned()
+        try:
+            self._step_inner()
+        except BaseException as e:
+            self._poisoned = e
+            raise
+
+    def _step_inner(self) -> None:
         for slot in range(self._nb):
             if not self._waiting:
                 break
@@ -328,5 +366,4 @@ class Server:
         emitted — the shared truncation convention)."""
         while self._waiting or self._occupied():
             self.step()
-        out, self._results = self._results, {}
-        return out
+        return self.results()

@@ -149,7 +149,10 @@ def test_validation_shared_with_transformer_path(topo8):
     with pytest.raises(ValueError, match="eos_id"):
         generate_rnn(model, params, [1], 2, eos_id=99)
     assert generate_rnn(model, params, [1, 2], 0) == [1, 2]
-    assert generate_rnn(model, params, [], 3) == []
+    # a flat empty sequence is a solo 0-token prompt — the shared
+    # validator rejects it instead of silently returning []
+    with pytest.raises(ValueError, match="prompt of 0 tokens"):
+        generate_rnn(model, params, [], 3)
 
 
 def test_batch_bucketing_shares_programs(topo8):

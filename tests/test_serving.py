@@ -158,6 +158,40 @@ def test_validation(topo8):
         Server(model, params, segment=0)
 
 
+def test_segment_failure_poisons_server(topo8, monkeypatch):
+    """A failure inside a donated-buffer kernel must not leave the
+    server silently unusable: the first failure propagates, and every
+    later call reports the poisoning clearly instead of an opaque
+    'array has been deleted'."""
+    from mpit_tpu.models import serving
+
+    model, params = _model_params()
+    srv = Server(model, params, max_batch=1, segment=4)
+    a = srv.submit(REQS[4][0], REQS[4][1])  # small budget: finishes fast
+    b = srv.submit(*REQS[0])
+    while a not in srv._results:
+        srv.step()  # request a completes and retires; b is in flight
+
+    def boom(*a, **k):
+        raise RuntimeError("simulated mid-segment failure")
+
+    monkeypatch.setattr(serving, "_serve_segment", boom)
+    with pytest.raises(RuntimeError, match="simulated"):
+        srv.step()
+    monkeypatch.undo()
+    with pytest.raises(RuntimeError, match="poisoned"):
+        srv.step()
+    with pytest.raises(RuntimeError, match="poisoned"):
+        srv.submit(*REQS[1])
+    # completed work survives the poisoning: a finished BEFORE the
+    # failure and its tokens are host-side ints
+    done = srv.results()
+    assert done[a] == _solo(
+        model, params, REQS[4][0], REQS[4][1], jax.random.key(0)
+    )
+    assert b not in done  # in-flight work is honestly lost
+
+
 def test_drain_empty_and_reuse(topo8):
     model, params = _model_params()
     srv = Server(model, params, max_batch=2, segment=4)
