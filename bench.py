@@ -737,13 +737,23 @@ def bench_decode(
     }
 
 
-def bench_serve(cpu_smoke: bool = False, weights_dtype: str = None) -> dict:
+def bench_serve(
+    cpu_smoke: bool = False, weights_dtype: str = None,
+    burst: bool = False,
+) -> dict:
     """Continuous-batching throughput: sustained generated tokens/sec of
     ``models.serving.Server`` draining a queue of unequal requests
     (prompt lengths AND budgets spread) through a fixed slot count —
     the serving metric with retirement + admission in the loop, where
     ``--decode`` measures one static batch. Completion is by
     construction: every generated token is host-fetched by the drain.
+
+    ``burst``: instead of a pre-filled queue, submit only the first
+    slot-full, run one segment, then dump EVERY remaining request
+    mid-flight — the admission-cost regime (grouped same-bucket
+    prefills at a scheduling boundary) that the plain drain never
+    exercises because its queue admits into free slots one segment at
+    a time.
     """
     import jax
     import jax.numpy as jnp
@@ -779,8 +789,14 @@ def bench_serve(cpu_smoke: bool = False, weights_dtype: str = None) -> dict:
 
     def drain_once():
         srv = Server(model, params, max_batch=max_batch, segment=segment)
-        for q, (_, mn) in zip(prompts, reqs):
+        pairs = list(zip(prompts, (mn for _, mn in reqs)))
+        head = pairs[:max_batch] if burst else pairs
+        for q, mn in head:
             srv.submit(q, mn)
+        if burst:
+            srv.step()  # head requests are mid-flight...
+            for q, mn in pairs[max_batch:]:
+                srv.submit(q, mn)  # ...when the burst arrives at once
         out = srv.drain()
         return sum(mn for _, mn in reqs), srv.segments_run, out
 
@@ -805,6 +821,7 @@ def bench_serve(cpu_smoke: bool = False, weights_dtype: str = None) -> dict:
         "segments_per_drain": segments,
         "model": "transformer-large" if not cpu_smoke else "tiny",
         **({"weights_dtype": weights_dtype} if weights_dtype else {}),
+        **({"admission": "burst"} if burst else {}),
     }
 
 
@@ -942,15 +959,16 @@ def main():
 
     if "--serve" in sys.argv:
         wd = weights_dtype_flag()
+        burst = "--burst" in sys.argv
         with trace(profile_dir):
-            res = bench_serve(cpu_smoke=cpu, weights_dtype=wd)
+            res = bench_serve(cpu_smoke=cpu, weights_dtype=wd, burst=burst)
         emit_tokens_metric(
             "serve_tokens_per_sec",
-            "serve" + ("-bf16" if wd else ""),
+            "serve" + ("-bf16" if wd else "") + ("-burst" if burst else ""),
             res,
             ("requests", "max_batch", "segment", "segments_per_drain",
              "model"),
-            ("weights_dtype", "spread"),
+            ("weights_dtype", "spread", "admission"),
         )
         return
 

@@ -48,37 +48,39 @@ from mpit_tpu.models import sampling
 
 
 @functools.partial(jax.jit, static_argnums=(0, 1, 2, 3, 4))
-def _prefill_one(
+def _prefill_rows(
     model, pre_bucket, greedy, top_k, use_top_p,
-    params, cache0, pre_buf, p_len, key0, temp, top_p,
+    params, cache0, pre_buf, p_lens, keys0, temp, top_p,
 ):
-    """Admission: ONE request's prompt through the dense chunked
-    prefill (batch 1) — returns its cache rows (counters at ``p_len``)
-    and its first sampled token (stream key 0, the same key the batch
-    kernel would have used)."""
+    """Admission: a GROUP of same-bucket prompts through the dense
+    chunked prefill as ONE kernel (K rows) — returns their cache rows
+    (each row's counter at its OWN ``p_lens[i]``, per-row clocks) and
+    each row's first sampled token (that request's stream key 0 — the
+    same key the batch kernel would have used). A burst of K arrivals
+    costs one prefill call, not K (pinned in tests/test_serving.py)."""
     hidden, mut = model.clone(head=False).apply(
         {"params": params, "cache": cache0}, pre_buf, mutable=["cache"]
     )
-    cache = sampling._fix_cache_indices(mut["cache"], p_len)
-    h_last = jax.vmap(lambda h, n: h[n - 1])(hidden, p_len)
-    last = model.head_logits(params, h_last)  # (1, V)
+    cache = sampling._fix_cache_indices(mut["cache"], p_lens)
+    h_last = jax.vmap(lambda h, n: h[n - 1])(hidden, p_lens)
+    last = model.head_logits(params, h_last)  # (K, V)
     tok0 = sampling._sample_rows(
-        last, key0, greedy, top_k, use_top_p, temp, top_p
+        last, keys0, greedy, top_k, use_top_p, temp, top_p
     )
-    return cache, tok0[0]
+    return cache, tok0
 
 
 @functools.partial(jax.jit, donate_argnums=(0,))
-def _insert_row(big, row, slot):
-    """Write a batch-1 cache tree into slot ``slot`` of the resident
-    (NB, ...) tree — every leaf is batch-leading, index counters
-    included, so one in-place dynamic update per leaf (the resident
-    tree is DONATED: admission writes in place, no full-cache copy)."""
+def _insert_rows(big, rows, slots):
+    """Scatter K prefilled cache rows into slots ``slots`` of the
+    resident (NB, ...) tree — every leaf is batch-leading, index
+    counters included (the resident tree is DONATED: admission writes
+    in place, no full-cache copy). Pad rows repeat row 0's inputs AND
+    slot, so their duplicate-index writes carry bit-identical values
+    (prefill is deterministic) and are harmless under scatter's
+    unspecified write order."""
     return jax.tree.map(
-        lambda b, r: jax.lax.dynamic_update_slice_in_dim(
-            b, r.astype(b.dtype), slot, axis=0
-        ),
-        big, row,
+        lambda b, r: b.at[slots].set(r.astype(b.dtype)), big, rows
     )
 
 
@@ -257,39 +259,61 @@ class Server:
     def _occupied(self):
         return [s for s in self._slots if s is not None]
 
-    def _admit(self, r: dict, slot: int) -> None:
-        """Prefill ONE newcomer and write its cache rows + first token
-        into the resident tree; in-flight slots are untouched."""
+    def _admit_group(self, grp: list) -> None:
+        """Prefill a SAME-BUCKET group of newcomers [(request, slot)]
+        as one K-row kernel call and scatter their cache rows + first
+        tokens into the resident tree; in-flight slots are untouched.
+        K buckets to a power of two (compiles stay log-bounded in the
+        burst size); pad rows repeat row 0's inputs and slot, so the
+        scatter rewrites row 0's slot with identical data."""
         import numpy as np
 
         if self._cache is None:
             self._cache = sampling._zero_cache(self._dec, self._nb)
             self._prev = jnp.zeros((self._nb,), jnp.int32)
-        p_len = len(r["known"])
-        pre_bucket = sampling._bucket(p_len, self.model.max_len)
-        pre_buf = np.zeros((1, pre_bucket), np.int32)
-        pre_buf[0, :p_len] = r["known"]
-        row_cache, tok0 = _prefill_one(
+        k = len(grp)
+        kb = sampling._bucket(k, 1 << 30)
+        pre_bucket = sampling._bucket(
+            max(len(r["known"]) for r, _ in grp), self.model.max_len
+        )
+        pre_buf = np.zeros((kb, pre_bucket), np.int32)
+        p_lens = np.zeros((kb,), np.int32)
+        slots = np.zeros((kb,), np.int32)
+        keys0 = []
+        for i, (r, slot) in enumerate(grp):
+            p = r["known"]
+            pre_buf[i, : len(p)] = p
+            p_lens[i] = len(p)
+            slots[i] = slot
+            keys0.append(r["stream"][0])
+        for i in range(k, kb):  # pad rows mirror row 0 exactly
+            pre_buf[i] = pre_buf[0]
+            p_lens[i] = p_lens[0]
+            slots[i] = slots[0]
+            keys0.append(grp[0][0]["stream"][0])
+        rows, tok0 = _prefill_rows(
             self._dec, pre_bucket, self._greedy, self.top_k,
             self.top_p is not None,
-            self.params, sampling._zero_cache(self._dec, 1),
-            jnp.asarray(pre_buf), jnp.asarray([p_len], jnp.int32),
-            r["stream"][:1], self._temp, self._tp,
+            self.params, sampling._zero_cache(self._dec, kb),
+            jnp.asarray(pre_buf), jnp.asarray(p_lens),
+            jnp.stack(keys0), self._temp, self._tp,
         )
-        self._cache = _insert_row(
-            self._cache, row_cache, jnp.asarray(slot, jnp.int32)
+        self._cache = _insert_rows(self._cache, rows, jnp.asarray(slots))
+        self._prev = self._prev.at[jnp.asarray(slots[:k])].set(
+            tok0[:k].astype(jnp.int32)
         )
-        tok0 = int(tok0)
-        self._prev = self._prev.at[slot].set(tok0)
-        r["known"].append(tok0)
-        r["gen"] = 1
-        if (
-            (self.eos_id is not None and tok0 == self.eos_id)
-            or r["gen"] >= r["max_new"]
-        ):
-            self._results[r["id"]] = r["known"]  # done at admission
-        else:
-            self._slots[slot] = r
+        host0 = jax.device_get(tok0[:k])
+        for i, (r, slot) in enumerate(grp):
+            t0 = int(host0[i])
+            r["known"].append(t0)
+            r["gen"] = 1
+            if (
+                (self.eos_id is not None and t0 == self.eos_id)
+                or r["gen"] >= r["max_new"]
+            ):
+                self._results[r["id"]] = r["known"]  # done at admission
+            else:
+                self._slots[slot] = r
 
     def step(self) -> None:
         """One scheduling round: admit into free slots, run one segment,
@@ -304,11 +328,23 @@ class Server:
             raise
 
     def _step_inner(self) -> None:
-        for slot in range(self._nb):
+        # admission: pop FIFO waiters into free slots, then batch the
+        # kernel work by prompt bucket — K same-bucket arrivals cost
+        # ONE prefill call (the per-row clocks make the group kernel
+        # identical to K solo prefills, row by row)
+        free = [
+            s for s in range(min(self._nb, self.max_batch))
+            if self._slots[s] is None
+        ]
+        groups: dict[int, list] = {}
+        for slot in free:
             if not self._waiting:
                 break
-            if self._slots[slot] is None and slot < self.max_batch:
-                self._admit(self._waiting.popleft(), slot)
+            r = self._waiting.popleft()
+            b = sampling._bucket(len(r["known"]), self.model.max_len)
+            groups.setdefault(b, []).append((r, slot))
+        for grp in groups.values():
+            self._admit_group(grp)
         occ = self._occupied()
         if not occ:
             return
@@ -363,7 +399,11 @@ class Server:
     def drain(self) -> dict:
         """Run until every submitted request finished; returns
         {id: tokens} (prompt included; truncated just past eos if one was
-        emitted — the shared truncation convention)."""
+        emitted — the shared truncation convention). On a poisoned
+        server this raises even when nothing appears pending (a failed
+        admission loses requests from the queue without occupying a
+        slot); use :meth:`results` for the completed work."""
+        self._check_poisoned()
         while self._waiting or self._occupied():
             self.step()
         return self.results()

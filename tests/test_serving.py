@@ -118,29 +118,59 @@ def test_eos_retires_early_and_matches_solo(topo8):
     assert got[b] == want_b
 
 
-def test_admission_never_reprefills_inflight_rows(topo8, monkeypatch):
-    """The resident cache makes admission O(one prompt): exactly ONE
-    prefill per request over the whole run, no matter how arrivals
-    interleave with in-flight decoding."""
+def _count_prefills(monkeypatch):
     from mpit_tpu.models import serving
 
-    model, params = _model_params()
     calls = []
-    real = serving._prefill_one
+    real = serving._prefill_rows
 
     def counting(*a, **k):
         calls.append(1)
         return real(*a, **k)
 
-    monkeypatch.setattr(serving, "_prefill_one", counting)
+    monkeypatch.setattr(serving, "_prefill_rows", counting)
+    return calls
+
+
+def test_admission_never_reprefills_inflight_rows(topo8, monkeypatch):
+    """The resident cache makes admission O(the newcomers' prompts):
+    each request is prefilled exactly once over its whole life, no
+    matter how arrivals interleave with in-flight decoding."""
+    calls = _count_prefills(monkeypatch)
+    model, params = _model_params()
     srv = Server(model, params, max_batch=2, segment=3)
-    srv.submit(*REQS[0])
-    srv.submit(*REQS[1])
+    srv.submit(*REQS[0])  # p_len 5 -> bucket 8
+    srv.submit(*REQS[1])  # p_len 1 -> bucket 1
     srv.step()
-    srv.submit(*REQS[2])  # arrives mid-flight
-    srv.submit(*REQS[3])
+    srv.submit(*REQS[2])  # p_len 3 -> bucket 4, arrives mid-flight
+    srv.submit(*REQS[3])  # p_len 6 -> bucket 8
     srv.drain()
-    assert len(calls) == 4  # one per request — never one per segment
+    # four requests in four distinct (round, bucket) admission groups:
+    # four prefill calls — never one per segment
+    assert len(calls) == 4
+
+
+def test_burst_admission_is_one_kernel_call(topo8, monkeypatch):
+    """K same-bucket arrivals admitted at one scheduling boundary cost
+    ONE prefill kernel call (the per-row clocks batch the group), and
+    every result still equals its solo call."""
+    calls = _count_prefills(monkeypatch)
+    model, params = _model_params()
+    kw = dict(temperature=0.8, top_k=5)
+    burst = [([3, 1, 4, 1], 6), ([2, 7, 1, 8], 5), ([9, 9], 4),
+             ([5, 3, 5], 7)]
+    srv = Server(model, params, max_batch=4, segment=4, **kw)
+    rngs = {}
+    for i, (prompt, mn) in enumerate(burst):
+        rng = jax.random.key(40 + i)
+        rngs[srv.submit(prompt, mn, rng=rng)] = rng
+    srv.step()
+    # buckets: 4,4,2,4 -> two groups (the 3 bucket-4 rows, 1 bucket-2)
+    assert len(calls) == 2
+    got = srv.drain()
+    for rid, (prompt, mn) in enumerate(burst):
+        assert got[rid] == _solo(model, params, prompt, mn, rngs[rid],
+                                 **kw), rid
 
 
 def test_validation(topo8):
@@ -183,6 +213,8 @@ def test_segment_failure_poisons_server(topo8, monkeypatch):
         srv.step()
     with pytest.raises(RuntimeError, match="poisoned"):
         srv.submit(*REQS[1])
+    with pytest.raises(RuntimeError, match="poisoned"):
+        srv.drain()  # even though nothing LOOKS pending
     # completed work survives the poisoning: a finished BEFORE the
     # failure and its tokens are host-side ints
     done = srv.results()
