@@ -42,6 +42,18 @@ class LSTMLM(nn.Module):
     # decode prefill projects ONE row per batch row through the vocab
     # head (head_logits) instead of materializing (B, T, V) f32 logits
     head: bool = True
+    # vocab-head OPERAND dtype override (None -> compute_dtype);
+    # accumulation is always f32 — see TransformerLM.head_dtype
+    head_dtype: Any = None
+
+    @property
+    def _head_operand_dtype(self):
+        """One resolution rule, shared by ``_head`` and ``head_logits``
+        (same contract as TransformerLM._head_operand_dtype)."""
+        return (
+            self.compute_dtype if self.head_dtype is None
+            else self.head_dtype
+        )
 
     @nn.compact
     def __call__(self, tokens, seq_lengths: Optional[jax.Array] = None):
@@ -84,8 +96,9 @@ class LSTMLM(nn.Module):
         # to bf16 on the way out (the plain Dense+astype recipe computed
         # a bf16 output first). Param tree unchanged: same Dense module,
         # only its dot_general carries preferred_element_type.
+        hdt = self._head_operand_dtype
         logits = nn.Dense(
-            self.vocab_size, dtype=self.compute_dtype,
+            self.vocab_size, dtype=hdt,
             dot_general=functools.partial(
                 lax.dot_general, preferred_element_type=jnp.float32
             ),
@@ -94,10 +107,10 @@ class LSTMLM(nn.Module):
 
     def head_logits(self, params, h):
         """The vocab head applied to (B, H) hidden rows — the SAME
-        projection ``__call__`` ends with (compute-dtype operands, f32
-        accumulation), for decode prefill callers that ran ``head=False``
-        and kept only each row's last prompt position."""
-        dt = self.compute_dtype
+        projection ``__call__`` ends with (head-operand-dtype operands,
+        f32 accumulation), for decode prefill callers that ran
+        ``head=False`` and kept only each row's last prompt position."""
+        dt = self._head_operand_dtype
         kernel = params["Dense_0"]["kernel"].astype(dt)
         # bias quantized to compute_dtype BEFORE the add — exactly what
         # flax Dense's promote_dtype does, so prefill logits match the

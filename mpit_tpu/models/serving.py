@@ -351,9 +351,16 @@ class Server:
         # a row at the max_len frontier caps the segment for everyone —
         # transient: such a row's budget ends within those ticks. Round
         # DOWN to a power of two so compiled programs stay log-bounded.
+        frontier = min(self.model.max_len - len(r["known"]) for r in occ)
+        # ...and the LARGEST remaining budget caps it too (rounded UP to
+        # a power of two): when every occupied row needs <= n more
+        # tokens, ticks past bucket(n) are pure waste — the drain tail
+        # used to burn a full `segment` of them per round
+        need = max(r["max_new"] - r["gen"] for r in occ)
         cap = min(
             self.segment,
-            min(self.model.max_len - len(r["known"]) for r in occ),
+            1 << (frontier.bit_length() - 1),
+            1 << max(need - 1, 0).bit_length(),
         )
         seg = 1 << (cap.bit_length() - 1)
         dummy = self._stream_slice(occ[0], seg)

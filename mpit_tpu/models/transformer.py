@@ -315,6 +315,22 @@ class TransformerLM(nn.Module):
     # instead of logits — chunked prefill projects ONE row through the
     # vocab head (head_logits) rather than materializing (B, T, V) f32
     head: bool = True
+    # vocab-head OPERAND dtype override (None -> compute_dtype).
+    # Accumulation is always f32 regardless. Exists so the bf16-head
+    # quality guard (tests/test_head_dtype.py) can A/B the head in
+    # isolation; head_dtype=f32 also serves a bf16 model with a
+    # full-precision head when quality comparisons call for it.
+    head_dtype: Any = None
+
+    @property
+    def _head_operand_dtype(self):
+        """The ONE resolution of the head's operand dtype — shared by
+        the ``__call__`` head and ``head_logits`` so the prefill==tick
+        bit-equality the serving tests pin cannot fork on a rule edit."""
+        return (
+            self.compute_dtype if self.head_dtype is None
+            else self.head_dtype
+        )
 
     @nn.compact
     def __call__(self, tokens):
@@ -402,20 +418,22 @@ class TransformerLM(nn.Module):
         # framework isn't the bottleneck. For compute_dtype=float32
         # models (the equivalence-test configuration) this is bit-
         # identical to the previous all-f32 head.
-        table = embed.embedding.astype(dt)
+        hdt = self._head_operand_dtype
+        table = embed.embedding.astype(hdt)
         return jnp.einsum(
-            "btd,vd->btv", x, table, preferred_element_type=jnp.float32
+            "btd,vd->btv", x.astype(hdt), table,
+            preferred_element_type=jnp.float32,
         )
 
     def head_logits(self, params, h):
         """The tied vocab head applied to (B, d_model) hidden rows —
-        the SAME projection ``__call__`` ends with (compute_dtype
+        the SAME projection ``__call__`` ends with (head-operand-dtype
         operands, f32 accumulation), for callers that ran ``head=False``
         and kept only the rows they need (chunked prefill). The embed
         table's param path is pinned by a test against a full forward."""
-        dt = self.compute_dtype
-        table = params["Embed_0"]["embedding"].astype(dt)
+        hdt = self._head_operand_dtype
+        table = params["Embed_0"]["embedding"].astype(hdt)
         return jnp.einsum(
-            "bd,vd->bv", h.astype(dt), table,
+            "bd,vd->bv", h.astype(hdt), table,
             preferred_element_type=jnp.float32,
         )

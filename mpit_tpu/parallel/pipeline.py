@@ -326,6 +326,7 @@ class PipelineParallelTrainer:
         virtual: int = 2,
         optimizer=None,
         clip_norm: Optional[float] = None,
+        donate_state: bool = True,
     ):
         """``optimizer``: an optax GradientTransformation replacing the
         built-in SGD+momentum (``lr``/``momentum`` are then ignored).
@@ -764,6 +765,10 @@ class PipelineParallelTrainer:
         else:
             self._is_params_like = None
             state_spec = {"params": spec, "momentum": spec, "step": P()}
+        # state donated like every other trainer (params + opt/momentum
+        # update in place; without it each step keeps a second copy of
+        # the whole stage-sharded state alive) — donate_state=False for
+        # callers that re-step the same state object
         self._step = jax.jit(
             jax.shard_map(
                 train_step,
@@ -771,7 +776,8 @@ class PipelineParallelTrainer:
                 in_specs=(state_spec, P(dp_axis), P(dp_axis)),
                 out_specs=(state_spec, P()),
                 check_vma=False,
-            )
+            ),
+            donate_argnums=(0,) if donate_state else (),
         )
         self._dp_axis = dp_axis
 

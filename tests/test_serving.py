@@ -224,6 +224,30 @@ def test_segment_failure_poisons_server(topo8, monkeypatch):
     assert b not in done  # in-flight work is honestly lost
 
 
+def test_segment_caps_at_remaining_budget(topo8, monkeypatch):
+    """A huge segment setting must not burn wasted ticks when every
+    occupied row needs only a few more tokens: the segment caps at
+    bucket(max remaining budget) — and results stay solo-equal."""
+    from mpit_tpu.models import serving
+
+    segs = []
+    real = serving._serve_segment
+
+    def recording(model, seg, *a, **k):
+        segs.append(seg)
+        return real(model, seg, *a, **k)
+
+    monkeypatch.setattr(serving, "_serve_segment", recording)
+    model, params = _model_params()
+    srv = Server(model, params, max_batch=2, segment=32)
+    a = srv.submit([3, 1, 4], 3)   # needs 2 ticks after admission
+    b = srv.submit([2, 7], 5)      # needs 4
+    got = srv.drain()
+    assert segs and max(segs) <= 4, segs  # never a 32-tick segment
+    assert got[a] == _solo(model, params, [3, 1, 4], 3, jax.random.key(0))
+    assert got[b] == _solo(model, params, [2, 7], 5, jax.random.key(0))
+
+
 def test_drain_empty_and_reuse(topo8):
     model, params = _model_params()
     srv = Server(model, params, max_batch=2, segment=4)
