@@ -91,7 +91,8 @@ class LSTMLM(nn.Module):
         return self._head(x)
 
     def _head(self, x):
-        # vocab head: operands stay in compute_dtype (MXU fast path) but
+        # vocab head: operands stay in the head operand dtype (default
+        # compute_dtype — the MXU fast path; head_dtype overrides) but
         # ACCUMULATE in f32 — the large-vocab logits never get quantized
         # to bf16 on the way out (the plain Dense+astype recipe computed
         # a bf16 output first). Param tree unchanged: same Dense module,
@@ -112,10 +113,10 @@ class LSTMLM(nn.Module):
         ``head=False`` and kept only each row's last prompt position."""
         dt = self._head_operand_dtype
         kernel = params["Dense_0"]["kernel"].astype(dt)
-        # bias quantized to compute_dtype BEFORE the add — exactly what
-        # flax Dense's promote_dtype does, so prefill logits match the
-        # tick path bit for bit (a f32 bias here would shift near-tie
-        # argmaxes on the default bf16 model)
+        # bias quantized to the head operand dtype BEFORE the add —
+        # exactly what flax Dense's promote_dtype does in _head, so
+        # prefill logits match the tick path bit for bit (a f32 bias
+        # here would shift near-tie argmaxes on the default bf16 model)
         bias = params["Dense_0"]["bias"].astype(dt)
         out = lax.dot_general(
             h.astype(dt), kernel, (((1,), (0,)), ((), ())),

@@ -408,7 +408,8 @@ class TransformerLM(nn.Module):
         x = nn.LayerNorm(dtype=dt)(x)
         if not self.head:
             return x
-        # tied output head: operands in compute_dtype, ACCUMULATION in f32
+        # tied output head: operands in the head operand dtype (default
+        # compute_dtype; head_dtype overrides), ACCUMULATION in f32
         # (preferred_element_type). What must not happen is large-vocab
         # logits quantized to bf16 on output (Embed.attend's behavior);
         # f32 accumulation prevents that while keeping the matmul on the
