@@ -454,14 +454,23 @@ def _sample_rows(logits, row_keys, greedy, top_k, use_top_p, temp, top_p):
     or temperature scale -> :func:`_filter_logits` -> categorical, per
     row of ``logits`` (N, V) with ``row_keys`` (N,). A change here is a
     change to BOTH kernels — which is what keeps the prefill==tick
-    parity pinnable."""
+    parity pinnable.
+
+    ``temp``/``top_p`` may be scalars (every row the same rule — the
+    batch entry points) or (N,) vectors (per-row rules — the serving
+    path's per-request overrides). Row n's math is identical either
+    way, which is what keeps a mixed-rule Server row bit-equal to its
+    solo call."""
     if greedy:
         return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    n = logits.shape[0]
+    temps = jnp.broadcast_to(jnp.asarray(temp, jnp.float32), (n,))
+    tops = jnp.broadcast_to(jnp.asarray(top_p, jnp.float32), (n,))
     scaled = jax.vmap(
-        lambda l: _filter_logits(
-            l / temp, top_k, top_p if use_top_p else None
+        lambda l, t, p: _filter_logits(
+            l / t, top_k, p if use_top_p else None
         )
-    )(logits)
+    )(logits, temps, tops)
     return jax.vmap(jax.random.categorical)(
         row_keys, scaled
     ).astype(jnp.int32)

@@ -43,6 +43,7 @@ from typing import Optional
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 from mpit_tpu.models import sampling
 
@@ -127,9 +128,12 @@ class Server:
       max_batch: decode-slot count; queued requests wait for a free slot.
       segment: ticks per kernel call between scheduling points. Large
         segments amortize dispatch; small segments admit/retire sooner.
-      temperature/top_k/top_p/eos_id: the sampling rule, shared by every
-        request this server runs (per-request rules would recompile per
-        combination; serve different rules from different Servers).
+      temperature/top_k/top_p/eos_id: the default sampling rule and,
+        for the STATIC halves (greedy vs sampling, top-k, nucleus
+        on/off), the server's compiled-in mode. temperature/top_p
+        VALUES are traced per row, so :meth:`submit` can override them
+        per request without recompiling; changing mode or top_k needs a
+        different Server.
     """
 
     def __init__(
@@ -175,25 +179,49 @@ class Server:
         self._cache = None  # built lazily at first admission
         self._prev = None
         self._greedy = self.temperature == 0.0
-        self._temp = jnp.asarray(
-            max(self.temperature, 1e-9), jnp.float32
-        )
-        self._tp = jnp.asarray(
-            1.0 if top_p is None else top_p, jnp.float32
-        )
 
     # ------------------------------------------------------------- intake
 
     def submit(
-        self, prompt, max_new_tokens: int, rng=None, seed=None
+        self, prompt, max_new_tokens: int, rng=None, seed=None,
+        temperature=None, top_p=None,
     ) -> int:
         """Queue a request; returns its id. The request's sampling stream
         is fixed HERE (``rng``, or ``fold_in(server_rng, id)`` — matching
         ``generate_batch``'s per-row derivation), so results are
-        reproducible regardless of scheduling."""
+        reproducible regardless of scheduling.
+
+        ``temperature``/``top_p`` override the server defaults for THIS
+        request only (the values are traced, so mixed rules share one
+        compiled program; each row stays bit-equal to its solo call at
+        its own rule). The server's MODE is fixed at construction —
+        greedy vs sampling, top-k on/off, nucleus on/off are compiled
+        in — so a greedy server rejects temperature overrides and
+        ``top_p`` needs nucleus enabled at construction."""
+        if temperature is not None:
+            if self._greedy:
+                raise ValueError(
+                    "per-request temperature needs a sampling server "
+                    "(constructed with temperature > 0); greedy is a "
+                    "server-level mode"
+                )
+            if temperature <= 0:
+                raise ValueError(
+                    f"per-request temperature={temperature} must be > 0"
+                )
+        if top_p is not None and self.top_p is None:
+            raise ValueError(
+                "per-request top_p needs nucleus sampling enabled at "
+                "construction (top_p=...)"
+            )
+        # the ONE resolution of this request's effective rule — what is
+        # validated here is exactly what the kernels later execute
+        eff_temp = (
+            self.temperature if temperature is None else temperature
+        )
+        eff_tp = self.top_p if top_p is None else top_p
         sampling._validate(
-            self.model, prompt, self.temperature, self.top_k, self.top_p,
-            self.eos_id,
+            self.model, prompt, eff_temp, self.top_k, eff_tp, self.eos_id,
         )
         if max_new_tokens < 1:
             raise ValueError("max_new_tokens must be >= 1")
@@ -217,11 +245,30 @@ class Server:
             "p0": len(prompt),
             "max_new": int(max_new_tokens),
             "gen": 0,
+            # per-request rule values (server defaults when not given)
+            "temp": max(eff_temp, 1e-9),
+            "tp": 1.0 if eff_tp is None else eff_tp,
             # the request's ENTIRE stream, split once: generated token j
             # draws key j — solo-call parity under any scheduling
             "stream": jax.random.split(rng, max_new_tokens),
         })
         return rid
+
+    def cancel(self, request_id: int) -> bool:
+        """Abandon a request: drop it from the queue, or free its slot
+        mid-flight (tokens generated so far are discarded; the freed
+        slot admits the next waiter at the coming boundary). Returns
+        whether anything was cancelled — False for ids already
+        finished (their results stay in :meth:`results`) or unknown."""
+        for i, r in enumerate(self._waiting):
+            if r["id"] == request_id:
+                del self._waiting[i]
+                return True
+        for slot, r in enumerate(self._slots):
+            if r is not None and r["id"] == request_id:
+                self._slots[slot] = None
+                return True
+        return False
 
     # ---------------------------------------------------------- scheduling
 
@@ -266,8 +313,6 @@ class Server:
         K buckets to a power of two (compiles stay log-bounded in the
         burst size); pad rows repeat row 0's inputs and slot, so the
         scatter rewrites row 0's slot with identical data."""
-        import numpy as np
-
         if self._cache is None:
             self._cache = sampling._zero_cache(self._dec, self._nb)
             self._prev = jnp.zeros((self._nb,), jnp.int32)
@@ -279,24 +324,30 @@ class Server:
         pre_buf = np.zeros((kb, pre_bucket), np.int32)
         p_lens = np.zeros((kb,), np.int32)
         slots = np.zeros((kb,), np.int32)
+        temps = np.ones((kb,), np.float32)
+        tops = np.ones((kb,), np.float32)
         keys0 = []
         for i, (r, slot) in enumerate(grp):
             p = r["known"]
             pre_buf[i, : len(p)] = p
             p_lens[i] = len(p)
             slots[i] = slot
+            temps[i] = r["temp"]
+            tops[i] = r["tp"]
             keys0.append(r["stream"][0])
         for i in range(k, kb):  # pad rows mirror row 0 exactly
             pre_buf[i] = pre_buf[0]
             p_lens[i] = p_lens[0]
             slots[i] = slots[0]
+            temps[i] = temps[0]
+            tops[i] = tops[0]
             keys0.append(grp[0][0]["stream"][0])
         rows, tok0 = _prefill_rows(
             self._dec, pre_bucket, self._greedy, self.top_k,
             self.top_p is not None,
             self.params, sampling._zero_cache(self._dec, kb),
             jnp.asarray(pre_buf), jnp.asarray(p_lens),
-            jnp.stack(keys0), self._temp, self._tp,
+            jnp.stack(keys0), jnp.asarray(temps), jnp.asarray(tops),
         )
         self._cache = _insert_rows(self._cache, rows, jnp.asarray(slots))
         self._prev = self._prev.at[jnp.asarray(slots[:k])].set(
@@ -368,11 +419,19 @@ class Server:
             self._stream_slice(r, seg) if r is not None else dummy
             for r in self._slots
         ])
+        temps = np.array(
+            [1.0 if r is None else r["temp"] for r in self._slots],
+            np.float32,
+        )
+        tops = np.array(
+            [1.0 if r is None else r["tp"] for r in self._slots],
+            np.float32,
+        )
         self._cache, self._prev, toks = _serve_segment(
             self._dec, seg, self._greedy, self.top_k,
             self.top_p is not None,
             self.params, self._cache, self._prev, keys,
-            self._temp, self._tp,
+            jnp.asarray(temps), jnp.asarray(tops),
         )
         self.segments_run += 1
         host = jax.device_get(toks)

@@ -158,10 +158,11 @@ def test_serve_segment_compiles_clean_and_donates(topo8):
     cache = sampling._zero_cache(srv._dec, srv._nb)
     prev = jnp.zeros((srv._nb,), jnp.int32)
     keys = jnp.stack([jax.random.split(jax.random.key(0), 4)] * srv._nb)
+    ones = jnp.ones((srv._nb,), jnp.float32)
     txt = _compiled_text(
         serving._serve_segment,
         srv._dec, 4, True, None, False,
-        params, cache, prev, keys, srv._temp, srv._tp,
+        params, cache, prev, keys, ones, ones,
     )
     _assert_clean(txt)
     want = len(jax.tree.leaves(cache)) + 1  # +1: the prev-token buffer

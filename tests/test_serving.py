@@ -224,6 +224,72 @@ def test_segment_failure_poisons_server(topo8, monkeypatch):
     assert b not in done  # in-flight work is honestly lost
 
 
+def test_per_request_sampling_rules_equal_solo_calls(topo8):
+    """One Server, heterogeneous rules: each request's temperature /
+    top_p override rides a traced (NB,) vector through the SAME
+    compiled segment program, and every row stays bit-equal to its
+    solo call at its own rule."""
+    from mpit_tpu.models import serving
+
+    model, params = _model_params()
+    srv = Server(model, params, max_batch=2, segment=3,
+                 temperature=0.9, top_p=0.8)
+    rules = [dict(temperature=0.5, top_p=0.95), dict(temperature=1.3),
+             dict(top_p=0.6), dict()]
+    want = {}
+    for i, ((prompt, mn), rule) in enumerate(zip(REQS, rules)):
+        rng = jax.random.key(200 + i)
+        rid = srv.submit(prompt, mn, rng=rng, **rule)
+        want[rid] = _solo(
+            model, params, prompt, mn, rng,
+            temperature=rule.get("temperature", 0.9),
+            top_p=rule.get("top_p", 0.8),
+        )
+    n0 = serving._serve_segment._cache_size()
+    got = srv.drain()
+    for rid in want:
+        assert got[rid] == want[rid], rid
+    # mixed rules never forked the program (one (NB,) vector arg)
+    assert serving._serve_segment._cache_size() == n0 + 1
+
+
+def test_per_request_rule_validation(topo8):
+    model, params = _model_params()
+    greedy_srv = Server(model, params)
+    with pytest.raises(ValueError, match="server-level mode"):
+        greedy_srv.submit([1], 2, temperature=0.7)
+    sampling_srv = Server(model, params, temperature=0.8)
+    with pytest.raises(ValueError, match="must be > 0"):
+        sampling_srv.submit([1], 2, temperature=0.0)
+    with pytest.raises(ValueError, match="nucleus"):
+        sampling_srv.submit([1], 2, top_p=0.5)
+    with pytest.raises(ValueError, match="top_p"):
+        Server(model, params, temperature=0.8, top_p=0.9) \
+            .submit([1], 2, top_p=1.5)
+
+
+def test_cancel(topo8):
+    """Cancelling drops queued requests before they cost a prefill and
+    frees in-flight slots; finished/unknown ids return False and
+    survivors stay solo-equal."""
+    model, params = _model_params()
+    srv = Server(model, params, max_batch=1, segment=4)
+    a = srv.submit(*REQS[0])
+    b = srv.submit(*REQS[1])   # waits behind a (one slot)
+    c = srv.submit(*REQS[2])
+    assert srv.cancel(b)       # cancelled while queued
+    srv.step()                 # a mid-flight now
+    assert srv.cancel(a)       # cancelled mid-flight, slot freed
+    got = srv.drain()
+    assert set(got) == {c}
+    assert got[c] == _solo(
+        model, params, *REQS[2], jax.random.key(0)
+    )
+    assert not srv.cancel(c)   # already finished
+    assert not srv.cancel(999)  # unknown
+    assert srv.pending == 0
+
+
 def test_segment_caps_at_remaining_budget(topo8, monkeypatch):
     """A huge segment setting must not burn wasted ticks when every
     occupied row needs only a few more tokens: the segment caps at
