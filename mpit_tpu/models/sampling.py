@@ -424,6 +424,25 @@ def beam_search(
     return _truncate_at_eos(seq, len(prompt), eos_id), float(scores[best])
 
 
+def _prefill_chunk(model, params, cache0, pre_buf, p_lens):
+    """The ONE padded-prefill recipe (shared by the batch decode kernel,
+    the Server's admission prefill, and the speculative decoder): run
+    the prompt buffer as a dense ``head=False`` chunk, undo the padded
+    rows' counter over-advance (:func:`_fix_cache_indices`, vector
+    ``p_lens`` — per-row clocks land at each row's OWN prompt length),
+    and project each row's last PROMPT hidden state through the vocab
+    head — never materializing (N, pre_bucket, V) f32 logits.
+
+    Returns ``(cache, last_logits)`` — last_logits is (N, V), the
+    distribution for each row's first generated token."""
+    hidden, mut = model.clone(head=False).apply(
+        {"params": params, "cache": cache0}, pre_buf, mutable=["cache"]
+    )
+    cache = _fix_cache_indices(mut["cache"], p_lens)
+    h_last = jax.vmap(lambda h, n: h[n - 1])(hidden, p_lens)  # (N, d)
+    return cache, model.head_logits(params, h_last)
+
+
 def _fix_cache_indices(cache, p_len):
     """Rewrite every position-counter leaf (per-block ``cache_index``,
     the LM's ``pos_index``) to ``p_len`` after a PADDED prefill chunk:
@@ -507,13 +526,7 @@ def _prefill_decode_scan(
     ever reads it after the scan. Reusing the returned cache would
     break invariant (b).
     """
-    hidden, mut = model.clone(head=False).apply(
-        {"params": params, "cache": cache0}, pre_buf, mutable=["cache"]
-    )
-    cache = _fix_cache_indices(mut["cache"], p_lens)
-    # each row's last PROMPT hidden state — at its own position
-    h_last = jax.vmap(lambda h, n: h[n - 1])(hidden, p_lens)  # (N, d)
-    last = model.head_logits(params, h_last)  # (N, V)
+    cache, last = _prefill_chunk(model, params, cache0, pre_buf, p_lens)
 
     tok0 = _sample_rows(
         last, keys[:, 0], greedy, top_k, use_top_p, temp, top_p

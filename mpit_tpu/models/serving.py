@@ -59,12 +59,9 @@ def _prefill_rows(
     each row's first sampled token (that request's stream key 0 — the
     same key the batch kernel would have used). A burst of K arrivals
     costs one prefill call, not K (pinned in tests/test_serving.py)."""
-    hidden, mut = model.clone(head=False).apply(
-        {"params": params, "cache": cache0}, pre_buf, mutable=["cache"]
+    cache, last = sampling._prefill_chunk(
+        model, params, cache0, pre_buf, p_lens
     )
-    cache = sampling._fix_cache_indices(mut["cache"], p_lens)
-    h_last = jax.vmap(lambda h, n: h[n - 1])(hidden, p_lens)
-    last = model.head_logits(params, h_last)  # (K, V)
     tok0 = sampling._sample_rows(
         last, keys0, greedy, top_k, use_top_p, temp, top_p
     )

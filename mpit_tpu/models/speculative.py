@@ -1,0 +1,197 @@
+"""Speculative decoding: a small draft LM proposes, the target verifies.
+
+Beyond-parity serving tier (the reference trains models and cannot
+sample at all; this accelerates the sampling tier the framework already
+has). Greedy speculative decoding with an EXACTNESS guarantee: the
+output is token-identical to :func:`~mpit_tpu.models.sampling.
+generate_fast`'s greedy decode of the target model alone, for ANY draft
+model — a bad draft only costs speed, never correctness. That contract
+is what makes the feature testable without hardware: the parity pin
+runs on the CPU mesh (tests/test_speculative.py).
+
+Why it is fast on TPU: plain decode is HBM-bound — every generated
+token re-reads all target weights for one token's worth of FLOPs.
+Here the target consumes the draft's k proposals (plus the pending
+token) as ONE (k+1)-token chunk through the SAME cached-attention
+kernel the chunked prefill uses (`transformer.py::_cached_attention`:
+a T-token chunk appends at each row's clock and masks causally), so
+one weight read scores k+1 positions. Accepted tokens advance the
+clock; a rejection rewinds both caches by resetting the per-row
+position counters (`sampling._fix_cache_indices`) — stale K/V beyond
+the clock is overwritten by the next chunk before any mask exposes it,
+the same invariant the padded prefill relies on.
+
+The whole loop — draft scan, target chunk, acceptance, rewind — is one
+jitted ``lax.while_loop``: zero host round-trips per token, one
+compiled program per (prompt-bucket, steps-bucket, k).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from mpit_tpu.models import sampling
+
+
+@functools.partial(jax.jit, static_argnums=(0, 1, 2, 3, 4))
+def _spec_loop(
+    tgt, dft, k, pre_bucket, gen_bucket,
+    t_params, d_params, t_cache, d_cache, pre_buf, p_len,
+):
+    """The compiled speculative loop (batch 1, greedy).
+
+    Invariant at the top of each iteration: both caches hold exactly
+    ``pos`` tokens' K/V (their counters say ``pos``), ``prev`` is the
+    last accepted token — not yet fed to either model — and
+    ``out[:n]`` holds the n tokens generated so far (so ``pos`` counts
+    the prompt plus the first n-1 generated tokens).
+    Each iteration emits m ∈ [1, k+1] tokens: the a accepted draft
+    proposals, then one target token (the correction, or the bonus
+    token the (k+1)-th chunk position yields when all k are accepted).
+    """
+    # prompt prefill, both models — the shared padded-prefill recipe
+    # (sampling._prefill_chunk: dense chunk, counters fixed to the true
+    # length, one head projection); the draft's prefill logits are
+    # irrelevant, only its filled cache matters
+    t_cache, t_last = sampling._prefill_chunk(
+        tgt, t_params, t_cache, pre_buf, p_len
+    )
+    d_cache, _ = sampling._prefill_chunk(
+        dft, d_params, d_cache, pre_buf, p_len
+    )
+    tok0 = jnp.argmax(t_last[0], -1).astype(jnp.int32)
+
+    out0 = jnp.zeros((gen_bucket + k + 1,), jnp.int32)
+    out0 = out0.at[0].set(tok0)
+
+    def draft_step(carry, _):
+        cache, prev = carry
+        logits, mut = dft.apply(
+            {"params": d_params, "cache": cache},
+            prev[None, None], mutable=["cache"],
+        )
+        nxt = jnp.argmax(logits[0, 0], -1).astype(jnp.int32)
+        return (mut["cache"], nxt), nxt
+
+    def body(carry):
+        t_cache, d_cache, prev, pos, n, it, out = carry
+        # draft proposes k tokens; one extra feed of d_k keeps the
+        # draft cache one step ahead so the bonus-token path below
+        # leaves it holding everything before the new prev
+        (d_cache, last_d), d = jax.lax.scan(
+            draft_step, (d_cache, prev), None, length=k
+        )
+        (d_cache, _), _ = draft_step((d_cache, last_d), None)
+        # target scores the (k+1)-chunk [prev, d_1..d_k] in one pass
+        chunk = jnp.concatenate([prev[None], d])[None]  # (1, k+1)
+        t_logits, t_mut = tgt.apply(
+            {"params": t_params, "cache": t_cache},
+            chunk, mutable=["cache"],
+        )
+        t_cache = t_mut["cache"]
+        t = jnp.argmax(t_logits[0], -1).astype(jnp.int32)  # (k+1,)
+        # a = accepted proposals; emitted tokens are exactly t[:a+1]
+        # (t_i == d_i for i < a; t_a is the correction/bonus)
+        match = jnp.cumprod((d == t[:k]).astype(jnp.int32))
+        a = jnp.sum(match)
+        m = a + 1
+        out = jax.lax.dynamic_update_slice(out, t, (n,))
+        # rewind both clocks to pos + m: everything before the new
+        # prev (= t[a], written into out at n + m - 1) is accepted
+        new_pos = pos + m
+        t_cache = sampling._fix_cache_indices(t_cache, new_pos)
+        d_cache = sampling._fix_cache_indices(d_cache, new_pos)
+        return (t_cache, d_cache, t[a], new_pos, n + m, it + 1, out)
+
+    def cond(carry):
+        return carry[4] < gen_bucket
+
+    _, _, _, _, n, iters, out = jax.lax.while_loop(
+        cond, body,
+        (t_cache, d_cache, tok0, p_len[0],
+         jnp.asarray(1, jnp.int32), jnp.asarray(0, jnp.int32), out0),
+    )
+    return out, n, iters
+
+
+def generate_speculative(
+    model,
+    params,
+    draft_model,
+    draft_params,
+    prompt,
+    steps: int,
+    k: int = 4,
+    eos_id: Optional[int] = None,
+    weights_dtype=None,
+    return_stats: bool = False,
+):
+    """Greedy-decode ``steps`` tokens from the target ``model``, with
+    ``draft_model`` proposing ``k`` tokens per verification chunk.
+
+    Output == ``generate_fast(model, params, prompt, steps,
+    eos_id=eos_id)`` token for token, for ANY draft (the exactness
+    contract; pinned in tests). Requirements: both models dense LMs
+    over the same vocab; ``len(prompt) + steps + k`` within BOTH
+    models' ``max_len`` (the last verification chunk may overhang by up
+    to k slots before the overrun is discarded).
+
+    ``return_stats``: also return ``{"iterations", "mean_emitted"}`` —
+    verification chunks run and tokens emitted per chunk (in [1, k+1];
+    the draft's usefulness, measured).
+    """
+    sampling._validate(model, prompt, 0.0, None, None, eos_id)
+    sampling._validate(draft_model, prompt, 0.0, None, None, None)
+    if draft_model.vocab_size != model.vocab_size:
+        raise ValueError(
+            f"draft vocab {draft_model.vocab_size} != target vocab "
+            f"{model.vocab_size}"
+        )
+    if k < 1:
+        raise ValueError(f"k={k} must be >= 1")
+    if steps <= 0:
+        seq0 = [int(t) for t in prompt]
+        return (seq0, {"iterations": 0, "mean_emitted": 0.0}) \
+            if return_stats else seq0
+    p0 = len(prompt)
+    for m, name in ((model, "target"), (draft_model, "draft")):
+        if p0 + steps + k > m.max_len:
+            raise ValueError(
+                f"prompt+steps+k = {p0 + steps + k} exceeds the {name} "
+                f"model's max_len={m.max_len} (the verification chunk "
+                "needs k slots of headroom)"
+            )
+    if weights_dtype is not None:
+        params = sampling.cast_weights(params, weights_dtype)
+        draft_params = sampling.cast_weights(draft_params, weights_dtype)
+    tgt = model.clone(decode=True, remat=False, seq_axis=None,
+                      attn_impl="xla")
+    dft = draft_model.clone(decode=True, remat=False, seq_axis=None,
+                            attn_impl="xla")
+    pre_bucket = sampling._bucket(p0, model.max_len)
+    gen_bucket = sampling._bucket(steps, model.max_len)
+    pre_buf = jnp.zeros((1, pre_bucket), jnp.int32)
+    pre_buf = pre_buf.at[0, :p0].set(jnp.asarray(prompt, jnp.int32))
+    out, n, iters = _spec_loop(
+        tgt, dft, k, pre_bucket, gen_bucket,
+        params, draft_params,
+        sampling._zero_cache(tgt, 1), sampling._zero_cache(dft, 1),
+        pre_buf, jnp.asarray([p0], jnp.int32),
+    )
+    seq = [int(t) for t in prompt] + [
+        int(t) for t in jax.device_get(out[:steps])
+    ]
+    seq = sampling._truncate_at_eos(seq, p0, eos_id)
+    if return_stats:
+        it = int(iters)
+        return seq, {
+            "iterations": it,
+            # n counts tok0 (from the prefill) plus every chunk's
+            # emissions; per-chunk usefulness excludes tok0
+            "mean_emitted": (int(n) - 1) / it if it else 0.0,
+        }
+    return seq

@@ -1,0 +1,143 @@
+"""Speculative decoding: the exactness contract IS the test.
+
+The one property that matters: for ANY draft model, the output equals
+the target-only greedy decode token for token — a bad draft costs
+speed, never correctness. Everything else (chunk scoring, cache
+rewind, the bonus token) is internal and covered by that pin.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from mpit_tpu.models import generate_fast, generate_speculative
+from mpit_tpu.models.transformer import TransformerLM
+
+V, T = 23, 128
+
+
+def _target():
+    return TransformerLM(
+        vocab_size=V, num_layers=2, d_model=32, num_heads=4, max_len=T,
+        compute_dtype=jnp.float32,
+    )
+
+
+def _draft(layers=1, d=16, heads=2):
+    return TransformerLM(
+        vocab_size=V, num_layers=layers, d_model=d, num_heads=heads,
+        max_len=T, compute_dtype=jnp.float32,
+    )
+
+
+def _init(model, seed):
+    return model.init(
+        jax.random.key(seed), jnp.zeros((1, 8), jnp.int32)
+    )["params"]
+
+
+PROMPTS = [[3, 1, 4, 1, 5], [7], [2, 7, 1, 8, 2, 8, 1, 8]]
+
+
+def test_exact_vs_target_greedy_any_draft(topo8):
+    """A smaller independently-initialized draft (realistic) and every
+    k: token-identical to the target-only greedy decode."""
+    tgt, dft = _target(), _draft()
+    tp, dp = _init(tgt, 0), _init(dft, 7)
+    for prompt in PROMPTS:
+        want = generate_fast(tgt, tp, prompt, 11)
+        for k in (1, 3, 4):
+            got = generate_speculative(tgt, tp, dft, dp, prompt, 11, k=k)
+            assert got == want, (prompt, k)
+
+
+def test_exact_with_adversarial_draft(topo8):
+    """Worst case — a garbage draft that agrees with the target on
+    nothing still yields the exact target output (just one accepted
+    token per chunk)."""
+    tgt = _target()
+    tp = _init(tgt, 0)
+    dft = _draft()
+    # random params, a different seed per leaf: maximally wrong draft
+    dp = jax.tree.map(
+        lambda a: jax.random.normal(
+            jax.random.key(int(np.prod(a.shape)) % 97), a.shape, a.dtype
+        ),
+        _init(dft, 1),
+    )
+    want = generate_fast(tgt, tp, PROMPTS[0], 9)
+    got = generate_speculative(tgt, tp, dft, dp, PROMPTS[0], 9, k=4)
+    assert got == want
+
+
+def test_perfect_draft_is_exact(topo8):
+    """Draft == target: every proposal accepted (plus the bonus token);
+    the result is still the pinned greedy decode."""
+    tgt = _target()
+    tp = _init(tgt, 0)
+    for steps in (4, 12):
+        want = generate_fast(tgt, tp, PROMPTS[0], steps)
+        got = generate_speculative(tgt, tp, tgt, tp, PROMPTS[0], steps, k=3)
+        assert got == want, steps
+
+
+def test_stats_reflect_draft_quality(topo8):
+    """Perfect draft: every chunk fully accepted (mean emitted k+1).
+    The stats are the measured usefulness of the draft — the quantity
+    the bench reports."""
+    tgt = _target()
+    tp = _init(tgt, 0)
+    _, stats = generate_speculative(
+        tgt, tp, tgt, tp, PROMPTS[0], 12, k=3, return_stats=True
+    )
+    assert stats["mean_emitted"] == 4.0  # k+1, every chunk
+    assert stats["iterations"] >= 3
+    dft = _draft()
+    _, stats2 = generate_speculative(
+        tgt, tp, dft, _init(dft, 7), PROMPTS[0], 12, k=3,
+        return_stats=True,
+    )
+    assert 1.0 <= stats2["mean_emitted"] <= 4.0
+
+
+def test_eos_truncation_matches(topo8):
+    tgt, dft = _target(), _draft()
+    tp, dp = _init(tgt, 0), _init(dft, 7)
+    probe = generate_fast(tgt, tp, PROMPTS[0], 10)
+    eos = probe[len(PROMPTS[0]) + 2]  # force a mid-stream eos
+    want = generate_fast(tgt, tp, PROMPTS[0], 10, eos_id=eos)
+    got = generate_speculative(
+        tgt, tp, dft, dp, PROMPTS[0], 10, k=3, eos_id=eos
+    )
+    assert got == want
+
+
+def test_weights_dtype_matches_fast_path(topo8):
+    tgt, dft = _target(), _draft()
+    tp, dp = _init(tgt, 0), _init(dft, 7)
+    want = generate_fast(tgt, tp, PROMPTS[0], 8,
+                         weights_dtype=jnp.bfloat16)
+    got = generate_speculative(
+        tgt, tp, dft, dp, PROMPTS[0], 8, k=3, weights_dtype=jnp.bfloat16
+    )
+    assert got == want
+
+
+def test_validation(topo8):
+    tgt = _target()
+    tp = _init(tgt, 0)
+    small_vocab = TransformerLM(
+        vocab_size=V - 1, num_layers=1, d_model=16, num_heads=2, max_len=T,
+        compute_dtype=jnp.float32,
+    )
+    sp = _init(small_vocab, 3)
+    with pytest.raises(ValueError, match="vocab"):
+        generate_speculative(tgt, tp, small_vocab, sp, [1], 4)
+    dft = _draft()
+    dp = _init(dft, 7)
+    with pytest.raises(ValueError, match="k=0"):
+        generate_speculative(tgt, tp, dft, dp, [1], 4, k=0)
+    with pytest.raises(ValueError, match="headroom"):
+        generate_speculative(tgt, tp, dft, dp, [1], T - 2, k=4)
+    assert generate_speculative(tgt, tp, dft, dp, [1, 2], 0) == [1, 2]
