@@ -902,10 +902,15 @@ def bench_spec(cpu_smoke: bool = False, k: int = 4) -> dict:
             t0 = time.perf_counter()
             fn()
             rates.append(steps / (time.perf_counter() - t0))
-        return float(np.median(rates))
+        med = float(np.median(rates))
+        spread = (
+            round((max(rates) - min(rates)) / med, 4)
+            if len(rates) > 1 else None
+        )
+        return med, spread
 
-    plain = time_fn(lambda: generate_fast(target, tp, prompt, steps))
-    spec = time_fn(lambda: generate_speculative(
+    plain, _ = time_fn(lambda: generate_fast(target, tp, prompt, steps))
+    spec, spread = time_fn(lambda: generate_speculative(
         target, tp, draft, dp, prompt, steps, k=k
     ))
     toks, stats = generate_speculative(
@@ -916,6 +921,8 @@ def bench_spec(cpu_smoke: bool = False, k: int = 4) -> dict:
     assert toks == generate_fast(target, tp, prompt, steps)
     return {
         "tokens_per_sec": spec,
+        "spread": spread,
+        "variance_flagged": bool(spread is not None and spread > 0.10),
         "plain_tokens_per_sec": round(plain, 1),
         "speedup": round(spec / plain, 3) if plain else None,
         "k": k,
@@ -1079,7 +1086,7 @@ def main():
             "spec_tokens_per_sec", "spec", res,
             ("plain_tokens_per_sec", "speedup", "k", "mean_emitted",
              "steps", "model"),
-            (),
+            ("spread",),
         )
         return
 

@@ -23,7 +23,10 @@ from mpit_tpu.models.sampling import (  # noqa: F401
 )
 from mpit_tpu.models.rnn_sampling import generate_rnn  # noqa: F401
 from mpit_tpu.models.serving import Server  # noqa: F401
-from mpit_tpu.models.speculative import generate_speculative  # noqa: F401
+from mpit_tpu.models.speculative import (  # noqa: F401
+    generate_speculative,
+    generate_speculative_batch,
+)
 
 _REGISTRY = {"lenet": LeNet, "mlp": MLP}
 

@@ -694,28 +694,36 @@ def _prep_rows(prompts, steps, rngs, max_len_cap):
     key streams (``split(rng_n, steps)``) padded to the generation
     bucket by repeating the last key (only discarded bucket-overrun
     ticks ever index the padding). The invariants here ARE the
-    batch==solo parity contract; keep them in one place."""
+    batch==solo parity contract; keep them in one place.
+
+    ``rngs=None`` (the greedy speculative path): skip the key streams
+    and return ``keys=None`` — everything else is identical."""
     import numpy as np
 
     if isinstance(rngs, (list, tuple)):
         rngs = jnp.stack(list(rngs))
     n = len(prompts)
     nb = _bucket(n, 1 << 30)  # rows have no cap — pad rows are sliced away
-    if nb > n:  # pad rows reuse row 0's rng; outputs are discarded
+    if rngs is not None and nb > n:
+        # pad rows reuse row 0's rng; outputs are discarded
         rngs = jnp.concatenate(
             [rngs, jnp.repeat(rngs[:1], nb - n, axis=0)]
         )
-    keys = jax.vmap(
-        lambda k: jax.random.split(k, max(steps, 1))
-    )(rngs)
     pre_bucket = _bucket(max(len(q) for q in prompts), max_len_cap)
     gen_bucket = _bucket(steps, max_len_cap)
-    if keys.shape[1] < gen_bucket:
-        keys = jnp.concatenate(
-            [keys,
-             jnp.repeat(keys[:, -1:], gen_bucket - keys.shape[1], axis=1)],
-            axis=1,
-        )
+    keys = None
+    if rngs is not None:
+        keys = jax.vmap(
+            lambda k: jax.random.split(k, max(steps, 1))
+        )(rngs)
+        if keys.shape[1] < gen_bucket:
+            keys = jnp.concatenate(
+                [keys,
+                 jnp.repeat(
+                     keys[:, -1:], gen_bucket - keys.shape[1], axis=1
+                 )],
+                axis=1,
+            )
     pre_host = np.zeros((nb, pre_bucket), np.int32)
     for i, q in enumerate(prompts):
         pre_host[i, : len(q)] = q

@@ -40,80 +40,93 @@ from mpit_tpu.models import sampling
 @functools.partial(jax.jit, static_argnums=(0, 1, 2, 3, 4))
 def _spec_loop(
     tgt, dft, k, pre_bucket, gen_bucket,
-    t_params, d_params, t_cache, d_cache, pre_buf, p_len,
+    t_params, d_params, t_cache, d_cache, pre_buf, p_lens,
 ):
-    """The compiled speculative loop (batch 1, greedy).
+    """The compiled speculative loop (N rows, greedy — every per-row
+    quantity rides the per-row cache clocks).
 
-    Invariant at the top of each iteration: both caches hold exactly
-    ``pos`` tokens' K/V (their counters say ``pos``), ``prev`` is the
-    last accepted token — not yet fed to either model — and
-    ``out[:n]`` holds the n tokens generated so far (so ``pos`` counts
-    the prompt plus the first n-1 generated tokens).
-    Each iteration emits m ∈ [1, k+1] tokens: the a accepted draft
-    proposals, then one target token (the correction, or the bonus
-    token the (k+1)-th chunk position yields when all k are accepted).
+    Invariant at the top of each iteration, PER ROW r: both caches hold
+    exactly ``pos[r]`` tokens' K/V for row r (the per-row counters say
+    so), ``prev[r]`` is row r's last accepted token — not yet fed to
+    either model — and ``out[r, :n[r]]`` holds its generated tokens.
+    Each iteration emits m[r] ∈ [1, k+1] tokens per ACTIVE row: the
+    a[r] accepted draft proposals, then one target token (correction,
+    or the bonus token the (k+1)-th chunk position yields when all k
+    are accepted). Rows that reached their budget freeze (m = 0): they
+    keep riding the batch — their rewound clocks make every later
+    chunk rewrite the same discarded slots — while the loop runs until
+    EVERY row is done. Row independence (each row's outputs depend
+    only on its own tokens and clock) is what keeps a row's result
+    identical whatever the other rows do — the same property the
+    serving batch==solo tests pin.
     """
-    # prompt prefill, both models — the shared padded-prefill recipe
-    # (sampling._prefill_chunk: dense chunk, counters fixed to the true
-    # length, one head projection); the draft's prefill logits are
-    # irrelevant, only its filled cache matters
+    nb = pre_buf.shape[0]
     t_cache, t_last = sampling._prefill_chunk(
-        tgt, t_params, t_cache, pre_buf, p_len
+        tgt, t_params, t_cache, pre_buf, p_lens
     )
     d_cache, _ = sampling._prefill_chunk(
-        dft, d_params, d_cache, pre_buf, p_len
+        dft, d_params, d_cache, pre_buf, p_lens
     )
-    tok0 = jnp.argmax(t_last[0], -1).astype(jnp.int32)
+    tok0 = jnp.argmax(t_last, -1).astype(jnp.int32)  # (nb,)
 
-    out0 = jnp.zeros((gen_bucket + k + 1,), jnp.int32)
-    out0 = out0.at[0].set(tok0)
+    out0 = jnp.zeros((nb, gen_bucket + k + 1), jnp.int32)
+    out0 = out0.at[:, 0].set(tok0)
 
     def draft_step(carry, _):
         cache, prev = carry
         logits, mut = dft.apply(
             {"params": d_params, "cache": cache},
-            prev[None, None], mutable=["cache"],
+            prev[:, None], mutable=["cache"],
         )
-        nxt = jnp.argmax(logits[0, 0], -1).astype(jnp.int32)
+        nxt = jnp.argmax(logits[:, 0], -1).astype(jnp.int32)
         return (mut["cache"], nxt), nxt
 
     def body(carry):
         t_cache, d_cache, prev, pos, n, it, out = carry
-        # draft proposes k tokens; one extra feed of d_k keeps the
-        # draft cache one step ahead so the bonus-token path below
-        # leaves it holding everything before the new prev
+        active = n < gen_bucket  # (nb,)
+        # draft proposes k tokens per row; one extra feed of d_k keeps
+        # the draft cache one step ahead so the bonus-token path below
+        # leaves it holding everything before each row's new prev
         (d_cache, last_d), d = jax.lax.scan(
             draft_step, (d_cache, prev), None, length=k
         )
         (d_cache, _), _ = draft_step((d_cache, last_d), None)
-        # target scores the (k+1)-chunk [prev, d_1..d_k] in one pass
-        chunk = jnp.concatenate([prev[None], d])[None]  # (1, k+1)
+        d = d.swapaxes(0, 1)  # (nb, k)
+        # target scores each row's (k+1)-chunk [prev, d_1..d_k]
+        chunk = jnp.concatenate([prev[:, None], d], axis=1)
         t_logits, t_mut = tgt.apply(
             {"params": t_params, "cache": t_cache},
             chunk, mutable=["cache"],
         )
         t_cache = t_mut["cache"]
-        t = jnp.argmax(t_logits[0], -1).astype(jnp.int32)  # (k+1,)
-        # a = accepted proposals; emitted tokens are exactly t[:a+1]
+        t = jnp.argmax(t_logits, -1).astype(jnp.int32)  # (nb, k+1)
+        # a[r] = accepted proposals; row r emits exactly t[r, :a+1]
         # (t_i == d_i for i < a; t_a is the correction/bonus)
-        match = jnp.cumprod((d == t[:k]).astype(jnp.int32))
-        a = jnp.sum(match)
-        m = a + 1
-        out = jax.lax.dynamic_update_slice(out, t, (n,))
-        # rewind both clocks to pos + m: everything before the new
-        # prev (= t[a], written into out at n + m - 1) is accepted
+        match = jnp.cumprod((d == t[:, :k]).astype(jnp.int32), axis=1)
+        a = jnp.sum(match, axis=1)
+        m = jnp.where(active, a + 1, 0)
+        # each row writes its chunk at its OWN cursor; frozen rows'
+        # writes clamp into the discard margin past gen_bucket
+        out = jax.vmap(
+            lambda row, tr, nr: jax.lax.dynamic_update_slice(
+                row, tr, (nr,)
+            )
+        )(out, t, jnp.where(active, n, gen_bucket))
         new_pos = pos + m
         t_cache = sampling._fix_cache_indices(t_cache, new_pos)
         d_cache = sampling._fix_cache_indices(d_cache, new_pos)
-        return (t_cache, d_cache, t[a], new_pos, n + m, it + 1, out)
+        new_prev = jnp.where(active, t[jnp.arange(nb), a], prev)
+        return (
+            t_cache, d_cache, new_prev, new_pos, n + m, it + 1, out
+        )
 
     def cond(carry):
-        return carry[4] < gen_bucket
+        return jnp.any(carry[4] < gen_bucket)
 
     _, _, _, _, n, iters, out = jax.lax.while_loop(
         cond, body,
-        (t_cache, d_cache, tok0, p_len[0],
-         jnp.asarray(1, jnp.int32), jnp.asarray(0, jnp.int32), out0),
+        (t_cache, d_cache, tok0, p_lens,
+         jnp.ones((nb,), jnp.int32), jnp.asarray(0, jnp.int32), out0),
     )
     return out, n, iters
 
@@ -144,8 +157,47 @@ def generate_speculative(
     verification chunks run and tokens emitted per chunk (in [1, k+1];
     the draft's usefulness, measured).
     """
-    sampling._validate(model, prompt, 0.0, None, None, eos_id)
-    sampling._validate(draft_model, prompt, 0.0, None, None, None)
+    rows, stats = _run_spec(
+        model, params, draft_model, draft_params, [prompt], steps, k,
+        eos_id, weights_dtype,
+    )
+    return (rows[0], stats) if return_stats else rows[0]
+
+
+def generate_speculative_batch(
+    model,
+    params,
+    draft_model,
+    draft_params,
+    prompts,
+    steps: int,
+    k: int = 4,
+    eos_id: Optional[int] = None,
+    weights_dtype=None,
+):
+    """N prompts through ONE compiled speculative loop — each row
+    accepts at its own rate on its own clock (rows that finish freeze
+    and ride along), and row n is pinned equal to its solo
+    :func:`generate_speculative` call, hence to the target-only greedy
+    decode. Row counts and lengths bucket to powers of two; pad rows
+    mirror row 0 and are discarded."""
+    if len(prompts) == 0:
+        return []
+    rows, _ = _run_spec(
+        model, params, draft_model, draft_params, list(prompts), steps,
+        k, eos_id, weights_dtype,
+    )
+    return rows
+
+
+def _run_spec(
+    model, params, draft_model, draft_params, prompts, steps, k,
+    eos_id, weights_dtype,
+):
+    """Shared prologue + kernel call for the solo and batch entries."""
+    for q in prompts:
+        sampling._validate(model, q, 0.0, None, None, eos_id)
+        sampling._validate(draft_model, q, 0.0, None, None, None)
     if draft_model.vocab_size != model.vocab_size:
         raise ValueError(
             f"draft vocab {draft_model.vocab_size} != target vocab "
@@ -154,16 +206,15 @@ def generate_speculative(
     if k < 1:
         raise ValueError(f"k={k} must be >= 1")
     if steps <= 0:
-        seq0 = [int(t) for t in prompt]
-        return (seq0, {"iterations": 0, "mean_emitted": 0.0}) \
-            if return_stats else seq0
-    p0 = len(prompt)
+        rows = [[int(t) for t in q] for q in prompts]
+        return rows, {"iterations": 0, "mean_emitted": 0.0}
+    longest = max(len(q) for q in prompts)
     for m, name in ((model, "target"), (draft_model, "draft")):
-        if p0 + steps + k > m.max_len:
+        if longest + steps + k > m.max_len:
             raise ValueError(
-                f"prompt+steps+k = {p0 + steps + k} exceeds the {name} "
-                f"model's max_len={m.max_len} (the verification chunk "
-                "needs k slots of headroom)"
+                f"prompt+steps+k = {longest + steps + k} exceeds the "
+                f"{name} model's max_len={m.max_len} (the verification "
+                "chunk needs k slots of headroom)"
             )
     if weights_dtype is not None:
         params = sampling.cast_weights(params, weights_dtype)
@@ -172,26 +223,37 @@ def generate_speculative(
                       attn_impl="xla")
     dft = draft_model.clone(decode=True, remat=False, seq_axis=None,
                             attn_impl="xla")
-    pre_bucket = sampling._bucket(p0, model.max_len)
-    gen_bucket = sampling._bucket(steps, model.max_len)
-    pre_buf = jnp.zeros((1, pre_bucket), jnp.int32)
-    pre_buf = pre_buf.at[0, :p0].set(jnp.asarray(prompt, jnp.int32))
+    n_real = len(prompts)
+    # the shared row-batching prep (greedy: no key streams). Buckets
+    # cap at the SMALLER of the two max_lens — both caches consume the
+    # same prompt buffer, so the draft's cache must fit it too
+    nb, pre_bucket, gen_bucket, pre_buf, p_lens, _ = sampling._prep_rows(
+        prompts, steps, None, min(model.max_len, draft_model.max_len)
+    )
     out, n, iters = _spec_loop(
         tgt, dft, k, pre_bucket, gen_bucket,
         params, draft_params,
-        sampling._zero_cache(tgt, 1), sampling._zero_cache(dft, 1),
-        pre_buf, jnp.asarray([p0], jnp.int32),
+        sampling._zero_cache(tgt, nb), sampling._zero_cache(dft, nb),
+        pre_buf, p_lens,
     )
-    seq = [int(t) for t in prompt] + [
-        int(t) for t in jax.device_get(out[:steps])
+    host = jax.device_get(out)
+    rows = [
+        sampling._truncate_at_eos(
+            [int(t) for t in prompts[i]]
+            + [int(t) for t in host[i, :steps]],
+            len(prompts[i]), eos_id,
+        )
+        for i in range(n_real)
     ]
-    seq = sampling._truncate_at_eos(seq, p0, eos_id)
-    if return_stats:
-        it = int(iters)
-        return seq, {
-            "iterations": it,
-            # n counts tok0 (from the prefill) plus every chunk's
-            # emissions; per-chunk usefulness excludes tok0
-            "mean_emitted": (int(n) - 1) / it if it else 0.0,
-        }
-    return seq
+    it = int(iters)
+    total = int(jax.device_get(n).sum()) if it else 0
+    stats = {
+        "iterations": it,
+        # n counts each row's tok0 (from the prefill) plus every
+        # chunk's emissions; per-chunk usefulness excludes tok0. For
+        # nb rows the denominator is chunk-ROWS (it * nb) — pad and
+        # frozen rows drag the batch average down honestly (they ran
+        # the compute).
+        "mean_emitted": (total - nb) / (it * nb) if it else 0.0,
+    }
+    return rows, stats

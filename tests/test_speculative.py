@@ -11,7 +11,11 @@ import jax.numpy as jnp
 import numpy as np
 import pytest
 
-from mpit_tpu.models import generate_fast, generate_speculative
+from mpit_tpu.models import (
+    generate_fast,
+    generate_speculative,
+    generate_speculative_batch,
+)
 from mpit_tpu.models.transformer import TransformerLM
 
 V, T = 23, 128
@@ -82,6 +86,37 @@ def test_perfect_draft_is_exact(topo8):
         assert got == want, steps
 
 
+def test_batch_rows_equal_solo_calls(topo8):
+    """Mixed-length batch, one compiled loop: every row equals its solo
+    speculative call (hence the target-only greedy decode), no matter
+    how the OTHER rows' acceptance rates desync the clocks — including
+    the N=3 pad row."""
+    tgt, dft = _target(), _draft()
+    tp, dp = _init(tgt, 0), _init(dft, 7)
+    rows = generate_speculative_batch(
+        tgt, tp, dft, dp, PROMPTS, 10, k=3
+    )
+    assert len(rows) == len(PROMPTS)
+    for i, prompt in enumerate(PROMPTS):
+        assert rows[i] == generate_fast(tgt, tp, prompt, 10), i
+    assert generate_speculative_batch(tgt, tp, dft, dp, [], 5) == []
+
+
+def test_batch_eos_per_row(topo8):
+    """eos truncates each batch row at its own point, matching the solo
+    eos calls."""
+    tgt, dft = _target(), _draft()
+    tp, dp = _init(tgt, 0), _init(dft, 7)
+    probe = generate_fast(tgt, tp, PROMPTS[0], 10)
+    eos = probe[len(PROMPTS[0]) + 1]
+    prompts = [PROMPTS[0], [t for t in PROMPTS[2] if t != eos]]
+    rows = generate_speculative_batch(
+        tgt, tp, dft, dp, prompts, 10, k=4, eos_id=eos
+    )
+    for i, q in enumerate(prompts):
+        assert rows[i] == generate_fast(tgt, tp, q, 10, eos_id=eos), i
+
+
 def test_stats_reflect_draft_quality(topo8):
     """Perfect draft: every chunk fully accepted (mean emitted k+1).
     The stats are the measured usefulness of the draft — the quantity
@@ -141,3 +176,21 @@ def test_validation(topo8):
     with pytest.raises(ValueError, match="headroom"):
         generate_speculative(tgt, tp, dft, dp, [1], T - 2, k=4)
     assert generate_speculative(tgt, tp, dft, dp, [1, 2], 0) == [1, 2]
+
+
+def test_draft_with_smaller_max_len(topo8):
+    """A draft whose max_len is below the target's: prompt buckets must
+    fit the SMALLER cache (66 buckets to 128 under the target's cap —
+    which would overflow a 96-slot draft cache) while results stay
+    exact."""
+    tgt = _target()  # max_len 128
+    tp = _init(tgt, 0)
+    dft = TransformerLM(
+        vocab_size=V, num_layers=1, d_model=16, num_heads=2, max_len=96,
+        compute_dtype=jnp.float32,
+    )
+    dp = _init(dft, 7)
+    prompt = list(np.arange(66) % V)
+    want = generate_fast(tgt, tp, prompt, 20)
+    got = generate_speculative(tgt, tp, dft, dp, prompt, 20, k=4)
+    assert got == want
