@@ -739,7 +739,7 @@ def bench_decode(
 
 def bench_serve(
     cpu_smoke: bool = False, weights_dtype: str = None,
-    burst: bool = False,
+    burst: bool = False, prefix_len: int = 0,
 ) -> dict:
     """Continuous-batching throughput: sustained generated tokens/sec of
     ``models.serving.Server`` draining a queue of unequal requests
@@ -754,6 +754,10 @@ def bench_serve(
     prefills at a scheduling boundary) that the plain drain never
     exercises because its queue admits into free slots one segment at
     a time.
+
+    ``prefix_len``: share a prefix_len-token prompt prefix across every
+    request (the system-prompt regime) — the server prefills it once
+    into a cache template; admission pays suffix FLOPs only.
     """
     import jax
     import jax.numpy as jnp
@@ -786,9 +790,19 @@ def bench_serve(
     prompts = [
         rng.integers(0, dims["vocab_size"], p).tolist() for p, _ in reqs
     ]
+    prefix = (
+        rng.integers(0, dims["vocab_size"], prefix_len).tolist()
+        if prefix_len else None
+    )
+    if prefix_len:
+        # keep prefix + prompt + budget within max_len (>=1 so an
+        # impossible prefix fails loudly in submit, not silently here)
+        reqs = [(p, max(1, min(mn, dims["max_len"] - prefix_len - p - 1)))
+                for p, mn in reqs]
 
     def drain_once():
-        srv = Server(model, params, max_batch=max_batch, segment=segment)
+        srv = Server(model, params, max_batch=max_batch, segment=segment,
+                     prefix=prefix)
         pairs = list(zip(prompts, (mn for _, mn in reqs)))
         head = pairs[:max_batch] if burst else pairs
         for q, mn in head:
@@ -822,6 +836,7 @@ def bench_serve(
         "model": "transformer-large" if not cpu_smoke else "tiny",
         **({"weights_dtype": weights_dtype} if weights_dtype else {}),
         **({"admission": "burst"} if burst else {}),
+        **({"prefix_len": prefix_len} if prefix_len else {}),
     }
 
 
@@ -1067,15 +1082,18 @@ def main():
     if "--serve" in sys.argv:
         wd = weights_dtype_flag()
         burst = "--burst" in sys.argv
+        plen = int(flag_arg("--prefix-len") or 0)
         with trace(profile_dir):
-            res = bench_serve(cpu_smoke=cpu, weights_dtype=wd, burst=burst)
+            res = bench_serve(cpu_smoke=cpu, weights_dtype=wd, burst=burst,
+                              prefix_len=plen)
         emit_tokens_metric(
             "serve_tokens_per_sec",
-            "serve" + ("-bf16" if wd else "") + ("-burst" if burst else ""),
+            "serve" + ("-bf16" if wd else "") + ("-burst" if burst else "")
+            + (f"-prefix{plen}" if plen else ""),
             res,
             ("requests", "max_batch", "segment", "segments_per_drain",
              "model"),
-            ("weights_dtype", "spread", "admission"),
+            ("weights_dtype", "spread", "admission", "prefix_len"),
         )
         return
 

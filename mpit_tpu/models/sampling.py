@@ -424,7 +424,7 @@ def beam_search(
     return _truncate_at_eos(seq, len(prompt), eos_id), float(scores[best])
 
 
-def _prefill_chunk(model, params, cache0, pre_buf, p_lens):
+def _prefill_chunk(model, params, cache0, pre_buf, p_lens, clock0=0):
     """The ONE padded-prefill recipe (shared by the batch decode kernel,
     the Server's admission prefill, and the speculative decoder): run
     the prompt buffer as a dense ``head=False`` chunk, undo the padded
@@ -433,12 +433,20 @@ def _prefill_chunk(model, params, cache0, pre_buf, p_lens):
     and project each row's last PROMPT hidden state through the vocab
     head — never materializing (N, pre_bucket, V) f32 logits.
 
+    ``clock0`` (scalar): the position ``cache0`` is already filled to —
+    0 for a fresh cache; the prefix length when ``cache0`` is a
+    prefix-cache template (the Server's shared-prefix admission). The
+    chunk appends at the cache's own per-row clocks either way; clock0
+    only enters the counter fix-up (global position = clock0 + local
+    length) — ``p_lens`` stays LOCAL to this chunk, including the
+    last-hidden gather.
+
     Returns ``(cache, last_logits)`` — last_logits is (N, V), the
     distribution for each row's first generated token."""
     hidden, mut = model.clone(head=False).apply(
         {"params": params, "cache": cache0}, pre_buf, mutable=["cache"]
     )
-    cache = _fix_cache_indices(mut["cache"], p_lens)
+    cache = _fix_cache_indices(mut["cache"], clock0 + p_lens)
     h_last = jax.vmap(lambda h, n: h[n - 1])(hidden, p_lens)  # (N, d)
     return cache, model.head_logits(params, h_last)
 

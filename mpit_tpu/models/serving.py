@@ -51,21 +51,44 @@ from mpit_tpu.models import sampling
 @functools.partial(jax.jit, static_argnums=(0, 1, 2, 3, 4))
 def _prefill_rows(
     model, pre_bucket, greedy, top_k, use_top_p,
-    params, cache0, pre_buf, p_lens, keys0, temp, top_p,
+    params, cache0, pre_buf, p_lens, keys0, temp, top_p, clock0,
 ):
     """Admission: a GROUP of same-bucket prompts through the dense
     chunked prefill as ONE kernel (K rows) — returns their cache rows
-    (each row's counter at its OWN ``p_lens[i]``, per-row clocks) and
+    (each row's counter at its OWN global position, per-row clocks) and
     each row's first sampled token (that request's stream key 0 — the
     same key the batch kernel would have used). A burst of K arrivals
-    costs one prefill call, not K (pinned in tests/test_serving.py)."""
+    costs one prefill call, not K (pinned in tests/test_serving.py).
+
+    ``clock0``: 0 for a fresh cache; the prefix length when ``cache0``
+    rows are copies of the server's prefix-cache template (admission
+    then pays only the SUFFIX prompt's FLOPs)."""
     cache, last = sampling._prefill_chunk(
-        model, params, cache0, pre_buf, p_lens
+        model, params, cache0, pre_buf, p_lens, clock0
     )
     tok0 = sampling._sample_rows(
         last, keys0, greedy, top_k, use_top_p, temp, top_p
     )
     return cache, tok0
+
+
+@functools.partial(jax.jit, static_argnums=(0, 1))
+def _prefill_prefix(model, pre_bucket, params, cache0, pre_buf, p_len):
+    """Build the prefix-cache TEMPLATE: the shared prefix through the
+    dense prefill ONCE (batch 1, counters at the true prefix length).
+    Its logits are never sampled — every request must add at least one
+    prompt token, whose suffix prefill produces the first sample."""
+    cache, _ = sampling._prefill_chunk(model, params, cache0, pre_buf, p_len)
+    return cache
+
+
+@functools.partial(jax.jit, static_argnums=(0,))
+def _tile_rows(kb, tpl):
+    """The batch-1 template repeated into a kb-row cache tree (the
+    starting cache for a prefix-server admission group)."""
+    return jax.tree.map(
+        lambda x: jnp.repeat(x, kb, axis=0), tpl
+    )
 
 
 @functools.partial(jax.jit, donate_argnums=(0,))
@@ -131,6 +154,12 @@ class Server:
         VALUES are traced per row, so :meth:`submit` can override them
         per request without recompiling; changing mode or top_k needs a
         different Server.
+      prefix: optional shared prompt prefix (a system prompt). It
+        prefills ONCE into a batch-1 cache template (lazily, at first
+        admission); every request implicitly starts with it — results
+        include it and equal ``generate_fast(prefix + prompt, ...)`` —
+        and admission pays only the request's OWN prompt's FLOPs (the
+        template rows are copied, not recomputed).
     """
 
     def __init__(
@@ -145,11 +174,16 @@ class Server:
         eos_id: Optional[int] = None,
         weights_dtype=None,
         seed: int = 0,
+        prefix=None,
     ):
         if max_batch < 1:
             raise ValueError("max_batch must be >= 1")
         if segment < 1:
             raise ValueError("segment must be >= 1")
+        if prefix is not None and len(prefix) == 0:
+            prefix = None
+        if prefix is not None:
+            sampling._validate(model, prefix, 0.0, None, None, None)
         self.model = model
         self.params = (
             sampling.cast_weights(params, jnp.bfloat16)
@@ -175,6 +209,13 @@ class Server:
         self._slots: list = [None] * self._nb
         self._cache = None  # built lazily at first admission
         self._prev = None
+        # shared-prefix (prompt-cache) serving: the prefix prefills ONCE
+        # into a batch-1 template at first admission; every admission
+        # then starts from template copies and pays only its SUFFIX
+        self.prefix = (
+            [int(t) for t in prefix] if prefix is not None else None
+        )
+        self._template = None
         self._greedy = self.temperature == 0.0
 
     # ------------------------------------------------------------- intake
@@ -222,10 +263,12 @@ class Server:
         )
         if max_new_tokens < 1:
             raise ValueError("max_new_tokens must be >= 1")
-        if len(prompt) + max_new_tokens > self.model.max_len:
+        pfx = len(self.prefix) if self.prefix else 0
+        if pfx + len(prompt) + max_new_tokens > self.model.max_len:
             raise ValueError(
-                f"prompt ({len(prompt)}) + max_new_tokens "
-                f"({max_new_tokens}) exceeds max_len={self.model.max_len} "
+                f"prefix ({pfx}) + prompt ({len(prompt)}) + "
+                f"max_new_tokens ({max_new_tokens}) exceeds "
+                f"max_len={self.model.max_len} "
                 "(the cached decode cannot slide)"
             )
         self._check_poisoned()
@@ -238,8 +281,9 @@ class Server:
             )
         self._waiting.append({
             "id": rid,
-            "known": [int(t) for t in prompt],
-            "p0": len(prompt),
+            # full accepted sequence, prefix included — results equal
+            # generate_fast(prefix + prompt, ...) token for token
+            "known": (self.prefix or []) + [int(t) for t in prompt],
             "max_new": int(max_new_tokens),
             "gen": 0,
             # per-request rule values (server defaults when not given)
@@ -309,14 +353,35 @@ class Server:
         tokens into the resident tree; in-flight slots are untouched.
         K buckets to a power of two (compiles stay log-bounded in the
         burst size); pad rows repeat row 0's inputs and slot, so the
-        scatter rewrites row 0's slot with identical data."""
+        scatter rewrites row 0's slot with identical data.
+
+        With a server ``prefix``, each row's prefill covers only its
+        SUFFIX (the part after the shared prefix): the group's starting
+        cache is kb copies of the prefix template (built once, lazily)
+        and the chunk appends at the prefix clock — admission pays
+        suffix FLOPs, not prefix+suffix."""
         if self._cache is None:
             self._cache = sampling._zero_cache(self._dec, self._nb)
             self._prev = jnp.zeros((self._nb,), jnp.int32)
+        pfx = len(self.prefix) if self.prefix else 0
+        if self.prefix and self._template is None:
+            pb = sampling._bucket(pfx, self.model.max_len)
+            buf = np.zeros((1, pb), np.int32)
+            buf[0, :pfx] = self.prefix
+            self._template = _prefill_prefix(
+                self._dec, pb, self.params,
+                sampling._zero_cache(self._dec, 1),
+                jnp.asarray(buf), jnp.asarray([pfx], jnp.int32),
+            )
         k = len(grp)
         kb = sampling._bucket(k, 1 << 30)
+        # the suffix bucket must fit ABOVE the prefix clock: a chunk
+        # appended at position pfx may span at most max_len - pfx slots
+        # (a larger bucket would clamp the K/V write start, silently
+        # corrupting the prefix rows)
         pre_bucket = sampling._bucket(
-            max(len(r["known"]) for r, _ in grp), self.model.max_len
+            max(len(r["known"]) - pfx for r, _ in grp),
+            self.model.max_len - pfx,
         )
         pre_buf = np.zeros((kb, pre_bucket), np.int32)
         p_lens = np.zeros((kb,), np.int32)
@@ -325,7 +390,7 @@ class Server:
         tops = np.ones((kb,), np.float32)
         keys0 = []
         for i, (r, slot) in enumerate(grp):
-            p = r["known"]
+            p = r["known"][pfx:]  # the suffix (everything new)
             pre_buf[i, : len(p)] = p
             p_lens[i] = len(p)
             slots[i] = slot
@@ -339,12 +404,17 @@ class Server:
             temps[i] = temps[0]
             tops[i] = tops[0]
             keys0.append(grp[0][0]["stream"][0])
+        cache0 = (
+            _tile_rows(kb, self._template) if self.prefix
+            else sampling._zero_cache(self._dec, kb)
+        )
         rows, tok0 = _prefill_rows(
             self._dec, pre_bucket, self._greedy, self.top_k,
             self.top_p is not None,
-            self.params, sampling._zero_cache(self._dec, kb),
+            self.params, cache0,
             jnp.asarray(pre_buf), jnp.asarray(p_lens),
             jnp.stack(keys0), jnp.asarray(temps), jnp.asarray(tops),
+            jnp.asarray(pfx, jnp.int32),
         )
         self._cache = _insert_rows(self._cache, rows, jnp.asarray(slots))
         self._prev = self._prev.at[jnp.asarray(slots[:k])].set(
@@ -385,11 +455,16 @@ class Server:
             if self._slots[s] is None
         ]
         groups: dict[int, list] = {}
+        pfx = len(self.prefix) if self.prefix else 0
         for slot in free:
             if not self._waiting:
                 break
             r = self._waiting.popleft()
-            b = sampling._bucket(len(r["known"]), self.model.max_len)
+            # grouped by SUFFIX bucket — the part admission prefills
+            # (same max_len - pfx cap as _admit_group's chunk)
+            b = sampling._bucket(
+                len(r["known"]) - pfx, self.model.max_len - pfx
+            )
             groups.setdefault(b, []).append((r, slot))
         for grp in groups.values():
             self._admit_group(grp)

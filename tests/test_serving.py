@@ -290,6 +290,74 @@ def test_cancel(topo8):
     assert srv.pending == 0
 
 
+def test_prefix_cache_results_equal_solo_calls(topo8, monkeypatch):
+    """Shared-prefix serving: every request equals the solo call on
+    prefix + prompt; the prefix prefills exactly ONCE (template), and
+    each admission prefills only its SUFFIX bucket."""
+    from mpit_tpu.models import serving
+
+    model, params = _model_params()
+    prefix = [5, 4, 3, 2, 1, 2, 3, 4, 5, 6, 7, 8]  # 12 tokens
+    pfx_calls, buckets = [], []
+    real_pfx, real_rows = serving._prefill_prefix, serving._prefill_rows
+
+    def count_pfx(*a, **k):
+        pfx_calls.append(1)
+        return real_pfx(*a, **k)
+
+    def spy_rows(model_, pre_bucket, *a, **k):
+        buckets.append(pre_bucket)
+        return real_rows(model_, pre_bucket, *a, **k)
+
+    monkeypatch.setattr(serving, "_prefill_prefix", count_pfx)
+    monkeypatch.setattr(serving, "_prefill_rows", spy_rows)
+    kw = dict(temperature=0.8, top_k=5)
+    srv = Server(model, params, max_batch=2, segment=4, prefix=prefix,
+                 **kw)
+    rngs = {}
+    for i, (prompt, mn) in enumerate(REQS[:4]):
+        rng = jax.random.key(300 + i)
+        rngs[srv.submit(prompt, mn, rng=rng)] = (prompt, mn, rng)
+    got = srv.drain()
+    for rid, (prompt, mn, rng) in rngs.items():
+        want = _solo(model, params, prefix + prompt, mn, rng, **kw)
+        assert got[rid] == want, rid
+    assert len(pfx_calls) == 1  # the prefix prefilled once, ever
+    # admission paid suffix-sized buckets (max suffix here is 6 -> 8),
+    # never the prefix+prompt bucket (>= 16)
+    assert buckets and max(buckets) <= 8
+
+
+def test_long_prefix_near_max_len(topo8):
+    """The suffix bucket is capped at max_len - prefix_len: a long
+    prefix plus a prompt whose bucket would overhang the cache (70 + 33
+    -> bucket 64 would clamp at 128) must still decode exactly."""
+    model, params = _model_params()  # max_len = 64
+    prefix = [(i * 7 + 3) % V for i in range(36)]
+    prompt = [(i * 5 + 1) % V for i in range(17)]  # bucket(17)=32 > 64-36
+    srv = Server(model, params, max_batch=2, segment=4, prefix=prefix)
+    rid = srv.submit(prompt, 8)
+    got = srv.drain()
+    assert got[rid] == _solo(
+        model, params, prefix + prompt, 8, jax.random.key(0)
+    )
+
+
+def test_prefix_validation(topo8):
+    model, params = _model_params()
+    srv = Server(model, params, prefix=[1, 2, 3])
+    with pytest.raises(ValueError, match="prefix"):
+        srv.submit(list(range(10)), T - 10)  # prefix pushes past max_len
+    with pytest.raises(ValueError, match="vocab_size"):
+        Server(model, params, prefix=[999])
+    # empty prefix means no prefix
+    srv2 = Server(model, params, prefix=[])
+    a = srv2.submit([1, 2], 3)
+    assert srv2.drain()[a] == _solo(
+        model, params, [1, 2], 3, jax.random.key(0)
+    )
+
+
 def test_segment_caps_at_remaining_budget(topo8, monkeypatch):
     """A huge segment setting must not burn wasted ticks when every
     occupied row needs only a few more tokens: the segment caps at
