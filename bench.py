@@ -928,6 +928,38 @@ def bench_spec(cpu_smoke: bool = False, k: int = 4) -> dict:
     spec, spread = time_fn(lambda: generate_speculative(
         target, tp, draft, dp, prompt, steps, k=k
     ))
+
+    # the same trained pair through the CONTINUOUS-BATCHING tier:
+    # speculative Server vs plain Server on a queue of pattern prompts
+    from mpit_tpu.models import Server
+
+    x_rows, _ = pattern(8, 48, seed=1)
+    q_prompts = [[int(t) for t in row[:24]] for row in x_rows]
+    q_mn = min(steps, max_len - 24 - k - 1)
+
+    def drain(srv_kw):
+        # segment applies to the plain server only; the spec server's
+        # granularity is its spec_rounds
+        srv = Server(target, tp, max_batch=4, segment=16, **srv_kw)
+        for q in q_prompts:
+            srv.submit(q, q_mn)
+        srv.drain()
+        return len(q_prompts) * q_mn
+
+    def time_drain(srv_kw):
+        drain(srv_kw)  # compile + warmup
+        rates = []
+        for _ in range(legs):
+            t0 = time.perf_counter()
+            toks = drain(srv_kw)
+            rates.append(toks / (time.perf_counter() - t0))
+        return float(np.median(rates))
+
+    serve_plain = time_drain({})
+    serve_spec = time_drain(dict(
+        draft_model=draft, draft_params=dp, spec_k=k,
+        spec_rounds=4,
+    ))
     toks, stats = generate_speculative(
         target, tp, draft, dp, prompt, steps, k=k, return_stats=True
     )
@@ -943,6 +975,11 @@ def bench_spec(cpu_smoke: bool = False, k: int = 4) -> dict:
         "k": k,
         "mean_emitted": round(stats["mean_emitted"], 2),
         "steps": steps,
+        "serve_tokens_per_sec": round(serve_spec, 1),
+        "serve_plain_tokens_per_sec": round(serve_plain, 1),
+        "serve_speedup": (
+            round(serve_spec / serve_plain, 3) if serve_plain else None
+        ),
         "model": "512d-6L vs 128d-2L draft" if not cpu_smoke else "tiny",
     }
 
@@ -1103,7 +1140,8 @@ def main():
         emit_tokens_metric(
             "spec_tokens_per_sec", "spec", res,
             ("plain_tokens_per_sec", "speedup", "k", "mean_emitted",
-             "steps", "model"),
+             "steps", "serve_tokens_per_sec",
+             "serve_plain_tokens_per_sec", "serve_speedup", "model"),
             ("spread",),
         )
         return

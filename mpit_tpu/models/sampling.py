@@ -424,7 +424,9 @@ def beam_search(
     return _truncate_at_eos(seq, len(prompt), eos_id), float(scores[best])
 
 
-def _prefill_chunk(model, params, cache0, pre_buf, p_lens, clock0=0):
+def _prefill_chunk(
+    model, params, cache0, pre_buf, p_lens, clock0=0, with_head=True
+):
     """The ONE padded-prefill recipe (shared by the batch decode kernel,
     the Server's admission prefill, and the speculative decoder): run
     the prompt buffer as a dense ``head=False`` chunk, undo the padded
@@ -442,11 +444,16 @@ def _prefill_chunk(model, params, cache0, pre_buf, p_lens, clock0=0):
     last-hidden gather.
 
     Returns ``(cache, last_logits)`` — last_logits is (N, V), the
-    distribution for each row's first generated token."""
+    distribution for each row's first generated token; ``with_head=
+    False`` skips the vocab projection and returns ``(cache, None)``
+    for callers that only want the filled cache (prefix templates, the
+    speculative draft's admission)."""
     hidden, mut = model.clone(head=False).apply(
         {"params": params, "cache": cache0}, pre_buf, mutable=["cache"]
     )
     cache = _fix_cache_indices(mut["cache"], clock0 + p_lens)
+    if not with_head:
+        return cache, None
     h_last = jax.vmap(lambda h, n: h[n - 1])(hidden, p_lens)  # (N, d)
     return cache, model.head_logits(params, h_last)
 

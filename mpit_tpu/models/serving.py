@@ -74,11 +74,14 @@ def _prefill_rows(
 
 @functools.partial(jax.jit, static_argnums=(0, 1))
 def _prefill_prefix(model, pre_bucket, params, cache0, pre_buf, p_len):
-    """Build the prefix-cache TEMPLATE: the shared prefix through the
-    dense prefill ONCE (batch 1, counters at the true prefix length).
-    Its logits are never sampled — every request must add at least one
-    prompt token, whose suffix prefill produces the first sample."""
-    cache, _ = sampling._prefill_chunk(model, params, cache0, pre_buf, p_len)
+    """Cache-only prefill (no vocab projection — the logits would be
+    discarded): the prefix-cache TEMPLATE (batch 1, once) and the
+    speculative draft's admission rows (kb rows, every boundary) both
+    use it; the first sampled token always comes from a TARGET
+    prefill."""
+    cache, _ = sampling._prefill_chunk(
+        model, params, cache0, pre_buf, p_len, with_head=False
+    )
     return cache
 
 
@@ -89,6 +92,56 @@ def _tile_rows(kb, tpl):
     return jax.tree.map(
         lambda x: jnp.repeat(x, kb, axis=0), tpl
     )
+
+
+@functools.partial(
+    jax.jit, static_argnums=(0, 1, 2, 3), donate_argnums=(6, 7, 8)
+)
+def _serve_spec_segment(
+    tgt, dft, k, r_cap,
+    t_params, d_params, t_cache, d_cache, prev, pos0, rounds,
+):
+    """``rounds`` speculative rounds over the whole resident batch as
+    one program (the spec-server analogue of :func:`_serve_segment`'s
+    tick scan): each round every row drafts k tokens, verifies the
+    (k+1)-chunk through the target, accepts per row, and rewinds its
+    own clock (`speculative._spec_round` — the shared primitive).
+    Target cache, draft cache, and prev are DONATED residents.
+
+    ``rounds`` is TRACED (``lax.fori_loop``) so the host can cap it per
+    boundary — by the max_len frontier and the largest remaining budget
+    — without a recompile per value; ``r_cap`` (static) only sizes the
+    out buffer. ``pos0``: each row's cached-token count (len(known)-1;
+    free slots pass 0 — the round resets their garbage clocks, which
+    keeps them from ever drifting into the clamp zone).
+
+    Returns per row its emitted tokens (first ``n[r]`` entries of
+    ``out[r]``) — every row emits ``rounds <= n[r] <= rounds*(k+1)``
+    tokens; the host takes what each request's budget needs."""
+    from mpit_tpu.models.speculative import _spec_round
+
+    nb = prev.shape[0]
+    out0 = jnp.zeros((nb, r_cap * (k + 1)), jnp.int32)
+    active = jnp.ones((nb,), bool)
+
+    def round_body(_j, carry):
+        t_cache, d_cache, prev, pos, n, out = carry
+        t_cache, d_cache, prev, pos, t, _a, m = _spec_round(
+            tgt, dft, k, t_params, d_params,
+            t_cache, d_cache, prev, pos, active,
+        )
+        out = jax.vmap(
+            lambda row, tr, nr: jax.lax.dynamic_update_slice(
+                row, tr, (nr,)
+            )
+        )(out, t, n)
+        return (t_cache, d_cache, prev, pos, n + m, out)
+
+    t_cache, d_cache, prev, _pos, n, out = jax.lax.fori_loop(
+        0, rounds, round_body,
+        (t_cache, d_cache, prev, pos0, jnp.zeros((nb,), jnp.int32), out0),
+    )
+    return t_cache, d_cache, prev, out, n
 
 
 @functools.partial(jax.jit, donate_argnums=(0,))
@@ -148,6 +201,8 @@ class Server:
       max_batch: decode-slot count; queued requests wait for a free slot.
       segment: ticks per kernel call between scheduling points. Large
         segments amortize dispatch; small segments admit/retire sooner.
+        Speculative servers ignore it — their boundary granularity is
+        ``spec_rounds`` draft-verify rounds instead of ticks.
       temperature/top_k/top_p/eos_id: the default sampling rule and,
         for the STATIC halves (greedy vs sampling, top-k, nucleus
         on/off), the server's compiled-in mode. temperature/top_p
@@ -160,6 +215,14 @@ class Server:
         include it and equal ``generate_fast(prefix + prompt, ...)`` —
         and admission pays only the request's OWN prompt's FLOPs (the
         template rows are copied, not recomputed).
+      draft_model/draft_params: enable SPECULATIVE serving (greedy
+        servers only — the exactness contract needs target-argmax
+        verification): a resident draft cache rides beside the
+        target's, each scheduling round runs ``spec_rounds``-capped
+        batched draft-verify rounds (``spec_k`` proposals per round,
+        per-row acceptance — `speculative._spec_round`), and every
+        result stays bit-equal to its solo greedy call. Requests need
+        ``prompt + max_new + spec_k <= max_len`` (chunk headroom).
     """
 
     def __init__(
@@ -175,6 +238,10 @@ class Server:
         weights_dtype=None,
         seed: int = 0,
         prefix=None,
+        draft_model=None,
+        draft_params=None,
+        spec_k: int = 4,
+        spec_rounds: int = 4,
     ):
         if max_batch < 1:
             raise ValueError("max_batch must be >= 1")
@@ -184,6 +251,32 @@ class Server:
             prefix = None
         if prefix is not None:
             sampling._validate(model, prefix, 0.0, None, None, None)
+        if draft_model is not None:
+            # speculative serving is the greedy tier (the exactness
+            # contract needs target-argmax verification)
+            if temperature != 0.0 or top_k is not None or top_p is not None:
+                raise ValueError(
+                    "speculative serving (draft_model=...) is greedy: "
+                    "temperature must be 0 and top_k/top_p None"
+                )
+            if prefix is not None:
+                raise ValueError(
+                    "draft_model and prefix cannot combine yet — the "
+                    "draft cache has no prefix template"
+                )
+            if draft_model.vocab_size != model.vocab_size:
+                raise ValueError(
+                    f"draft vocab {draft_model.vocab_size} != target "
+                    f"vocab {model.vocab_size}"
+                )
+            if draft_model.max_len < model.max_len:
+                raise ValueError(
+                    "draft max_len must cover the target's (both caches "
+                    f"hold the same sequence): {draft_model.max_len} < "
+                    f"{model.max_len}"
+                )
+            if spec_k < 1 or spec_rounds < 1:
+                raise ValueError("spec_k and spec_rounds must be >= 1")
         self.model = model
         self.params = (
             sampling.cast_weights(params, jnp.bfloat16)
@@ -217,6 +310,21 @@ class Server:
         )
         self._template = None
         self._greedy = self.temperature == 0.0
+        # speculative serving: resident DRAFT cache beside the target's
+        self.spec_k = int(spec_k)
+        self.spec_rounds = int(spec_rounds)
+        self._dft = (
+            draft_model.clone(
+                decode=True, remat=False, seq_axis=None, attn_impl="xla"
+            ) if draft_model is not None else None
+        )
+        self._d_params = (
+            sampling.cast_weights(draft_params, jnp.bfloat16)
+            if draft_params is not None
+            and weights_dtype in ("bf16", jnp.bfloat16)
+            else draft_params
+        )
+        self._d_cache = None
 
     # ------------------------------------------------------------- intake
 
@@ -270,6 +378,17 @@ class Server:
                 f"max_new_tokens ({max_new_tokens}) exceeds "
                 f"max_len={self.model.max_len} "
                 "(the cached decode cannot slide)"
+            )
+        if (
+            self._dft is not None
+            and len(prompt) + max_new_tokens + self.spec_k
+            > self.model.max_len
+        ):
+            raise ValueError(
+                f"prompt + max_new_tokens + spec_k = "
+                f"{len(prompt) + max_new_tokens + self.spec_k} exceeds "
+                f"max_len={self.model.max_len} (the verification chunk "
+                "needs spec_k slots of headroom)"
             )
         self._check_poisoned()
         rid = self._next_id
@@ -417,6 +536,20 @@ class Server:
             jnp.asarray(pfx, jnp.int32),
         )
         self._cache = _insert_rows(self._cache, rows, jnp.asarray(slots))
+        if self._dft is not None:
+            # the DRAFT cache prefills the same prompts (its logits are
+            # never sampled — only its filled rows matter) and scatters
+            # into the resident draft tree at the same slots
+            if self._d_cache is None:
+                self._d_cache = sampling._zero_cache(self._dft, self._nb)
+            d_rows = _prefill_prefix(
+                self._dft, pre_bucket, self._d_params,
+                sampling._zero_cache(self._dft, kb),
+                jnp.asarray(pre_buf), jnp.asarray(p_lens),
+            )
+            self._d_cache = _insert_rows(
+                self._d_cache, d_rows, jnp.asarray(slots)
+            )
         self._prev = self._prev.at[jnp.asarray(slots[:k])].set(
             tok0[:k].astype(jnp.int32)
         )
@@ -471,6 +604,9 @@ class Server:
         occ = self._occupied()
         if not occ:
             return
+        if self._dft is not None:
+            self._spec_step(occ)
+            return
         # a row at the max_len frontier caps the segment for everyone —
         # transient: such a row's budget ends within those ticks. Round
         # DOWN to a power of two so compiled programs stay log-bounded.
@@ -506,11 +642,16 @@ class Server:
             jnp.asarray(temps), jnp.asarray(tops),
         )
         self.segments_run += 1
-        host = jax.device_get(toks)
+        self._harvest(jax.device_get(toks), [seg] * self._nb)
+
+    def _harvest(self, host, avail) -> None:
+        """The ONE retirement convention both segment flavors share:
+        append up to ``avail[slot]`` harvested tokens per occupied row
+        (capped by its remaining budget), retire on eos or budget."""
         for slot, r in enumerate(self._slots):
             if r is None:
                 continue
-            take = min(seg, r["max_new"] - r["gen"])
+            take = min(int(avail[slot]), r["max_new"] - r["gen"])
             done = False
             for j in range(take):
                 tok = int(host[slot, j])
@@ -522,6 +663,37 @@ class Server:
             if done or r["gen"] >= r["max_new"]:
                 self._results[r["id"]] = r["known"]
                 self._slots[slot] = None
+
+    def _spec_step(self, occ) -> None:
+        """One speculative scheduling round: ``rounds`` batched
+        draft-verify rounds as one program, then retire. Emitted token
+        counts are per row (each row accepts at its own rate); the host
+        takes what each budget needs — exactly the tick path's
+        retirement rules on a variable-length harvest."""
+        k = self.spec_k
+        # rounds capped by the configured count, the max_len frontier
+        # (a round advances a row's clock by at most k+1), and the
+        # largest remaining budget (a round emits at least one token)
+        frontier = min(
+            (self.model.max_len - (len(r["known"]) - 1)) // (k + 1)
+            for r in occ
+        )
+        need = max(r["max_new"] - r["gen"] for r in occ)
+        rounds = max(1, min(self.spec_rounds, frontier, need))
+        pos0 = np.zeros((self._nb,), np.int32)
+        for slot, r in enumerate(self._slots):
+            if r is not None:
+                pos0[slot] = len(r["known"]) - 1
+        self._cache, self._d_cache, self._prev, out, n = (
+            _serve_spec_segment(
+                self._dec, self._dft, k, self.spec_rounds,
+                self.params, self._d_params,
+                self._cache, self._d_cache, self._prev,
+                jnp.asarray(pos0), jnp.asarray(rounds, jnp.int32),
+            )
+        )
+        self.segments_run += 1
+        self._harvest(jax.device_get(out), jax.device_get(n))
 
     def _stream_slice(self, r: dict, steps: int):
         """keys [gen, gen+steps) of the request's stream, padded by

@@ -37,6 +37,59 @@ import jax.numpy as jnp
 from mpit_tpu.models import sampling
 
 
+def _spec_round(
+    tgt, dft, k, t_params, d_params, t_cache, d_cache, prev, pos, active,
+):
+    """ONE speculative round over nb rows — the primitive both the
+    standalone loop and the serving spec-segment share (a change to the
+    acceptance/rewind math lands here once).
+
+    Per ACTIVE row: the draft proposes k tokens (plus one extra feed so
+    its cache stays a step ahead for the bonus-token path), the target
+    scores the (k+1)-chunk [prev, d_1..d_k] in one pass, the row
+    accepts a leading proposals and emits m = a+1 tokens (= t[:, :m]),
+    and both caches' per-row clocks rewind to pos + m. Inactive rows
+    emit m = 0 and keep their prev/clock (their chunk writes repeat the
+    same discarded slots).
+
+    Returns ``(t_cache, d_cache, new_prev, new_pos, t, a, m)`` where
+    ``t`` is (nb, k+1) — each row's emitted tokens are its first m
+    entries."""
+    nb = prev.shape[0]
+
+    def draft_step(carry, _):
+        cache, p = carry
+        logits, mut = dft.apply(
+            {"params": d_params, "cache": cache},
+            p[:, None], mutable=["cache"],
+        )
+        nxt = jnp.argmax(logits[:, 0], -1).astype(jnp.int32)
+        return (mut["cache"], nxt), nxt
+
+    (d_cache, last_d), d = jax.lax.scan(
+        draft_step, (d_cache, prev), None, length=k
+    )
+    (d_cache, _), _ = draft_step((d_cache, last_d), None)
+    d = d.swapaxes(0, 1)  # (nb, k)
+    chunk = jnp.concatenate([prev[:, None], d], axis=1)
+    t_logits, t_mut = tgt.apply(
+        {"params": t_params, "cache": t_cache},
+        chunk, mutable=["cache"],
+    )
+    t_cache = t_mut["cache"]
+    t = jnp.argmax(t_logits, -1).astype(jnp.int32)  # (nb, k+1)
+    # a[r] = accepted proposals; row r emits exactly t[r, :a+1]
+    # (t_i == d_i for i < a; t_a is the correction/bonus)
+    match = jnp.cumprod((d == t[:, :k]).astype(jnp.int32), axis=1)
+    a = jnp.sum(match, axis=1)
+    m = jnp.where(active, a + 1, 0)
+    new_pos = pos + m
+    t_cache = sampling._fix_cache_indices(t_cache, new_pos)
+    d_cache = sampling._fix_cache_indices(d_cache, new_pos)
+    new_prev = jnp.where(active, t[jnp.arange(nb), a], prev)
+    return t_cache, d_cache, new_prev, new_pos, t, a, m
+
+
 @functools.partial(jax.jit, static_argnums=(0, 1, 2, 3, 4))
 def _spec_loop(
     tgt, dft, k, pre_bucket, gen_bucket,
@@ -72,39 +125,13 @@ def _spec_loop(
     out0 = jnp.zeros((nb, gen_bucket + k + 1), jnp.int32)
     out0 = out0.at[:, 0].set(tok0)
 
-    def draft_step(carry, _):
-        cache, prev = carry
-        logits, mut = dft.apply(
-            {"params": d_params, "cache": cache},
-            prev[:, None], mutable=["cache"],
-        )
-        nxt = jnp.argmax(logits[:, 0], -1).astype(jnp.int32)
-        return (mut["cache"], nxt), nxt
-
     def body(carry):
         t_cache, d_cache, prev, pos, n, it, out = carry
         active = n < gen_bucket  # (nb,)
-        # draft proposes k tokens per row; one extra feed of d_k keeps
-        # the draft cache one step ahead so the bonus-token path below
-        # leaves it holding everything before each row's new prev
-        (d_cache, last_d), d = jax.lax.scan(
-            draft_step, (d_cache, prev), None, length=k
+        t_cache, d_cache, new_prev, new_pos, t, a, m = _spec_round(
+            tgt, dft, k, t_params, d_params,
+            t_cache, d_cache, prev, pos, active,
         )
-        (d_cache, _), _ = draft_step((d_cache, last_d), None)
-        d = d.swapaxes(0, 1)  # (nb, k)
-        # target scores each row's (k+1)-chunk [prev, d_1..d_k]
-        chunk = jnp.concatenate([prev[:, None], d], axis=1)
-        t_logits, t_mut = tgt.apply(
-            {"params": t_params, "cache": t_cache},
-            chunk, mutable=["cache"],
-        )
-        t_cache = t_mut["cache"]
-        t = jnp.argmax(t_logits, -1).astype(jnp.int32)  # (nb, k+1)
-        # a[r] = accepted proposals; row r emits exactly t[r, :a+1]
-        # (t_i == d_i for i < a; t_a is the correction/bonus)
-        match = jnp.cumprod((d == t[:, :k]).astype(jnp.int32), axis=1)
-        a = jnp.sum(match, axis=1)
-        m = jnp.where(active, a + 1, 0)
         # each row writes its chunk at its OWN cursor; frozen rows'
         # writes clamp into the discard margin past gen_bucket
         out = jax.vmap(
@@ -112,10 +139,6 @@ def _spec_loop(
                 row, tr, (nr,)
             )
         )(out, t, jnp.where(active, n, gen_bucket))
-        new_pos = pos + m
-        t_cache = sampling._fix_cache_indices(t_cache, new_pos)
-        d_cache = sampling._fix_cache_indices(d_cache, new_pos)
-        new_prev = jnp.where(active, t[jnp.arange(nb), a], prev)
         return (
             t_cache, d_cache, new_prev, new_pos, n + m, it + 1, out
         )

@@ -330,8 +330,9 @@ def test_prefix_cache_results_equal_solo_calls(topo8, monkeypatch):
 
 def test_long_prefix_near_max_len(topo8):
     """The suffix bucket is capped at max_len - prefix_len: a long
-    prefix plus a prompt whose bucket would overhang the cache (70 + 33
-    -> bucket 64 would clamp at 128) must still decode exactly."""
+    prefix plus a prompt whose uncapped bucket would overhang the cache
+    (prefix 36 + bucket(17)=32 > max_len 64 — the append would clamp
+    into the prefix rows) must still decode exactly."""
     model, params = _model_params()  # max_len = 64
     prefix = [(i * 7 + 3) % V for i in range(36)]
     prompt = [(i * 5 + 1) % V for i in range(17)]  # bucket(17)=32 > 64-36
@@ -356,6 +357,92 @@ def test_prefix_validation(topo8):
     assert srv2.drain()[a] == _solo(
         model, params, [1, 2], 3, jax.random.key(0)
     )
+
+
+def _draft_model_params():
+    dft = TransformerLM(
+        vocab_size=V, num_layers=1, d_model=16, num_heads=2, max_len=T,
+        compute_dtype=jnp.float32,
+    )
+    dp = dft.init(
+        jax.random.key(11), jnp.zeros((1, 8), jnp.int32)
+    )["params"]
+    return dft, dp
+
+
+def test_spec_server_results_equal_solo_calls(topo8):
+    """Speculative continuous batching: greedy results bit-equal to the
+    solo generate_fast call under mixed lengths, interleaved arrivals,
+    and per-row acceptance rates — an (independently random) draft can
+    only change speed, never tokens."""
+    model, params = _model_params()
+    dft, dp = _draft_model_params()
+    srv = Server(model, params, max_batch=2, segment=4,
+                 draft_model=dft, draft_params=dp, spec_k=3,
+                 spec_rounds=2)
+    rids = {}
+    for prompt, mn in REQS[:3]:
+        rids[srv.submit(prompt, mn)] = (prompt, mn)
+    srv.step()
+    rids[srv.submit(*REQS[3])] = REQS[3]  # arrives mid-flight
+    got = srv.drain()
+    for rid, (prompt, mn) in rids.items():
+        assert got[rid] == _solo(
+            model, params, prompt, mn, jax.random.key(0)
+        ), rid
+
+
+def test_spec_server_perfect_draft_and_eos(topo8):
+    """Draft == target accepts everything; eos retires mid-segment at
+    the shared truncation point."""
+    model, params = _model_params()
+    probe = generate_fast(model, params, REQS[0][0], 8)
+    eos = probe[len(REQS[0][0]) + 1]
+    srv = Server(model, params, max_batch=1, draft_model=model,
+                 draft_params=params, spec_k=4, eos_id=eos)
+    a = srv.submit(REQS[0][0], 8)
+    b = srv.submit([t for t in REQS[3][0] if t != eos], 6)
+    got = srv.drain()
+    assert got[a] == generate_fast(
+        model, params, REQS[0][0], 8, eos_id=eos, rng=jax.random.key(0)
+    )
+    assert got[b] == generate_fast(
+        model, params, [t for t in REQS[3][0] if t != eos], 6,
+        eos_id=eos, rng=jax.random.key(0),
+    )
+
+
+def test_spec_server_near_frontier(topo8):
+    """A request ending right at the max_len - spec_k boundary: the
+    per-boundary rounds cap keeps the chunk inside the cache and the
+    result exact."""
+    model, params = _model_params()  # max_len 64
+    dft, dp = _draft_model_params()
+    srv = Server(model, params, max_batch=2, draft_model=dft,
+                 draft_params=dp, spec_k=4, spec_rounds=4)
+    prompt = [(i * 3 + 1) % V for i in range(40)]
+    mn = T - 40 - 4  # exactly the headroom limit
+    rid = srv.submit(prompt, mn)
+    got = srv.drain()
+    assert got[rid] == _solo(model, params, prompt, mn, jax.random.key(0))
+
+
+def test_spec_server_validation(topo8):
+    model, params = _model_params()
+    dft, dp = _draft_model_params()
+    with pytest.raises(ValueError, match="greedy"):
+        Server(model, params, temperature=0.5, draft_model=dft,
+               draft_params=dp)
+    with pytest.raises(ValueError, match="prefix"):
+        Server(model, params, prefix=[1, 2], draft_model=dft,
+               draft_params=dp)
+    srv = Server(model, params, draft_model=dft, draft_params=dp,
+                 spec_k=4)
+    with pytest.raises(ValueError, match="headroom"):
+        srv.submit(list(range(10)), T - 10 - 3)  # k=4 > 3 slots left
+    with pytest.raises(ValueError, match="spec_k"):
+        Server(model, params, draft_model=dft, draft_params=dp,
+               spec_k=0)
 
 
 def test_segment_caps_at_remaining_budget(topo8, monkeypatch):
