@@ -469,6 +469,119 @@ def test_segment_caps_at_remaining_budget(topo8, monkeypatch):
     assert got[b] == _solo(model, params, [2, 7], 5, jax.random.key(0))
 
 
+def _schedule_ops(submit_extras):
+    """Op-sequence strategy for the scheduling sweeps: submit tuples
+    carry (prompt_len, budget, *extras), plus step and cancel ops."""
+    from hypothesis import strategies as st
+
+    return st.lists(
+        st.one_of(
+            st.tuples(st.just("submit"), st.integers(1, 7),
+                      st.integers(1, 8), *submit_extras),
+            st.tuples(st.just("step")),
+            st.tuples(st.just("cancel"), st.integers(0, 9)),
+        ),
+        min_size=3, max_size=10,
+    )
+
+
+def _replay_and_check(srv, schedule, submit_fn, solo_cache, solo_fn):
+    """The ONE schedule-replay contract both sweeps share: run the ops,
+    drain, then assert cancelled requests vanished and every survivor
+    equals its cached solo expectation."""
+    live, cancelled = {}, set()
+    for op in schedule:
+        if op[0] == "submit":
+            rid, key = submit_fn(srv, *op[1:])
+            live[rid] = key
+        elif op[0] == "step":
+            srv.step()
+        elif srv.cancel(op[1]):
+            cancelled.add(op[1])
+    got = srv.drain()
+    for rid, key in live.items():
+        if rid in cancelled:
+            assert rid not in got
+            continue
+        assert rid in got  # drain completes everything uncancelled
+        if key not in solo_cache:
+            solo_cache[key] = solo_fn(key)
+        assert got[rid] == solo_cache[key], (rid, key)
+
+
+def _sched_prompt(plen):
+    return [(plen * 13 + i * 7) % V for i in range(plen)]
+
+
+@pytest.mark.slow
+def test_random_scheduling_preserves_parity(topo8):
+    """Hypothesis sweep over adversarial schedules: ANY interleaving of
+    submit (varying lengths/budgets/rules), step, and cancel must leave
+    every surviving request bit-equal to its solo call — the serving
+    contract under schedules no hand-written test would pick."""
+    from hypothesis import given, settings, strategies as st
+
+    model, params = _model_params()
+    kw = dict(temperature=0.8, top_k=7, top_p=0.9)
+    solo_cache: dict = {}
+
+    def submit(srv, plen, mn, temp):
+        prompt = _sched_prompt(plen)
+        rng = jax.random.key(plen * 100 + mn)
+        over = {} if temp is None else {"temperature": temp}
+        return srv.submit(prompt, mn, rng=rng, **over), \
+            (tuple(prompt), mn, temp)
+
+    def solo(key):
+        prompt, mn, temp = key
+        return _solo(
+            model, params, list(prompt), mn,
+            jax.random.key(len(prompt) * 100 + mn),
+            **{**kw, **({} if temp is None else {"temperature": temp})},
+        )
+
+    @settings(max_examples=15, deadline=None)
+    @given(_schedule_ops([st.sampled_from([None, 0.5, 1.2])]),
+           st.integers(1, 3), st.integers(1, 4))
+    def run(schedule, max_batch, segment):
+        srv = Server(model, params, max_batch=max_batch,
+                     segment=segment, **kw)
+        _replay_and_check(srv, schedule, submit, solo_cache, solo)
+
+    run()
+
+
+@pytest.mark.slow
+def test_random_scheduling_spec_server(topo8):
+    """The same adversarial-schedule sweep against the SPECULATIVE
+    server: per-row acceptance under random interleavings must never
+    shift any request off its solo greedy decode."""
+    from hypothesis import given, settings, strategies as st
+
+    model, params = _model_params()
+    dft, dp = _draft_model_params()
+    solo_cache: dict = {}
+
+    def submit(srv, plen, mn):
+        prompt = _sched_prompt(plen)
+        return srv.submit(prompt, mn), (tuple(prompt), mn)
+
+    def solo(key):
+        prompt, mn = key
+        return _solo(model, params, list(prompt), mn, jax.random.key(0))
+
+    @settings(max_examples=10, deadline=None)
+    @given(_schedule_ops([]), st.integers(1, 3), st.integers(1, 3),
+           st.integers(1, 2))
+    def run(schedule, max_batch, spec_k, spec_rounds):
+        srv = Server(model, params, max_batch=max_batch,
+                     draft_model=dft, draft_params=dp,
+                     spec_k=spec_k, spec_rounds=spec_rounds)
+        _replay_and_check(srv, schedule, submit, solo_cache, solo)
+
+    run()
+
+
 def test_drain_empty_and_reuse(topo8):
     model, params = _model_params()
     srv = Server(model, params, max_batch=2, segment=4)
