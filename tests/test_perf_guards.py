@@ -172,6 +172,39 @@ def test_serve_segment_compiles_clean_and_donates(topo8):
     )
 
 
+def test_serve_spec_segment_compiles_clean_and_donates(topo8):
+    """The speculative segment — the spec server's hot loop — has no
+    host transfers and donates all three residents (target cache,
+    draft cache, prev tokens) leaf-for-leaf."""
+    from mpit_tpu.models import sampling, serving
+    from mpit_tpu.models.transformer import TransformerLM
+
+    model, params, srv_unused = _serve_fixture()
+    dft = TransformerLM(
+        vocab_size=17, num_layers=1, d_model=16, num_heads=2, max_len=64,
+        compute_dtype=jnp.float32,
+    )
+    dp = dft.init(jax.random.key(5), jnp.zeros((1, 8), jnp.int32))["params"]
+    srv = serving.Server(model, params, max_batch=2, draft_model=dft,
+                         draft_params=dp, spec_k=3, spec_rounds=2)
+    nb = srv._nb
+    t_cache = sampling._zero_cache(srv._dec, nb)
+    d_cache = sampling._zero_cache(srv._dft, nb)
+    prev = jnp.zeros((nb,), jnp.int32)
+    pos0 = jnp.ones((nb,), jnp.int32)
+    txt = _compiled_text(
+        serving._serve_spec_segment,
+        srv._dec, srv._dft, srv.spec_k, srv.spec_rounds,
+        params, dp, t_cache, d_cache, prev, pos0,
+        jnp.asarray(srv.spec_rounds, jnp.int32),
+    )
+    _assert_clean(txt)
+    want = (
+        len(jax.tree.leaves(t_cache)) + len(jax.tree.leaves(d_cache)) + 1
+    )
+    assert _alias_count(txt) == want
+
+
 def test_serve_steady_state_is_one_program(topo8):
     """A drain over same-bucket requests runs ONE compiled segment
     program — retirement/admission must not leak shapes into the
