@@ -22,7 +22,7 @@ from mpit_tpu.models.sampling import (  # noqa: F401
     generate_tp,
 )
 from mpit_tpu.models.rnn_sampling import generate_rnn  # noqa: F401
-from mpit_tpu.models.serving import Server  # noqa: F401
+from mpit_tpu.models.serving import RNNServer, Server  # noqa: F401
 from mpit_tpu.models.speculative import (  # noqa: F401
     generate_speculative,
     generate_speculative_batch,

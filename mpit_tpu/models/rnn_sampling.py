@@ -34,6 +34,24 @@ import jax.numpy as jnp
 from mpit_tpu.models import sampling
 
 
+def _rnn_prefill(model, params, cache0, pre_buf, p_lens, with_head=True):
+    """The ONE RNN padded-prefill recipe (the carry analogue of
+    :func:`sampling._prefill_chunk`, shared by the batch kernel and the
+    RNNServer's admission/template prefills): the prompt buffer through
+    one ``nn.RNN`` pass with ``seq_lengths`` freezing each row's carry
+    at its OWN true length, then the vocab head on each row's last true
+    position only. ``with_head=False`` skips the projection and returns
+    ``(cache, None)`` (prefix templates)."""
+    hidden, mut = model.clone(head=False).apply(
+        {"params": params, "cache": cache0}, pre_buf,
+        seq_lengths=p_lens, mutable=["cache"],
+    )
+    if not with_head:
+        return mut["cache"], None
+    h_last = jax.vmap(lambda h, n: h[n - 1])(hidden, p_lens)  # (N, H)
+    return mut["cache"], model.head_logits(params, h_last)  # (N, V)
+
+
 @functools.partial(jax.jit, static_argnums=(0, 1, 2, 3, 4, 5))
 def _rnn_prefill_decode_scan(
     model, pre_bucket, gen_len, greedy, top_k, use_top_p,
@@ -43,13 +61,7 @@ def _rnn_prefill_decode_scan(
     length), head on each row's last prompt position only, then
     ``gen_len`` one-token ticks — every tick pure sampling for every
     row."""
-    hidden, mut = model.clone(head=False).apply(
-        {"params": params, "cache": cache0}, pre_buf,
-        seq_lengths=p_lens, mutable=["cache"],
-    )
-    cache = mut["cache"]
-    h_last = jax.vmap(lambda h, n: h[n - 1])(hidden, p_lens)  # (N, H)
-    last = model.head_logits(params, h_last)  # (N, V)
+    cache, last = _rnn_prefill(model, params, cache0, pre_buf, p_lens)
     tok0 = sampling._sample_rows(
         last, keys[:, 0], greedy, top_k, use_top_p, temp, top_p
     )

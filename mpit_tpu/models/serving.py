@@ -252,6 +252,12 @@ class Server:
         if prefix is not None:
             sampling._validate(model, prefix, 0.0, None, None, None)
         if draft_model is not None:
+            if getattr(model, "max_len", None) is None:
+                raise ValueError(
+                    "speculative serving needs a transformer-style "
+                    "target (chunk verification scores k+1 positions "
+                    "in parallel; a recurrence cannot)"
+                )
             # speculative serving is the greedy tier (the exactness
             # contract needs target-argmax verification)
             if temperature != 0.0 or top_k is not None or top_p is not None:
@@ -294,10 +300,12 @@ class Server:
         self._waiting: deque[dict] = deque()
         self._results: dict[int, list[int]] = {}
         self.segments_run = 0
+        # None for carry-decode RNNs: their state has no positional
+        # horizon, so every frontier/bucket cap below degrades to "no cap"
+        # (draft_model on a horizon-free target was rejected above)
+        self._max_len = getattr(model, "max_len", None)
         # resident decode state: one slot per row of the bucketed batch
-        self._dec = model.clone(
-            decode=True, remat=False, seq_axis=None, attn_impl="xla"
-        )
+        self._dec = self._decode_clone(model)
         self._nb = sampling._bucket(self.max_batch, 1 << 30)
         self._slots: list = [None] * self._nb
         self._cache = None  # built lazily at first admission
@@ -325,6 +333,36 @@ class Server:
             else draft_params
         )
         self._d_cache = None
+
+    # ---- model-family hooks (the RNN server overrides these three) ----
+
+    def _decode_clone(self, model):
+        return model.clone(
+            decode=True, remat=False, seq_axis=None, attn_impl="xla"
+        )
+
+    def _prefill_call(
+        self, pre_bucket, cache0, pre_buf, p_lens, keys0, temps, tops, pfx
+    ):
+        """The admission prefill kernel: (cache rows, first tokens)."""
+        return _prefill_rows(
+            self._dec, pre_bucket, self._greedy, self.top_k,
+            self.top_p is not None,
+            self.params, cache0, pre_buf, p_lens, keys0, temps, tops,
+            jnp.asarray(pfx, jnp.int32),
+        )
+
+    def _template_call(self, pb, buf, p_len):
+        """The one-time prefix-template prefill (cache only)."""
+        return _prefill_prefix(
+            self._dec, pb, self.params,
+            sampling._zero_cache(self._dec, 1), buf, p_len,
+        )
+
+    def _len_cap(self, pfx=0) -> int:
+        """Bucket cap for prompt chunks: the cache headroom above the
+        prefix clock, or effectively unbounded for horizon-free RNNs."""
+        return (self._max_len - pfx) if self._max_len else (1 << 30)
 
     # ------------------------------------------------------------- intake
 
@@ -372,22 +410,25 @@ class Server:
         if max_new_tokens < 1:
             raise ValueError("max_new_tokens must be >= 1")
         pfx = len(self.prefix) if self.prefix else 0
-        if pfx + len(prompt) + max_new_tokens > self.model.max_len:
+        if (
+            self._max_len is not None
+            and pfx + len(prompt) + max_new_tokens > self._max_len
+        ):
             raise ValueError(
                 f"prefix ({pfx}) + prompt ({len(prompt)}) + "
                 f"max_new_tokens ({max_new_tokens}) exceeds "
-                f"max_len={self.model.max_len} "
+                f"max_len={self._max_len} "
                 "(the cached decode cannot slide)"
             )
         if (
             self._dft is not None
             and len(prompt) + max_new_tokens + self.spec_k
-            > self.model.max_len
+            > self._max_len
         ):
             raise ValueError(
                 f"prompt + max_new_tokens + spec_k = "
                 f"{len(prompt) + max_new_tokens + self.spec_k} exceeds "
-                f"max_len={self.model.max_len} (the verification chunk "
+                f"max_len={self._max_len} (the verification chunk "
                 "needs spec_k slots of headroom)"
             )
         self._check_poisoned()
@@ -484,13 +525,11 @@ class Server:
             self._prev = jnp.zeros((self._nb,), jnp.int32)
         pfx = len(self.prefix) if self.prefix else 0
         if self.prefix and self._template is None:
-            pb = sampling._bucket(pfx, self.model.max_len)
+            pb = sampling._bucket(pfx, self._len_cap())
             buf = np.zeros((1, pb), np.int32)
             buf[0, :pfx] = self.prefix
-            self._template = _prefill_prefix(
-                self._dec, pb, self.params,
-                sampling._zero_cache(self._dec, 1),
-                jnp.asarray(buf), jnp.asarray([pfx], jnp.int32),
+            self._template = self._template_call(
+                pb, jnp.asarray(buf), jnp.asarray([pfx], jnp.int32)
             )
         k = len(grp)
         kb = sampling._bucket(k, 1 << 30)
@@ -500,7 +539,7 @@ class Server:
         # corrupting the prefix rows)
         pre_bucket = sampling._bucket(
             max(len(r["known"]) - pfx for r, _ in grp),
-            self.model.max_len - pfx,
+            self._len_cap(pfx),
         )
         pre_buf = np.zeros((kb, pre_bucket), np.int32)
         p_lens = np.zeros((kb,), np.int32)
@@ -527,13 +566,11 @@ class Server:
             _tile_rows(kb, self._template) if self.prefix
             else sampling._zero_cache(self._dec, kb)
         )
-        rows, tok0 = _prefill_rows(
-            self._dec, pre_bucket, self._greedy, self.top_k,
-            self.top_p is not None,
-            self.params, cache0,
+        rows, tok0 = self._prefill_call(
+            pre_bucket, cache0,
             jnp.asarray(pre_buf), jnp.asarray(p_lens),
             jnp.stack(keys0), jnp.asarray(temps), jnp.asarray(tops),
-            jnp.asarray(pfx, jnp.int32),
+            pfx,
         )
         self._cache = _insert_rows(self._cache, rows, jnp.asarray(slots))
         if self._dft is not None:
@@ -596,7 +633,7 @@ class Server:
             # grouped by SUFFIX bucket — the part admission prefills
             # (same max_len - pfx cap as _admit_group's chunk)
             b = sampling._bucket(
-                len(r["known"]) - pfx, self.model.max_len - pfx
+                len(r["known"]) - pfx, self._len_cap(pfx)
             )
             groups.setdefault(b, []).append((r, slot))
         for grp in groups.values():
@@ -610,7 +647,11 @@ class Server:
         # a row at the max_len frontier caps the segment for everyone —
         # transient: such a row's budget ends within those ticks. Round
         # DOWN to a power of two so compiled programs stay log-bounded.
-        frontier = min(self.model.max_len - len(r["known"]) for r in occ)
+        # (horizon-free RNNs have no frontier)
+        frontier = (
+            min(self._max_len - len(r["known"]) for r in occ)
+            if self._max_len is not None else 1 << 30
+        )
         # ...and the LARGEST remaining budget caps it too (rounded UP to
         # a power of two): when every occupied row needs <= n more
         # tokens, ticks past bucket(n) are pure waste — the drain tail
@@ -675,7 +716,7 @@ class Server:
         # (a round advances a row's clock by at most k+1), and the
         # largest remaining budget (a round emits at least one token)
         frontier = min(
-            (self.model.max_len - (len(r["known"]) - 1)) // (k + 1)
+            (self._max_len - (len(r["known"]) - 1)) // (k + 1)
             for r in occ
         )
         need = max(r["max_new"] - r["gen"] for r in occ)
@@ -717,3 +758,83 @@ class Server:
         while self._waiting or self._occupied():
             self.step()
         return self.results()
+
+
+@functools.partial(jax.jit, static_argnums=(0, 1, 2, 3, 4))
+def _rnn_prefill_rows(
+    model, pre_bucket, greedy, top_k, use_top_p,
+    params, cache0, pre_buf, p_lens, keys0, temp, top_p,
+):
+    """RNN admission: a group of prompts through the shared RNN prefill
+    recipe (`rnn_sampling._rnn_prefill` — carries freeze at each row's
+    own length; no counters exist to fix), each row's first token
+    sampled from its last true position with its stream key 0.
+    Starting carries come from ``cache0`` (zero, or prefix-template
+    copies)."""
+    from mpit_tpu.models.rnn_sampling import _rnn_prefill
+
+    cache, last = _rnn_prefill(model, params, cache0, pre_buf, p_lens)
+    tok0 = sampling._sample_rows(
+        last, keys0, greedy, top_k, use_top_p, temp, top_p
+    )
+    return cache, tok0
+
+
+@functools.partial(jax.jit, static_argnums=(0, 1))
+def _rnn_prefill_template(model, pre_bucket, params, cache0, pre_buf, p_len):
+    """Carry-only RNN prefill (no head) for the prefix template."""
+    from mpit_tpu.models.rnn_sampling import _rnn_prefill
+
+    cache, _ = _rnn_prefill(
+        model, params, cache0, pre_buf, p_len, with_head=False
+    )
+    return cache
+
+
+class RNNServer(Server):
+    """Continuous batching for the carry-decode RNN family
+    (:class:`~mpit_tpu.models.lstm.LSTMLM`): the SAME scheduler as
+    :class:`Server` — resident state, segments, grouped burst
+    admission, per-request rules, shared-prefix template, cancel,
+    poison safety — with the carry tree replacing the KV cache. Three
+    differences, all at the model-family hooks: the decode clone is
+    plain ``clone(decode=True)``; admission prefills through the
+    ``seq_lengths`` path (carries freeze at each row's own prompt
+    length — no position counters exist); and there is no ``max_len``
+    horizon, so the frontier/bucket caps are unbounded. The per-tick
+    segment kernel is the shared :func:`_serve_segment` — an RNN decode
+    step is the same (B, 1)-token mutate-the-cache program shape.
+    Speculative mode is transformer-only (rejected at construction).
+
+    Parity contract unchanged: every result bit-equal to its solo
+    :func:`~mpit_tpu.models.rnn_sampling.generate_rnn` call."""
+
+    def __init__(self, model, params, **kw):
+        # fail at construction, not at first admission (where the
+        # mismatched prefill would poison the server): KV-cache models
+        # carry a max_len horizon, carry-decode RNNs do not
+        if getattr(model, "max_len", None) is not None:
+            raise ValueError(
+                "RNNServer serves carry-decode RNN models (no max_len "
+                "horizon); use Server for KV-cache transformer models"
+            )
+        super().__init__(model, params, **kw)
+
+    def _decode_clone(self, model):
+        return model.clone(decode=True)
+
+    def _prefill_call(
+        self, pre_bucket, cache0, pre_buf, p_lens, keys0, temps, tops, pfx
+    ):
+        del pfx  # carries have no clock to offset
+        return _rnn_prefill_rows(
+            self._dec, pre_bucket, self._greedy, self.top_k,
+            self.top_p is not None,
+            self.params, cache0, pre_buf, p_lens, keys0, temps, tops,
+        )
+
+    def _template_call(self, pb, buf, p_len):
+        return _rnn_prefill_template(
+            self._dec, pb, self.params,
+            sampling._zero_cache(self._dec, 1), buf, p_len,
+        )

@@ -582,6 +582,94 @@ def test_random_scheduling_spec_server(topo8):
     run()
 
 
+class TestRNNServer:
+    """The carry-decode family through the SAME scheduler: every result
+    bit-equal to its solo generate_rnn call."""
+
+    def _lstm(self):
+        from mpit_tpu.models.lstm import LSTMLM
+
+        model = LSTMLM(
+            vocab_size=V, embed_dim=12, hidden=16, num_layers=2,
+            compute_dtype=jnp.float32,
+        )
+        params = model.init(
+            jax.random.key(3), jnp.zeros((1, 8), jnp.int32)
+        )["params"]
+        return model, params
+
+    def _solo_rnn(self, model, params, prompt, mn, rng, **kw):
+        from mpit_tpu.models import generate_rnn
+
+        return generate_rnn(model, params, prompt, mn, rng=rng, **kw)
+
+    def test_results_equal_solo_calls(self, topo8):
+        from mpit_tpu.models import RNNServer
+
+        model, params = self._lstm()
+        kw = dict(temperature=0.9, top_k=5)
+        srv = RNNServer(model, params, max_batch=2, segment=3, **kw)
+        rngs = {}
+        for i, (prompt, mn) in enumerate(REQS[:3]):
+            rng = jax.random.key(400 + i)
+            rngs[srv.submit(prompt, mn, rng=rng)] = (prompt, mn, rng)
+        srv.step()
+        rng = jax.random.key(404)
+        rngs[srv.submit(*REQS[3], rng=rng)] = (*REQS[3], rng)
+        got = srv.drain()
+        for rid, (prompt, mn, rng) in rngs.items():
+            want = self._solo_rnn(model, params, prompt, mn, rng, **kw)
+            assert got[rid] == want, rid
+
+    def test_prefix_and_long_generation(self, topo8):
+        """Prefix template + a generation far past any transformer-style
+        horizon (the RNN has none)."""
+        from mpit_tpu.models import RNNServer
+
+        model, params = self._lstm()
+        prefix = [3, 1, 4, 1, 5, 9, 2, 6]
+        srv = RNNServer(model, params, max_batch=2, segment=8,
+                        prefix=prefix)
+        a = srv.submit([7, 7], 150)  # way past T=64-style caps
+        got = srv.drain()
+        assert got[a] == self._solo_rnn(
+            model, params, prefix + [7, 7], 150, jax.random.key(0)
+        )
+
+    def test_eos_and_cancel(self, topo8):
+        from mpit_tpu.models import RNNServer, generate_rnn
+
+        model, params = self._lstm()
+        probe = generate_rnn(model, params, [3, 1, 4], 8)
+        eos = probe[4]
+        srv = RNNServer(model, params, max_batch=1, eos_id=eos)
+        a = srv.submit([3, 1, 4], 8)
+        b = srv.submit([2, 2], 5)
+        assert srv.cancel(b)
+        got = srv.drain()
+        assert set(got) == {a}
+        assert got[a] == generate_rnn(
+            model, params, [3, 1, 4], 8, eos_id=eos, rng=jax.random.key(0)
+        )
+
+    def test_spec_rejected(self, topo8):
+        from mpit_tpu.models import RNNServer
+
+        model, params = self._lstm()
+        dft, dp = _draft_model_params()
+        with pytest.raises(ValueError, match="transformer-style"):
+            RNNServer(model, params, draft_model=dft, draft_params=dp)
+
+    def test_wrong_family_rejected_at_construction(self, topo8):
+        """A KV-cache transformer into RNNServer fails loudly at init,
+        not by poisoning the server at first admission."""
+        from mpit_tpu.models import RNNServer
+
+        t_model, t_params = _model_params()
+        with pytest.raises(ValueError, match="carry-decode"):
+            RNNServer(t_model, t_params)
+
+
 def test_drain_empty_and_reuse(topo8):
     model, params = _model_params()
     srv = Server(model, params, max_batch=2, segment=4)
