@@ -30,6 +30,24 @@ def _fresh_topology():
     mpit_tpu.finalize()
 
 
+@pytest.fixture(autouse=True, scope="module")
+def _bounded_jit_cache():
+    """Drop jax's compiled-program caches after each test module.
+
+    The full suite compiles hundreds of XLA:CPU programs in one
+    process; past ~330 tests the LLVM JIT segfaults NONDETERMINISTICALLY
+    inside ``backend_compile_and_load`` (observed twice on 2026-08-01,
+    at two unrelated tests — not OOM: 120+ GB free). Bounding the live
+    executable count at module boundaries keeps the gate out of the
+    crash window. Within-module compile-count pins are unaffected (the
+    clear runs between modules); the cost is cross-module recompiles of
+    the few shared small kernels."""
+    yield
+    import jax
+
+    jax.clear_caches()
+
+
 @pytest.fixture
 def topo8():
     return mpit_tpu.init()

@@ -37,7 +37,7 @@ def test_parallelism_tour_runs():
 
 
 def test_generate_text_example_runs():
-    """The serving tour trains and decodes with all four recipes."""
+    """The serving tour trains and decodes with all six recipes."""
     r = subprocess.run(
         [sys.executable, os.path.join(REPO, "examples",
                                       "generate_text.py"),
@@ -45,5 +45,6 @@ def test_generate_text_example_runs():
         cwd=REPO, capture_output=True, text=True, timeout=1800,
     )
     assert r.returncode == 0, r.stdout + r.stderr
-    for tag in ("generate ", "generate_fast", "batched row", "beam (K=4)"):
+    for tag in ("generate ", "generate_fast", "batched row", "beam (K=4)",
+                "speculative", "served"):
         assert tag in r.stdout, f"missing: {tag}\n{r.stdout}"
