@@ -52,18 +52,19 @@ def _rnn_prefill(model, params, cache0, pre_buf, p_lens, with_head=True):
     return mut["cache"], model.head_logits(params, h_last)  # (N, V)
 
 
-@functools.partial(jax.jit, static_argnums=(0, 1, 2, 3, 4, 5))
+@functools.partial(jax.jit, static_argnums=(0, 1, 2, 3, 4, 5, 6))
 def _rnn_prefill_decode_scan(
-    model, pre_bucket, gen_len, greedy, top_k, use_top_p,
-    params, cache0, pre_buf, p_lens, keys, temp, top_p,
+    model, pre_bucket, gen_len, greedy, top_k, use_top_p, use_min_p,
+    params, cache0, pre_buf, p_lens, keys, temp, top_p, min_p,
 ):
     """One program: prompt pass (carries frozen at each row's own
     length), head on each row's last prompt position only, then
     ``gen_len`` one-token ticks — every tick pure sampling for every
     row."""
     cache, last = _rnn_prefill(model, params, cache0, pre_buf, p_lens)
+    mp = min_p if use_min_p else None
     tok0 = sampling._sample_rows(
-        last, keys[:, 0], greedy, top_k, use_top_p, temp, top_p
+        last, keys[:, 0], greedy, top_k, use_top_p, temp, top_p, mp
     )
 
     def step(carry, t):
@@ -75,7 +76,7 @@ def _rnn_prefill_decode_scan(
         )
         nxt = sampling._sample_rows(
             logits[:, 0], keys[:, t + 1], greedy, top_k, use_top_p,
-            temp, top_p,
+            temp, top_p, mp,
         )
         return (mut["cache"], nxt), nxt
 
@@ -100,6 +101,7 @@ def generate_rnn(
     top_p: Optional[float] = None,
     weights_dtype=None,
     eos_id: Optional[int] = None,
+    min_p: Optional[float] = None,
 ):
     """Continue prompt(s) by ``steps`` tokens with a carry-decode LSTM.
 
@@ -119,7 +121,9 @@ def generate_rnn(
     solo = len(prompts) == 0 or not hasattr(prompts[0], "__len__")
     batch = [prompts] if solo else list(prompts)
     for q in batch:
-        sampling._validate(model, q, temperature, top_k, top_p, eos_id)
+        sampling._validate(
+            model, q, temperature, top_k, top_p, eos_id, min_p
+        )
     if steps <= 0:
         rows = [[int(t) for t in q] for q in batch]
         return rows[0] if solo else rows
@@ -144,10 +148,11 @@ def generate_rnn(
     dec = model.clone(decode=True)
     gen = _rnn_prefill_decode_scan(
         dec, pre_bucket, gen_bucket, temperature == 0.0, top_k,
-        top_p is not None,
+        top_p is not None, min_p is not None,
         params, sampling._zero_cache(dec, nb), pre_buf, p_lens, keys,
         jnp.asarray(max(temperature, 1e-9), jnp.float32),
         jnp.asarray(1.0 if top_p is None else top_p, jnp.float32),
+        jnp.asarray(0.0 if min_p is None else min_p, jnp.float32),
     )
     host = jax.device_get(gen)
     rows = [

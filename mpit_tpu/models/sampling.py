@@ -55,18 +55,20 @@ def generate(
     rng: Optional[jax.Array] = None,
     top_k: Optional[int] = None,
     top_p: Optional[float] = None,
+    min_p: Optional[float] = None,
 ) -> list:
     """Continue ``prompt`` by ``steps`` tokens; returns prompt + new.
 
     ``temperature=0``: greedy argmax (deterministic). ``>0``: softmax
     sampling at that temperature, reproducible from ``seed`` (or pass an
     explicit ``rng`` key), optionally restricted to the ``top_k``
-    highest-scoring tokens and/or the ``top_p`` probability nucleus
-    (temperature scales first, then the filters — the standard order).
-    ``model`` must be the dense single-device configuration
+    highest-scoring tokens, the ``top_p`` probability nucleus, and/or
+    the ``min_p`` band (tokens at least min_p times as probable as the
+    best) — temperature scales first, then the filters, the standard
+    order. ``model`` must be the dense single-device configuration
     (``seq_axis=None``).
     """
-    _validate(model, prompt, temperature, top_k, top_p)
+    _validate(model, prompt, temperature, top_k, top_p, min_p=min_p)
     length = model.max_len
     buf = jnp.zeros((1, length), jnp.int32)
     buf = buf.at[0, : len(prompt)].set(jnp.asarray(prompt, jnp.int32))
@@ -81,7 +83,9 @@ def generate(
             pos = length - 1
         logits = _apply(model, params, buf)[0, pos - 1]
         if temperature > 0:
-            scaled = _filter_logits(logits / temperature, top_k, top_p)
+            scaled = _filter_logits(
+                logits / temperature, top_k, top_p, min_p
+            )
             nxt = jax.random.categorical(keys[i], scaled)
         else:
             nxt = jnp.argmax(logits)
@@ -92,7 +96,8 @@ def generate(
 
 
 def _validate(
-    model, prompt, temperature, top_k=None, top_p=None, eos_id=None
+    model, prompt, temperature, top_k=None, top_p=None, eos_id=None,
+    min_p=None,
 ):
     """Shared argument checks for every decoding entry point."""
     if eos_id is not None and not 0 <= eos_id < model.vocab_size:
@@ -117,11 +122,16 @@ def _validate(
         )
     if top_p is not None and not 0.0 < top_p <= 1.0:
         raise ValueError(f"top_p={top_p} must be in (0, 1]")
-    if (top_k is not None or top_p is not None) and temperature == 0:
+    if min_p is not None and not 0.0 < min_p <= 1.0:
+        raise ValueError(f"min_p={min_p} must be in (0, 1]")
+    if (
+        (top_k is not None or top_p is not None or min_p is not None)
+        and temperature == 0
+    ):
         raise ValueError(
-            "top_k/top_p shape the SAMPLING distribution; temperature=0 "
-            "is greedy argmax, which they cannot affect — set "
-            "temperature > 0"
+            "top_k/top_p/min_p shape the SAMPLING distribution; "
+            "temperature=0 is greedy argmax, which they cannot affect — "
+            "set temperature > 0"
         )
     bad = [t for t in prompt if not 0 <= int(t) < model.vocab_size]
     if bad:
@@ -132,20 +142,30 @@ def _validate(
         )
 
 
-def _filter_logits(logits, top_k, top_p):
-    """Mask logits outside the top-k set and/or the top-p nucleus to
-    -inf (jit-safe, static shapes). The ONE filter both recipes share —
-    what makes their sampled streams comparable at a fixed seed.
+def _filter_logits(logits, top_k, top_p, min_p=None):
+    """Mask logits outside the top-k set / the top-p nucleus / the
+    min-p band to -inf (jit-safe, static shapes). The ONE filter both
+    recipes share — what makes their sampled streams comparable at a
+    fixed seed.
 
     top-p keeps the smallest prefix of probability-sorted tokens whose
     cumulative mass reaches ``top_p`` (the token that crosses the
     threshold is kept — standard nucleus rule), so at least one token
     always survives; ties at the top-k boundary keep every token equal
-    to the k-th value (strictly-less masking).
+    to the k-th value (strictly-less masking). min-p keeps tokens whose
+    probability is at least ``min_p`` times the maximum's — computed in
+    logit space (``l >= l_max + log(min_p)``, softmax-free), on the
+    post-temperature distribution like the other filters; the argmax
+    always survives, and a traced ``min_p=0`` is exactly "keep all"
+    (``log 0 = -inf``).
     """
     if top_k is not None:
         kth = jax.lax.top_k(logits, top_k)[0][-1]
         logits = jnp.where(logits < kth, -jnp.inf, logits)
+    if min_p is not None:
+        # threshold vs the UNFILTERED max (the max survives every mask)
+        floor = jnp.max(logits) + jnp.log(min_p)
+        logits = jnp.where(logits < floor, -jnp.inf, logits)
     if top_p is not None:
         order = jnp.argsort(logits)[::-1]  # descending
         probs = jax.nn.softmax(logits[order])
@@ -196,6 +216,7 @@ def generate_fast(
     top_p: Optional[float] = None,
     weights_dtype=None,
     eos_id: Optional[int] = None,
+    min_p: Optional[float] = None,
 ) -> list:
     """KV-cached generation: continue ``prompt`` by ``steps`` tokens.
 
@@ -214,7 +235,7 @@ def generate_fast(
       flash-attention model the greedy-equality pin versus
       :func:`generate` holds only up to that kernel's numerics.
     """
-    _validate(model, prompt, temperature, top_k, top_p, eos_id)
+    _validate(model, prompt, temperature, top_k, top_p, eos_id, min_p)
     if steps <= 0:
         return [int(t) for t in prompt]  # prompt length already validated
     if rng is None:
@@ -224,7 +245,7 @@ def generate_fast(
     return _truncate_at_eos(
         _generate_rows(
             model, params, [prompt], steps, temperature, [rng],
-            top_k, top_p,
+            top_k, top_p, min_p=min_p,
         )[0],
         len(prompt), eos_id,
     )
@@ -483,37 +504,49 @@ def _fix_cache_indices(cache, p_len):
     return jtu.tree_map_with_path(fix, cache)
 
 
-def _sample_rows(logits, row_keys, greedy, top_k, use_top_p, temp, top_p):
+def _sample_rows(
+    logits, row_keys, greedy, top_k, use_top_p, temp, top_p, min_p=None,
+):
     """The ONE sampling rule both decode kernels share: greedy argmax,
     or temperature scale -> :func:`_filter_logits` -> categorical, per
     row of ``logits`` (N, V) with ``row_keys`` (N,). A change here is a
     change to BOTH kernels — which is what keeps the prefill==tick
     parity pinnable.
 
-    ``temp``/``top_p`` may be scalars (every row the same rule — the
-    batch entry points) or (N,) vectors (per-row rules — the serving
-    path's per-request overrides). Row n's math is identical either
-    way, which is what keeps a mixed-rule Server row bit-equal to its
-    solo call."""
+    ``temp``/``top_p``/``min_p`` may be scalars (every row the same
+    rule — the batch entry points) or (N,) vectors (per-row rules — the
+    serving path's per-request overrides). Row n's math is identical
+    either way, which is what keeps a mixed-rule Server row bit-equal
+    to its solo call. ``min_p=None`` omits the min-p mask entirely
+    (kernels without the knob compile the exact program they always
+    did)."""
     if greedy:
         return jnp.argmax(logits, axis=-1).astype(jnp.int32)
     n = logits.shape[0]
     temps = jnp.broadcast_to(jnp.asarray(temp, jnp.float32), (n,))
     tops = jnp.broadcast_to(jnp.asarray(top_p, jnp.float32), (n,))
-    scaled = jax.vmap(
-        lambda l, t, p: _filter_logits(
-            l / t, top_k, p if use_top_p else None
-        )
-    )(logits, temps, tops)
+    if min_p is None:
+        scaled = jax.vmap(
+            lambda l, t, p: _filter_logits(
+                l / t, top_k, p if use_top_p else None
+            )
+        )(logits, temps, tops)
+    else:
+        mps = jnp.broadcast_to(jnp.asarray(min_p, jnp.float32), (n,))
+        scaled = jax.vmap(
+            lambda l, t, p, mp: _filter_logits(
+                l / t, top_k, p if use_top_p else None, mp
+            )
+        )(logits, temps, tops, mps)
     return jax.vmap(jax.random.categorical)(
         row_keys, scaled
     ).astype(jnp.int32)
 
 
-@functools.partial(jax.jit, static_argnums=(0, 1, 2, 3, 4, 5))
+@functools.partial(jax.jit, static_argnums=(0, 1, 2, 3, 4, 5, 6))
 def _prefill_decode_scan(
-    model, pre_bucket, gen_len, greedy, top_k, use_top_p,
-    params, cache0, pre_buf, p_lens, keys, temp, top_p,
+    model, pre_bucket, gen_len, greedy, top_k, use_top_p, use_min_p,
+    params, cache0, pre_buf, p_lens, keys, temp, top_p, min_p,
 ):
     """Chunked-prefill decoding, per-row clocks: EVERY row's ENTIRE
     prompt enters the cache in one dense pass (matmul-bound — one chunk
@@ -543,8 +576,9 @@ def _prefill_decode_scan(
     """
     cache, last = _prefill_chunk(model, params, cache0, pre_buf, p_lens)
 
+    mp = min_p if use_min_p else None
     tok0 = _sample_rows(
-        last, keys[:, 0], greedy, top_k, use_top_p, temp, top_p
+        last, keys[:, 0], greedy, top_k, use_top_p, temp, top_p, mp
     )
 
     def step(carry, t):
@@ -556,7 +590,7 @@ def _prefill_decode_scan(
         )
         nxt = _sample_rows(
             logits[:, 0], keys[:, t + 1], greedy, top_k, use_top_p,
-            temp, top_p,
+            temp, top_p, mp,
         )
         return (mut["cache"], nxt), nxt
 
@@ -581,6 +615,7 @@ def generate_batch(
     top_p: Optional[float] = None,
     weights_dtype=None,
     eos_id: Optional[int] = None,
+    min_p: Optional[float] = None,
 ) -> "list[list]":
     """Continue N prompts by ``steps`` tokens each, in ONE compiled
     decode scan over a (N, ...) K/V cache — the batched serving path.
@@ -595,6 +630,7 @@ def generate_batch(
     return _batch_impl(
         model, params, prompts, steps, temperature, seed, rng,
         top_k, top_p, weights_dtype=weights_dtype, eos_id=eos_id,
+        min_p=min_p,
     )
 
 
@@ -629,7 +665,7 @@ def _truncate_at_eos(seq, p_len, eos_id):
 def _batch_impl(
     model, params, prompts, steps, temperature, seed, rng, top_k, top_p,
     cache_sharding_fn=None, params_placer=None, weights_dtype=None,
-    eos_id=None,
+    eos_id=None, min_p=None,
 ):
     """The ONE prologue generate_batch and generate_tp share: validation,
     trivial early returns, the per-row rng derivation (fold_in — the
@@ -640,7 +676,7 @@ def _batch_impl(
     if len(prompts) == 0:
         return []
     for p in prompts:
-        _validate(model, p, temperature, top_k, top_p, eos_id)
+        _validate(model, p, temperature, top_k, top_p, eos_id, min_p)
     if steps <= 0:
         return [[int(t) for t in p] for p in prompts]
     if weights_dtype is not None:
@@ -655,7 +691,7 @@ def _batch_impl(
     )
     rows = _generate_rows(
         model, params, prompts, steps, temperature, rngs, top_k, top_p,
-        cache_sharding_fn=cache_sharding_fn,
+        cache_sharding_fn=cache_sharding_fn, min_p=min_p,
     )
     return [
         _truncate_at_eos(r, len(p), eos_id)
@@ -665,7 +701,7 @@ def _batch_impl(
 
 def _generate_rows(
     model, params, prompts, steps, temperature, rngs, top_k, top_p,
-    cache_sharding_fn=None,
+    cache_sharding_fn=None, min_p=None,
 ):
     """The ONE wrapper both serving entry points share: bucket the
     prefill and generation lengths (power-of-two, capped at max_len)
@@ -688,11 +724,12 @@ def _generate_rows(
     )
     gen = _prefill_decode_scan(
         dec, pre_bucket, gen_bucket, temperature == 0.0, top_k,
-        top_p is not None,
+        top_p is not None, min_p is not None,
         params, _zero_cache(dec, nb, sharding_fn=cache_sharding_fn),
         pre_buf, p_lens, keys,
         jnp.asarray(max(temperature, 1e-9), jnp.float32),
         jnp.asarray(1.0 if top_p is None else top_p, jnp.float32),
+        jnp.asarray(0.0 if min_p is None else min_p, jnp.float32),
     )
     host = jax.device_get(gen)
     return [
@@ -763,6 +800,7 @@ def generate_tp(
     top_p: Optional[float] = None,
     weights_dtype=None,
     eos_id: Optional[int] = None,
+    min_p: Optional[float] = None,
 ) -> "list[list]":
     """Tensor-parallel batched decode: the SAME compiled kernel as
     :func:`generate_batch`, partitioned by GSPMD across a mesh with a
@@ -826,5 +864,5 @@ def generate_tp(
         model, params, prompts, steps, temperature, seed, rng,
         top_k, top_p, cache_sharding_fn=cache_sharding,
         params_placer=place_params, weights_dtype=weights_dtype,
-        eos_id=eos_id,
+        eos_id=eos_id, min_p=min_p,
     )

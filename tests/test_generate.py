@@ -273,9 +273,92 @@ def test_top_filter_validation(topo8):
         generate(model, params, [1], 2, top_k=3)
 
 
+def test_min_p_filter_unit(topo8):
+    """min-p keeps tokens at least min_p times as probable as the best
+    (logit-space: l >= l_max + log(min_p)); min_p -> 0 keeps all."""
+    from mpit_tpu.models import sampling
+
+    logits = jnp.asarray([0.0, -1.0, -3.0, -10.0])
+    out = sampling._filter_logits(
+        logits, None, None, jnp.asarray(0.2)
+    )  # threshold log(0.2) ~ -1.609: keep 0.0 and -1.0 only
+    assert bool(jnp.isfinite(out[0])) and bool(jnp.isfinite(out[1]))
+    assert out[2] == -jnp.inf and out[3] == -jnp.inf
+    out0 = sampling._filter_logits(logits, None, None, jnp.asarray(0.0))
+    assert bool(jnp.all(jnp.isfinite(out0)))
+
+
+def test_min_p_matches_across_recipes_and_batch(topo8):
+    """min_p through the three recipe layers: fast == slow at a fixed
+    seed (alone and composed with top_k), and each batch row equals its
+    solo call."""
+    model = _model()
+    params = model.init(
+        jax.random.key(0), jnp.zeros((1, T), jnp.int32)
+    )["params"]
+    from mpit_tpu.models import generate_batch, generate_fast
+
+    for kw in ({"min_p": 0.25}, {"min_p": 0.1, "top_k": 5}):
+        a = generate(
+            model, params, [1, 2], steps=6, temperature=0.9, seed=4, **kw
+        )
+        b = generate_fast(
+            model, params, [1, 2], steps=6, temperature=0.9, seed=4, **kw
+        )
+        assert a == b, kw
+    rng = jax.random.key(7)
+    rows = generate_batch(
+        model, params, [[1, 2], [3], [4, 5, 6]], 5,
+        temperature=0.8, min_p=0.3, rng=rng,
+    )
+    for i, q in enumerate([[1, 2], [3], [4, 5, 6]]):
+        want = generate_fast(
+            model, params, q, 5, temperature=0.8, min_p=0.3,
+            rng=jax.random.fold_in(rng, i),
+        )
+        assert rows[i] == want, i
+
+
+def test_min_p_restricts_support(topo8):
+    """Every sampled token's probability is at least min_p times the
+    step's best — checked against the slow recipe's own prefix
+    forwards."""
+    model = _model()
+    params = model.init(
+        jax.random.key(0), jnp.zeros((1, T), jnp.int32)
+    )["params"]
+    mp = 0.4
+    for seed in range(3):
+        toks = generate(
+            model, params, [5], steps=5, temperature=1.5, min_p=mp,
+            seed=seed,
+        )
+        for i in range(1, 6):
+            logits = model.apply(
+                {"params": params},
+                jnp.asarray(toks[:i], jnp.int32)[None],
+            )[0, -1] / 1.5
+            probs = np.asarray(jax.nn.softmax(logits))
+            assert probs[toks[i]] >= mp * probs.max() - 1e-7, (seed, i)
+
+
+def test_min_p_validation(topo8):
+    model = _model()
+    params = model.init(
+        jax.random.key(0), jnp.zeros((1, T), jnp.int32)
+    )["params"]
+    with pytest.raises(ValueError, match="min_p"):
+        generate(model, params, [1], 2, temperature=1.0, min_p=0.0)
+    with pytest.raises(ValueError, match="min_p"):
+        generate(model, params, [1], 2, temperature=1.0, min_p=1.5)
+    with pytest.raises(ValueError, match="greedy"):
+        generate(model, params, [1], 2, min_p=0.5)
+
+
 def test_top_p_sweep_shares_one_program(topo8):
-    """top_p is a traced threshold: sweeping nucleus values must not
-    recompile the decode scan (only top_k changes the program)."""
+    """top_p and min_p are traced thresholds: sweeping their values
+    must not recompile the decode scan (only top_k — and switching a
+    filter on/off — changes the program)."""
     model = _model()
     params = model.init(
         jax.random.key(0), jnp.zeros((1, T), jnp.int32)
@@ -289,6 +372,11 @@ def test_top_p_sweep_shares_one_program(topo8):
     for p in (0.6, 0.8, 0.9, 0.95):
         generate_fast(model, params, [1], 8, temperature=1.0, top_p=p)
     assert sampling._prefill_decode_scan._cache_size() == n0
+    generate_fast(model, params, [1], 8, temperature=1.0, min_p=0.1)
+    n1 = sampling._prefill_decode_scan._cache_size()
+    for mp in (0.2, 0.3, 0.5):
+        generate_fast(model, params, [1], 8, temperature=1.0, min_p=mp)
+    assert sampling._prefill_decode_scan._cache_size() == n1
 
 
 # --------------------------------------------------------------- beam search

@@ -155,6 +155,25 @@ def test_validation_shared_with_transformer_path(topo8):
         generate_rnn(model, params, [], 3)
 
 
+def test_min_p_batch_rows_equal_solo(topo8):
+    """min_p on the RNN path: batch row n equals its solo call at
+    fold_in(rng, n) — the same contract as every other rule knob."""
+    model, params = _model_params()
+    rng = jax.random.key(9)
+    prompts = [[1, 2], [3], [4, 5, 6]]
+    rows = generate_rnn(
+        model, params, prompts, 5, temperature=0.8, min_p=0.3, rng=rng
+    )
+    for i, q in enumerate(prompts):
+        want = generate_rnn(
+            model, params, q, 5, temperature=0.8, min_p=0.3,
+            rng=jax.random.fold_in(rng, i),
+        )
+        assert rows[i] == want, i
+    with pytest.raises(ValueError, match="min_p"):
+        generate_rnn(model, params, [1], 2, temperature=0.8, min_p=2.0)
+
+
 def test_batch_bucketing_shares_programs(topo8):
     """Row counts and lengths bucket: N=3 shares the N=4 program."""
     from mpit_tpu.models import rnn_sampling
