@@ -241,11 +241,12 @@ def test_batch_decode_kernel_compiles_clean(topo8):
                       for i in range(nb)])
     txt = _compiled_text(
         sampling._prefill_decode_scan,
-        dec, 4, 8, True, None, False,
+        dec, 4, 8, True, None, False, False,
         params, sampling._zero_cache(dec, nb),
         jnp.zeros((nb, 4), jnp.int32),
         jnp.ones((nb,), jnp.int32), keys,
         jnp.asarray(1.0, jnp.float32), jnp.asarray(1.0, jnp.float32),
+        jnp.asarray(0.0, jnp.float32),
     )
     _assert_clean(txt)
 
