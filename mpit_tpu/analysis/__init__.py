@@ -1,0 +1,51 @@
+"""mpit_tpu.analysis — distributed-correctness linter + runtime checker.
+
+Two halves (ISSUE 1):
+
+- a static AST pass over the package (:mod:`~mpit_tpu.analysis.lint`,
+  rules MPT001–MPT006) catching the distributed/JAX hazard classes that
+  have actually bitten this codebase: unbound collective axis names,
+  transport-tag indiscipline, jit static-argument drift (commit c166392),
+  host syncs in hot loops, and blocking I/O under locks;
+- an opt-in runtime checker (:mod:`~mpit_tpu.analysis.runtime`, rules
+  RT101/RT102) instrumenting the transport layer's locks and mailboxes for
+  lock-order cycles and concurrent tag reuse.
+
+CLI: ``python -m mpit_tpu.analysis [--format json|text] [path]`` — exits 0
+when the scan matches the checked-in baseline. See ``docs/ANALYSIS.md``.
+
+This ``__init__`` stays import-light (PEP 562 lazy attributes): the
+transports import :mod:`~mpit_tpu.analysis.runtime` on their hot
+construction path, and pulling the whole AST machinery in with it would tax
+every process start.
+"""
+
+from __future__ import annotations
+
+_LAZY = {
+    "Config": ("mpit_tpu.analysis.lint", "Config"),
+    "run_lint": ("mpit_tpu.analysis.lint", "run_lint"),
+    "Finding": ("mpit_tpu.analysis.findings", "Finding"),
+    "load_baseline": ("mpit_tpu.analysis.findings", "load_baseline"),
+    "new_findings": ("mpit_tpu.analysis.findings", "new_findings"),
+    "write_baseline": ("mpit_tpu.analysis.findings", "write_baseline"),
+    "RuntimeChecker": ("mpit_tpu.analysis.runtime", "RuntimeChecker"),
+    "RuntimeFinding": ("mpit_tpu.analysis.runtime", "RuntimeFinding"),
+    "checking": ("mpit_tpu.analysis.runtime", "checking"),
+    "make_lock": ("mpit_tpu.analysis.runtime", "make_lock"),
+    "active_checker": ("mpit_tpu.analysis.runtime", "active_checker"),
+}
+
+__all__ = sorted(_LAZY)
+
+
+def __getattr__(name: str):
+    try:
+        module_name, attr = _LAZY[name]
+    except KeyError:
+        raise AttributeError(
+            f"module {__name__!r} has no attribute {name!r}"
+        ) from None
+    import importlib
+
+    return getattr(importlib.import_module(module_name), attr)

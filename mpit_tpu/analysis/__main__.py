@@ -1,0 +1,140 @@
+"""CLI for the distributed-correctness linter.
+
+``python -m mpit_tpu.analysis [options] [path ...]``
+
+Scans the given files/directories (default: the installed ``mpit_tpu``
+package) with rules MPT001–MPT006, subtracts the checked-in baseline, and
+exits 0 when nothing new was found. ``--write-baseline`` refreshes the
+baseline from the current scan (review the diff — every line you accept is
+a violation you are signing off on).
+
+Exit codes: 0 clean (vs baseline), 1 new findings, 2 usage/baseline error.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from pathlib import Path
+
+from mpit_tpu.analysis import findings as findings_mod
+from mpit_tpu.analysis import lint
+
+
+def _default_scan_path() -> str:
+    return str(Path(__file__).resolve().parent.parent)
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="python -m mpit_tpu.analysis",
+        description="Distributed-correctness linter (rules MPT001-MPT006).",
+    )
+    parser.add_argument(
+        "paths",
+        nargs="*",
+        help="files/directories to scan (default: the mpit_tpu package)",
+    )
+    parser.add_argument(
+        "--format",
+        choices=("text", "json"),
+        default="text",
+        help="output format (default: text)",
+    )
+    parser.add_argument(
+        "--baseline",
+        metavar="PATH",
+        help="baseline file (default: analysis-baseline.json at the repo "
+        "root, or $MPIT_ANALYSIS_BASELINE)",
+    )
+    parser.add_argument(
+        "--no-baseline",
+        action="store_true",
+        help="report every finding, ignoring any baseline",
+    )
+    parser.add_argument(
+        "--write-baseline",
+        action="store_true",
+        help="write the current findings as the new baseline and exit 0",
+    )
+    parser.add_argument(
+        "--list-rules",
+        action="store_true",
+        help="print the rule table and exit",
+    )
+    args = parser.parse_args(argv)
+
+    if args.list_rules:
+        from mpit_tpu.analysis.rules import RULE_DOCS
+
+        for rule_id in sorted(RULE_DOCS):
+            slug, doc = RULE_DOCS[rule_id]
+            print(f"{rule_id}  {slug:<26} {doc}")
+        return 0
+
+    paths = args.paths or [_default_scan_path()]
+    for p in paths:
+        if not Path(p).exists():
+            print(f"error: no such path: {p}", file=sys.stderr)
+            return 2
+
+    all_findings = lint.run_lint(paths)
+
+    baseline_path = None
+    if not args.no_baseline:
+        baseline_path = (
+            Path(args.baseline)
+            if args.baseline
+            else lint.default_baseline_path(paths[0])
+        )
+
+    if args.write_baseline:
+        if baseline_path is None:
+            print(
+                "error: no baseline path (pass --baseline or run inside "
+                "the repo)",
+                file=sys.stderr,
+            )
+            return 2
+        findings_mod.write_baseline(baseline_path, all_findings)
+        print(
+            f"wrote {len(all_findings)} finding(s) to {baseline_path}"
+        )
+        return 0
+
+    baseline = None
+    if baseline_path is not None:
+        try:
+            baseline = findings_mod.load_baseline(baseline_path)
+        except ValueError as e:
+            print(f"error: {e}", file=sys.stderr)
+            return 2
+
+    new = findings_mod.new_findings(all_findings, baseline)
+
+    if args.format == "json":
+        print(
+            json.dumps(
+                {
+                    "findings": [f.to_dict() for f in new],
+                    "total_scanned": len(all_findings),
+                    "baselined": len(all_findings) - len(new),
+                },
+                indent=2,
+            )
+        )
+    else:
+        for f in new:
+            print(f.format())
+        suffix = (
+            f" ({len(all_findings) - len(new)} baselined)"
+            if baseline
+            else ""
+        )
+        print(f"{len(new)} new finding(s){suffix}")
+    return 1 if new else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

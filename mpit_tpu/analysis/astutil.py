@@ -1,0 +1,117 @@
+"""Small AST helpers shared by the lint rules (stdlib-only, no jax import —
+the linter must run in a bare CI container and never initialize a backend).
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator, Optional
+
+
+def build_parents(tree: ast.AST) -> dict:
+    """child node -> parent node, for upward walks (enclosing fn, loops)."""
+    parents: dict = {}
+    for node in ast.walk(tree):
+        for child in ast.iter_child_nodes(node):
+            parents[child] = node
+    return parents
+
+
+def enclosing_symbol(node: ast.AST, parents: dict) -> str:
+    """Dotted qualname of the innermost enclosing def/class, or <module>."""
+    names = []
+    cur = parents.get(node)
+    while cur is not None:
+        if isinstance(
+            cur, (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef)
+        ):
+            names.append(cur.name)
+        cur = parents.get(cur)
+    return ".".join(reversed(names)) if names else "<module>"
+
+
+def dotted_name(func: ast.AST) -> Optional[str]:
+    """'jax.lax.psum' for nested Attribute/Name chains; None for anything
+    whose base isn't a plain name (calls, subscripts...)."""
+    parts = []
+    cur = func
+    while isinstance(cur, ast.Attribute):
+        parts.append(cur.attr)
+        cur = cur.value
+    if isinstance(cur, ast.Name):
+        parts.append(cur.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def call_last_name(call: ast.Call) -> Optional[str]:
+    """Last component of the callee: 'sendall' for x.y.sendall(...),
+    'psum' for psum(...). None when the callee base is itself a call or
+    subscript — but the final attribute still names the operation, so
+    ``self._connection(dst).sendall(f)`` resolves to 'sendall'."""
+    func = call.func
+    if isinstance(func, ast.Attribute):
+        return func.attr
+    if isinstance(func, ast.Name):
+        return func.id
+    return None
+
+
+def string_constants(node: ast.AST) -> Iterator[str]:
+    for sub in ast.walk(node):
+        if isinstance(sub, ast.Constant) and isinstance(sub.value, str):
+            yield sub.value
+
+
+def int_constant(node: ast.AST) -> Optional[int]:
+    """The int value of a Constant node (bools excluded), else None."""
+    if (
+        isinstance(node, ast.Constant)
+        and isinstance(node.value, int)
+        and not isinstance(node.value, bool)
+    ):
+        return node.value
+    if (  # -1 parses as UnaryOp(USub, Constant(1))
+        isinstance(node, ast.UnaryOp)
+        and isinstance(node.op, ast.USub)
+        and isinstance(node.operand, ast.Constant)
+        and isinstance(node.operand.value, int)
+        and not isinstance(node.operand.value, bool)
+    ):
+        return -node.operand.value
+    return None
+
+
+def get_arg(
+    call: ast.Call, pos: int, kw: str
+) -> Optional[ast.AST]:
+    """Argument at positional index ``pos`` or keyword ``kw``."""
+    if len(call.args) > pos and not any(
+        isinstance(a, ast.Starred) for a in call.args[: pos + 1]
+    ):
+        return call.args[pos]
+    for k in call.keywords:
+        if k.arg == kw:
+            return k.value
+    return None
+
+
+def in_loop(node: ast.AST, parents: dict) -> bool:
+    """Is ``node`` syntactically inside a for/while body, without an
+    intervening function boundary (a closure DEFINED in a loop does not
+    itself run per iteration)?"""
+    cur = parents.get(node)
+    while cur is not None:
+        if isinstance(cur, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            return False
+        if isinstance(cur, (ast.For, ast.AsyncFor, ast.While)):
+            return True
+        cur = parents.get(cur)
+    return False
+
+
+def line_text(source_lines: list, node: ast.AST) -> str:
+    try:
+        return source_lines[node.lineno - 1].strip()
+    except (AttributeError, IndexError):
+        return ""

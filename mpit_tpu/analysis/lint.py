@@ -1,0 +1,219 @@
+"""Static pass of the distributed-correctness linter.
+
+Drives the AST rules in :mod:`mpit_tpu.analysis.rules` over a file set,
+applies inline suppressions and the checked-in baseline, and returns
+:class:`~mpit_tpu.analysis.findings.Finding` lists. The analysis modules
+are stdlib-only: scanned code is parsed, never imported, and no jax
+BACKEND is ever initialized (the parent package's import does pull in the
+jax module for its compat shims, but linting touches no devices) — safe
+for pre-commit hooks and bare CI containers.
+
+Suppression layers, outermost first:
+
+1. baseline file (``analysis-baseline.json`` at the repo root): accepted
+   deviations, counted per fingerprint — the build fails only on NEW
+   findings (see :func:`mpit_tpu.analysis.findings.new_findings`);
+2. inline ``# mpit-analysis: ignore[MPT005]`` (or bare ``ignore`` for all
+   rules) on the flagged line;
+3. barrier functions: a def annotated ``# mpit-analysis: host-sync-barrier``
+   (see ``utils/profiling.force_completion``) is exempt from the host-sync
+   rule, body and call sites both.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import os
+import re
+from pathlib import Path
+from typing import Iterable, Optional, Sequence
+
+from mpit_tpu.analysis import astutil
+from mpit_tpu.analysis.findings import Finding
+
+_IGNORE_RE = re.compile(
+    r"#\s*mpit-analysis:\s*ignore(?:\[([A-Z0-9,\s]+)\])?"
+)
+_BARRIER_RE = re.compile(r"#\s*mpit-analysis:\s*host-sync-barrier")
+
+BASELINE_FILENAME = "analysis-baseline.json"
+
+
+@dataclasses.dataclass
+class Config:
+    """Knobs the rules read. Defaults describe THIS repo; tests override
+    (e.g. ``hot_all=True`` to lint a fixture as if it were a hot path)."""
+
+    # path components marking latency-critical modules for the host-sync
+    # rule (run.py, parallel/, ops/ — ISSUE 1 hot-path set)
+    hot_parts: Sequence[str] = ("parallel", "ops")
+    hot_basenames: Sequence[str] = ("run.py",)
+    hot_all: bool = False  # treat every scanned file as hot (fixtures)
+    # functions whose calls/bodies are sanctioned host syncs, on top of the
+    # `# mpit-analysis: host-sync-barrier` markers discovered in sources
+    host_sync_barriers: Sequence[str] = ("force_completion",)
+    # include mpit_tpu/parallel's TAG_* registry even when linting a path
+    # that doesn't contain it (cross-module collisions against the
+    # canonical protocol tags)
+    canonical_tag_registry: bool = True
+
+
+@dataclasses.dataclass
+class ModuleCtx:
+    path: Path  # absolute
+    rel: str  # posix, relative to the scan root
+    tree: ast.Module
+    source_lines: list
+    parents: dict
+    ignores: dict  # line -> set of rule ids, or {"*"}
+    barrier_defs: set  # function names marked host-sync-barrier
+
+    def is_hot(self, config: Config) -> bool:
+        if config.hot_all:
+            return True
+        parts = Path(self.rel).parts
+        return (
+            parts[-1] in config.hot_basenames
+            or any(p in config.hot_parts for p in parts[:-1])
+        )
+
+    def finding(self, rule: str, node: ast.AST, message: str) -> Finding:
+        return Finding(
+            rule=rule,
+            path=self.rel,
+            line=getattr(node, "lineno", 0),
+            col=getattr(node, "col_offset", 0),
+            symbol=astutil.enclosing_symbol(node, self.parents),
+            message=message,
+            text=astutil.line_text(self.source_lines, node),
+        )
+
+
+@dataclasses.dataclass
+class Project:
+    modules: list  # list[ModuleCtx]
+    config: Config
+
+
+def _parse_ignores(source_lines: list) -> dict:
+    out: dict = {}
+    for i, line in enumerate(source_lines, start=1):
+        m = _IGNORE_RE.search(line)
+        if not m:
+            continue
+        if m.group(1):
+            out[i] = {r.strip() for r in m.group(1).split(",") if r.strip()}
+        else:
+            out[i] = {"*"}
+    return out
+
+
+def _parse_barriers(tree: ast.Module, source_lines: list) -> set:
+    """Function names whose def line (or the line above it) carries the
+    host-sync-barrier marker."""
+    out = set()
+    for node in ast.walk(tree):
+        if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        for ln in (node.lineno, node.lineno - 1):
+            if 1 <= ln <= len(source_lines) and _BARRIER_RE.search(
+                source_lines[ln - 1]
+            ):
+                out.add(node.name)
+                break
+    return out
+
+
+def load_module(path: Path, rel: str) -> Optional[ModuleCtx]:
+    try:
+        source = path.read_text()
+        tree = ast.parse(source, filename=str(path))
+    except (OSError, SyntaxError):
+        return None  # unreadable / non-parse files are out of scope
+    lines = source.splitlines()
+    return ModuleCtx(
+        path=path,
+        rel=rel,
+        tree=tree,
+        source_lines=lines,
+        parents=astutil.build_parents(tree),
+        ignores=_parse_ignores(lines),
+        barrier_defs=_parse_barriers(tree, lines),
+    )
+
+
+def collect_files(paths: Iterable) -> list:
+    """(abs_path, rel) pairs for every .py under ``paths`` (files pass
+    through; directories recurse, skipping __pycache__/hidden dirs)."""
+    out = []
+    for p in paths:
+        p = Path(p)
+        if p.is_file():
+            out.append((p.resolve(), p.name))
+            continue
+        root = p.resolve()
+        for dirpath, dirnames, filenames in os.walk(root):
+            dirnames[:] = [
+                d
+                for d in sorted(dirnames)
+                if d != "__pycache__" and not d.startswith(".")
+            ]
+            for fn in sorted(filenames):
+                if fn.endswith(".py"):
+                    ap = Path(dirpath) / fn
+                    out.append((ap, ap.relative_to(root.parent).as_posix()))
+    return out
+
+
+def run_lint(
+    paths: Iterable, config: Optional[Config] = None
+) -> list:
+    """Lint ``paths`` (files and/or directories) and return the suppressed,
+    sorted finding list (baseline NOT applied — that's the caller's
+    policy decision; see :func:`mpit_tpu.analysis.findings.new_findings`)."""
+    from mpit_tpu.analysis import rules
+
+    config = config or Config()
+    modules = []
+    for ap, rel in collect_files(paths):
+        ctx = load_module(ap, rel)
+        if ctx is not None:
+            modules.append(ctx)
+    project = Project(modules=modules, config=config)
+    findings = []
+    for rule_mod in rules.RULE_MODULES:
+        findings.extend(rule_mod.run(project))
+    findings = [
+        f
+        for f in findings
+        if not _suppressed(f, {m.rel: m for m in modules})
+    ]
+    findings.sort(key=lambda f: (f.path, f.line, f.col, f.rule))
+    return findings
+
+
+def _suppressed(f: Finding, by_rel: dict) -> bool:
+    mod = by_rel.get(f.path)
+    if mod is None:
+        return False
+    ignored = mod.ignores.get(f.line, ())
+    return "*" in ignored or f.rule in ignored
+
+
+def find_repo_root(start: Path) -> Optional[Path]:
+    cur = start.resolve()
+    if cur.is_file():
+        cur = cur.parent
+    for candidate in (cur, *cur.parents):
+        if (candidate / "pyproject.toml").exists():
+            return candidate
+    return None
+
+
+def default_baseline_path(scan_path) -> Optional[Path]:
+    env = os.environ.get("MPIT_ANALYSIS_BASELINE")
+    if env:
+        return Path(env)
+    root = find_repo_root(Path(scan_path))
+    return root / BASELINE_FILENAME if root is not None else None

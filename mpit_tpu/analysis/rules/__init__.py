@@ -1,0 +1,25 @@
+"""Rule registry for the static linter.
+
+Each rule module exposes ``run(project) -> Iterable[Finding]`` plus the
+``RULES`` metadata it owns. Adding a rule = adding a module here and
+registering it in ``RULE_MODULES`` (and documenting it in
+``docs/ANALYSIS.md``).
+"""
+
+from __future__ import annotations
+
+from mpit_tpu.analysis.rules import (
+    collectives,
+    host_sync,
+    jit_signature,
+    locks,
+    tags,
+)
+
+RULE_MODULES = (collectives, tags, jit_signature, host_sync, locks)
+
+# rule id -> (title, one-line rationale); the CLI's --list-rules output and
+# the docs table are generated from this single source
+RULE_DOCS = {}
+for _mod in RULE_MODULES:
+    RULE_DOCS.update(_mod.RULES)

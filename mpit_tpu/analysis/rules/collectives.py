@@ -1,0 +1,129 @@
+"""MPT001 — collective called with a literal axis name the module never binds.
+
+``lax.psum(x, "dp")`` deadlocks (or fails to lower) unless some enclosing
+``shard_map``/``Mesh`` binds the axis ``"dp"``. Functions that take the axis
+as a *parameter* (the repo convention — ``def step(..., axis): lax.psum(g,
+axis)``) are exempt by construction: only string literals are checked, and a
+literal is fine when the same module also names that axis in a
+``shard_map``/``Mesh``/``axis_names=`` context (module granularity — the
+linter doesn't do interprocedural binding analysis, it catches the "copied a
+collective out of its mesh context" class of bug).
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterable, Iterator
+
+from mpit_tpu.analysis import astutil
+
+RULES = {
+    "MPT001": (
+        "unbound-collective-axis",
+        "lax.psum-family call with a literal axis name not bound by any "
+        "shard_map/Mesh context in the module",
+    ),
+}
+
+COLLECTIVE_FNS = {
+    "psum",
+    "pmean",
+    "pmax",
+    "pmin",
+    "psum_scatter",
+    "all_gather",
+    "all_to_all",
+    "ppermute",
+    "pshuffle",
+    "axis_index",
+    "axis_size",
+}
+
+# calls whose string constants (specs, axis_names tuples...) bind axis names.
+# P/PartitionSpec/NamedSharding count: a module that writes P("pp") specs is
+# evidently feeding them to a mesh that has the axis, even when the Mesh
+# itself is constructed elsewhere (the pipeline trainer pattern).
+_BINDING_CALLS = {"shard_map", "Mesh", "AbstractMesh", "make_mesh",
+                  "create_device_mesh", "init", "P", "PartitionSpec",
+                  "NamedSharding"}
+_BINDING_KEYWORDS = {"axis_names", "axis_name"}
+
+
+def _bound_axes(tree: ast.Module) -> set:
+    bound = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Call):
+            name = astutil.call_last_name(node)
+            if name in _BINDING_CALLS:
+                bound.update(astutil.string_constants(node))
+        if isinstance(node, ast.keyword) and node.arg in _BINDING_KEYWORDS:
+            bound.update(astutil.string_constants(node.value))
+    return bound
+
+
+def _jax_prefixed(dotted: str, module_imports_lax_names: set) -> bool:
+    parts = dotted.split(".")
+    if len(parts) == 1:
+        return parts[0] in module_imports_lax_names
+    return "lax" in parts[:-1] or parts[0] == "jax"
+
+
+def _lax_imports(tree: ast.Module) -> set:
+    """Names imported straight from jax.lax (``from jax.lax import psum``) —
+    the only way a BARE collective call is jax's rather than a local
+    helper's."""
+    out = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.ImportFrom) and node.module in (
+            "jax.lax",
+            "jax._src.lax.parallel",
+        ):
+            for alias in node.names:
+                out.add(alias.asname or alias.name)
+    return out
+
+
+def _axis_literals(arg: ast.AST) -> Iterator[str]:
+    """String literal(s) in an axis argument (a name or a tuple of names)."""
+    if isinstance(arg, ast.Constant) and isinstance(arg.value, str):
+        yield arg.value
+    elif isinstance(arg, (ast.Tuple, ast.List)):
+        for elt in arg.elts:
+            if isinstance(elt, ast.Constant) and isinstance(elt.value, str):
+                yield elt.value
+
+
+def run(project) -> Iterable:
+    for mod in project.modules:
+        bound = _bound_axes(mod.tree)
+        bare_ok = _lax_imports(mod.tree)
+        for node in ast.walk(mod.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            dotted = astutil.dotted_name(node.func)
+            if dotted is None:
+                continue
+            if dotted.split(".")[-1] not in COLLECTIVE_FNS:
+                continue
+            if not _jax_prefixed(dotted, bare_ok):
+                continue
+            axis_arg = astutil.get_arg(node, 1, "axis_name")
+            if axis_arg is None:
+                axis_arg = astutil.get_arg(node, 1, "axis")
+            if axis_arg is None and dotted.split(".")[-1] in (
+                "axis_index",
+                "axis_size",
+            ):
+                axis_arg = astutil.get_arg(node, 0, "axis_name")
+            if axis_arg is None:
+                continue
+            for lit in _axis_literals(axis_arg):
+                if lit not in bound:
+                    yield mod.finding(
+                        "MPT001",
+                        node,
+                        f"collective {dotted!r} names axis {lit!r}, which "
+                        "no shard_map/Mesh context in this module binds — "
+                        "outside an SPMD context this deadlocks or fails "
+                        "to lower",
+                    )

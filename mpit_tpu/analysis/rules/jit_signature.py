@@ -1,0 +1,152 @@
+"""MPT004 — ``jax.jit`` static/donate argument drift vs the wrapped signature.
+
+The exact failure class of commit c166392: a function gains or loses a
+parameter, the ``static_argnums`` tuple on its jit wrapper silently keeps
+pointing at the old positions, and the first symptom is an AOT-lowering
+failure (or, worse, a tracer leaking into a hash-based cache key) far from
+the edit. Checked statically:
+
+- every index in ``static_argnums``/``donate_argnums`` must be a valid
+  positional index of the wrapped function (skipped when it takes
+  ``*args``);
+- every name in ``static_argnames``/``donate_argnames`` must be a
+  parameter name (skipped when it takes ``**kwargs``).
+
+Covered shapes: ``@jax.jit(...)`` / ``@functools.partial(jax.jit, ...)``
+decorators, and module-level ``f = jax.jit(g, static_argnums=...)``
+assignments where ``g`` is a def in the same module. Non-literal index/name
+expressions are skipped (no constant folding).
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterable, Optional
+
+from mpit_tpu.analysis import astutil
+
+RULES = {
+    "MPT004": (
+        "jit-static-drift",
+        "jit static_argnums/static_argnames (or donate_*) out of range / "
+        "not in the wrapped function's signature",
+    ),
+}
+
+_INDEX_KW = ("static_argnums", "donate_argnums")
+_NAME_KW = ("static_argnames", "donate_argnames")
+_JIT_NAMES = {"jit"}  # jax.jit, jax.api.jit, bare jit from jax import
+
+
+def _is_jit(func: ast.AST) -> bool:
+    dotted = astutil.dotted_name(func)
+    return dotted is not None and dotted.split(".")[-1] in _JIT_NAMES
+
+
+def _jit_keywords(call: ast.Call) -> Optional[list]:
+    """The keyword list of a jit wrapper call, for both spellings:
+    ``jax.jit(fn, ...)`` and ``functools.partial(jax.jit, ...)``."""
+    if _is_jit(call.func):
+        return call.keywords
+    dotted = astutil.dotted_name(call.func)
+    if (
+        dotted is not None
+        and dotted.split(".")[-1] == "partial"
+        and call.args
+        and isinstance(call.args[0], (ast.Attribute, ast.Name))
+        and _is_jit(call.args[0])
+    ):
+        return call.keywords
+    return None
+
+
+def _int_tuple(node: ast.AST) -> Optional[list]:
+    single = astutil.int_constant(node)
+    if single is not None:
+        return [single]
+    if isinstance(node, (ast.Tuple, ast.List)):
+        out = []
+        for elt in node.elts:
+            v = astutil.int_constant(elt)
+            if v is None:
+                return None  # non-literal member: skip the whole check
+            out.append(v)
+        return out
+    return None
+
+
+def _str_tuple(node: ast.AST) -> Optional[list]:
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return [node.value]
+    if isinstance(node, (ast.Tuple, ast.List)):
+        out = []
+        for elt in node.elts:
+            if not (
+                isinstance(elt, ast.Constant) and isinstance(elt.value, str)
+            ):
+                return None
+            out.append(elt.value)
+        return out
+    return None
+
+
+def _check(mod, site: ast.AST, keywords: list, fn: ast.FunctionDef):
+    pos_params = [a.arg for a in fn.args.posonlyargs + fn.args.args]
+    all_names = set(pos_params) | {a.arg for a in fn.args.kwonlyargs}
+    has_varargs = fn.args.vararg is not None
+    has_varkw = fn.args.kwarg is not None
+    for kw in keywords:
+        if kw.arg in _INDEX_KW and not has_varargs:
+            idxs = _int_tuple(kw.value)
+            for idx in idxs or ():
+                if not 0 <= idx < len(pos_params):
+                    yield mod.finding(
+                        "MPT004",
+                        site,
+                        f"{kw.arg} index {idx} out of range for "
+                        f"{fn.name}() with {len(pos_params)} positional "
+                        "parameters — signature drifted under its jit "
+                        "wrapper (the c166392 failure class)",
+                    )
+        elif kw.arg in _NAME_KW and not has_varkw:
+            names = _str_tuple(kw.value)
+            for name in names or ():
+                if name not in all_names:
+                    yield mod.finding(
+                        "MPT004",
+                        site,
+                        f"{kw.arg} names {name!r}, which is not a "
+                        f"parameter of {fn.name}() — signature drifted "
+                        "under its jit wrapper",
+                    )
+
+
+def run(project) -> Iterable:
+    for mod in project.modules:
+        # module-level defs by name, for the assignment form
+        defs = {
+            n.name: n
+            for n in ast.walk(mod.tree)
+            if isinstance(n, ast.FunctionDef)
+        }
+        for node in ast.walk(mod.tree):
+            if isinstance(node, ast.FunctionDef):
+                for dec in node.decorator_list:
+                    if not isinstance(dec, ast.Call):
+                        continue
+                    kws = _jit_keywords(dec)
+                    if kws is not None:
+                        yield from _check(mod, dec, kws, node)
+            elif isinstance(node, ast.Assign):
+                if not (
+                    isinstance(node.value, ast.Call)
+                    and _is_jit(node.value.func)
+                    and node.value.args
+                    and isinstance(node.value.args[0], ast.Name)
+                ):
+                    continue
+                fn = defs.get(node.value.args[0].id)
+                if fn is not None:
+                    yield from _check(
+                        mod, node.value, node.value.keywords, fn
+                    )

@@ -1,0 +1,278 @@
+"""Runtime lock-order and tag-concurrency checker for the transport layer.
+
+Opt-in instrumentation (zero overhead when off — the transports call
+:func:`make_lock` at construction and :func:`active_checker` per recv, both
+of which short-circuit on the module-level ``_ACTIVE`` being None):
+
+- **RT101 lock-order cycles.** Every :class:`_TrackedLock` acquisition
+  records, per thread, the set of locks already held and adds *order edges*
+  ``held -> acquiring`` to a global directed graph. A cycle in that graph is
+  a potential deadlock EVEN IF the runs that built the two halves of the
+  cycle never overlapped in time — which is exactly why a graph beats
+  timeout-based detection: the inversion is caught on a clean single-run
+  test, not on the unlucky production schedule.
+- **RT102 concurrent tag reuse.** :class:`~mpit_tpu.transport.inproc.Broker`
+  registers every blocking ``get`` (recv) as a *waiter* keyed by
+  ``(broker, dst, src, tag)``. Two waiters on the same mailbox whose
+  filters can match the same message — same concrete tag, sources equal or
+  either a wildcard, different threads — mean two protocol roles are
+  racing for one tag: whichever recv matches first steals the other role's
+  message. (Wildcard-tag waiters are exempt: ``recv(ANY_TAG)`` is the
+  single-threaded dispatcher pattern, e.g. the pserver loop.)
+
+Usage::
+
+    from mpit_tpu.analysis import runtime
+    with runtime.checking() as checker:
+        ...construct transports / brokers and run traffic...
+    assert not checker.findings
+
+Locks created BEFORE the checker was enabled stay untracked (they were
+handed out as plain ``threading.Lock``): enable the checker first, then
+construct the transports under test.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import dataclasses
+import itertools
+import threading
+from typing import Iterator, Optional
+
+ANY = -1  # mirrors transport.ANY_SOURCE/ANY_TAG without importing transport
+
+
+@dataclasses.dataclass(frozen=True)
+class RuntimeFinding:
+    rule: str  # "RT101" | "RT102"
+    message: str
+
+    def format(self) -> str:
+        return f"{self.rule}: {self.message}"
+
+
+@dataclasses.dataclass(frozen=True)
+class _Waiter:
+    token: int
+    thread: int
+    thread_name: str
+    broker: int  # id() of the broker — scoping is per broker
+    dst: int
+    src: int
+    tag: int
+
+    def overlaps(self, other: "_Waiter") -> bool:
+        if self.broker != other.broker or self.dst != other.dst:
+            return False
+        if self.thread == other.thread:
+            return False  # one role draining sequentially
+        if self.tag == ANY or other.tag == ANY:
+            return False  # wildcard dispatcher pattern
+        if self.tag != other.tag:
+            return False
+        return (
+            self.src == other.src or self.src == ANY or other.src == ANY
+        )
+
+
+class RuntimeChecker:
+    """Collects RT101/RT102 findings; thread-safe; activate via
+    :func:`checking` (or :func:`enable`/:func:`disable` for long-lived
+    diagnostics sessions)."""
+
+    def __init__(self):
+        self._mu = threading.Lock()
+        self.findings: list = []
+        # lock-order graph over lock INSTANCES (ids) — names alias freely
+        # (every per-dst lock shares one name) so identity is the node
+        self._edges: dict = {}  # id -> set(id)
+        self._names: dict = {}  # id -> name
+        self._reported_edges: set = set()
+        self._held = threading.local()
+        self._waiters: dict = {}  # token -> _Waiter
+        self._token_counter = itertools.count(1)
+        self._reported_tags: set = set()
+
+    # -- lock-order graph -------------------------------------------------
+
+    def _held_stack(self) -> list:
+        stack = getattr(self._held, "stack", None)
+        if stack is None:
+            stack = self._held.stack = []
+        return stack
+
+    def on_acquire(self, lock: "_TrackedLock") -> None:
+        """Called BEFORE the underlying acquire blocks, so a deadlock in
+        progress still records the edge that explains it."""
+        stack = self._held_stack()
+        me = id(lock)
+        with self._mu:
+            self._names[me] = lock.name
+            for held in stack:
+                if held == me:
+                    continue  # reentrant misuse; RT101 is not that check
+                self._add_edge(held, me)
+        stack.append(me)
+
+    def on_release(self, lock: "_TrackedLock") -> None:
+        stack = self._held_stack()
+        me = id(lock)
+        # remove the most recent occurrence; out-of-order release is legal
+        for i in range(len(stack) - 1, -1, -1):
+            if stack[i] == me:
+                del stack[i]
+                break
+
+    def _add_edge(self, a: int, b: int) -> None:
+        """a held while acquiring b. Caller holds self._mu."""
+        if b in self._edges.setdefault(a, set()):
+            return
+        self._edges[a].add(b)
+        path = self._find_path(b, a)
+        if path is not None:
+            key = frozenset(path)
+            if key not in self._reported_edges:
+                self._reported_edges.add(key)
+                names = " -> ".join(
+                    self._names.get(n, f"lock@{n:#x}") for n in path + [b]
+                )
+                self.findings.append(
+                    RuntimeFinding(
+                        "RT101",
+                        "lock-order cycle (potential deadlock): "
+                        f"{names} — two threads acquire these locks in "
+                        "opposite orders",
+                    )
+                )
+
+    def _find_path(self, start: int, goal: int) -> Optional[list]:
+        """DFS path start..goal in the edge graph, else None."""
+        stack = [(start, [start])]
+        seen = set()
+        while stack:
+            node, path = stack.pop()
+            if node == goal:
+                return path
+            if node in seen:
+                continue
+            seen.add(node)
+            for nxt in self._edges.get(node, ()):
+                stack.append((nxt, path + [nxt]))
+        return None
+
+    # -- tag concurrency --------------------------------------------------
+
+    def on_recv_enter(
+        self, broker, dst: int, src: int, tag: int
+    ) -> int:
+        """Register a blocking recv; returns a token for
+        :meth:`on_recv_exit`. Emits RT102 when an already-active waiter on
+        the same mailbox can match the same messages."""
+        th = threading.current_thread()
+        waiter = _Waiter(
+            token=next(self._token_counter),
+            thread=th.ident or 0,
+            thread_name=th.name,
+            broker=id(broker),
+            dst=dst,
+            src=src,
+            tag=tag,
+        )
+        with self._mu:
+            for other in self._waiters.values():
+                if waiter.overlaps(other):
+                    key = (waiter.broker, dst, tag)
+                    if key not in self._reported_tags:
+                        self._reported_tags.add(key)
+                        self.findings.append(
+                            RuntimeFinding(
+                                "RT102",
+                                f"tag {tag} on rank {dst} is being "
+                                "received concurrently by threads "
+                                f"{other.thread_name!r} (src filter "
+                                f"{other.src}) and "
+                                f"{waiter.thread_name!r} (src filter "
+                                f"{waiter.src}) — two protocol roles "
+                                "share one tag; whichever matches first "
+                                "steals the other's message",
+                            )
+                        )
+            self._waiters[waiter.token] = waiter
+        return waiter.token
+
+    def on_recv_exit(self, token: int) -> None:
+        with self._mu:
+            self._waiters.pop(token, None)
+
+
+class _TrackedLock:
+    """threading.Lock wrapper reporting acquisition order to a checker.
+
+    Bound to the checker active at CREATION time, so a checker torn down
+    mid-flight (the ``checking()`` block exited while a transport thread
+    still runs) keeps receiving events instead of the thread crashing."""
+
+    def __init__(self, name: str, checker: RuntimeChecker):
+        self._lock = threading.Lock()
+        self.name = name
+        self._checker = checker
+
+    def acquire(self, blocking: bool = True, timeout: float = -1) -> bool:
+        self._checker.on_acquire(self)
+        got = self._lock.acquire(blocking, timeout)
+        if not got:
+            self._checker.on_release(self)
+        return got
+
+    def release(self) -> None:
+        self._lock.release()
+        self._checker.on_release(self)
+
+    def locked(self) -> bool:
+        return self._lock.locked()
+
+    def __enter__(self) -> bool:
+        return self.acquire()
+
+    def __exit__(self, *exc) -> None:
+        self.release()
+
+
+_ACTIVE: Optional[RuntimeChecker] = None
+
+
+def active_checker() -> Optional[RuntimeChecker]:
+    return _ACTIVE
+
+
+def make_lock(name: str):
+    """The transport lock factory: a plain ``threading.Lock`` normally, a
+    tracked lock while a checker is active. ``name`` is the diagnostic
+    role label (instances may share it; identity drives the graph)."""
+    checker = _ACTIVE
+    if checker is None:
+        return threading.Lock()
+    return _TrackedLock(name, checker)
+
+
+def enable(checker: Optional[RuntimeChecker] = None) -> RuntimeChecker:
+    global _ACTIVE
+    _ACTIVE = checker or RuntimeChecker()
+    return _ACTIVE
+
+
+def disable() -> None:
+    global _ACTIVE
+    _ACTIVE = None
+
+
+@contextlib.contextmanager
+def checking() -> Iterator[RuntimeChecker]:
+    """Enable a fresh checker for the block; disables on exit (the checker
+    object and its findings stay readable afterwards)."""
+    checker = enable()
+    try:
+        yield checker
+    finally:
+        disable()

@@ -1,0 +1,61 @@
+"""Compatibility shims for the range of jax releases this package runs on.
+
+The codebase targets the modern public API (``jax.shard_map`` with
+``check_vma``). On older jaxlibs (< 0.5) that API lives at
+``jax.experimental.shard_map.shard_map`` and spells the replication check
+``check_rep``. Installing the alias here — imported from the package
+``__init__`` before any trainer module loads — keeps every call site on the
+one modern spelling instead of scattering try/except at 15 import sites.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+
+
+def _install_shard_map_alias() -> None:
+    if hasattr(jax, "shard_map"):
+        return
+    from jax.experimental.shard_map import shard_map as _shard_map
+
+    @functools.wraps(_shard_map)
+    def shard_map(f, /, *args, **kwargs):
+        if "check_vma" in kwargs:
+            kwargs["check_rep"] = kwargs.pop("check_vma")
+        if "axis_names" in kwargs:
+            # modern partial-manual mode names the MANUAL axes; the old
+            # API names the complement ("auto" axes of the mesh)
+            manual = frozenset(kwargs.pop("axis_names"))
+            mesh = kwargs.get("mesh", args[0] if args else None)
+            kwargs["auto"] = frozenset(mesh.axis_names) - manual
+        return _shard_map(f, *args, **kwargs)
+
+    jax.shard_map = shard_map
+
+
+def _install_axis_size_alias() -> None:
+    if hasattr(jax.lax, "axis_size"):
+        return
+    from jax._src.core import get_axis_env
+
+    def axis_size(axis_name):
+        """Static size of a bound mesh axis (product over a tuple of
+        names), as the modern ``jax.lax.axis_size`` returns it."""
+        env = get_axis_env()
+        names = (
+            axis_name
+            if isinstance(axis_name, (tuple, list))
+            else (axis_name,)
+        )
+        out = 1
+        for name in names:
+            out *= env.axis_size(name)
+        return out
+
+    jax.lax.axis_size = axis_size
+
+
+_install_shard_map_alias()
+_install_axis_size_alias()

@@ -111,13 +111,19 @@ def generate_rnn(
     :func:`sampling.generate_batch` shape; row n pinned equal to its
     solo call at ``fold_in(rng, n)``). Unlike the transformer there is
     no ``max_len`` — an RNN carry has no positional horizon.
+
+    Empty-input contract — this DIVERGES from the transformer path:
+    :func:`sampling.generate_batch` maps ``[] -> []``, but here a flat
+    empty ``prompts`` is ambiguous between "empty batch" and "one empty
+    prompt", so the empty *list* ``[]`` is treated as one empty prompt
+    and rejected by the shared validator (the same ``ValueError``
+    ``generate``/``generate_fast`` raise on an empty prompt) — a caller
+    bug cannot silently come back as ``[]``. The one unambiguous
+    spelling of "empty batch" is the empty *tuple* ``prompts=()``,
+    which returns ``[]``.
     """
-    # A flat empty sequence is treated as a SOLO empty prompt and
-    # rejected by the shared validator below — the same error
-    # generate/generate_fast raise — so a caller bug cannot silently
-    # come back as []. ([] cannot mean "empty batch" here: that call is
-    # a degenerate no-op better served by generate_batch's []->[]
-    # contract on the transformer path.)
+    if isinstance(prompts, tuple) and len(prompts) == 0:
+        return []  # explicit empty batch: the () spelling documented above
     solo = len(prompts) == 0 or not hasattr(prompts[0], "__len__")
     batch = [prompts] if solo else list(prompts)
     for q in batch:

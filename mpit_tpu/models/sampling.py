@@ -64,9 +64,16 @@ def generate(
     explicit ``rng`` key), optionally restricted to the ``top_k``
     highest-scoring tokens, the ``top_p`` probability nucleus, and/or
     the ``min_p`` band (tokens at least min_p times as probable as the
-    best) — temperature scales first, then the filters, the standard
-    order. ``model`` must be the dense single-device configuration
-    (``seq_axis=None``).
+    best). Filter order is fixed: temperature scales first, then
+    **top-k → min-p → top-p** (see :func:`_filter_logits`). Because
+    top-p's cumulative mass is computed over the distribution
+    renormalized AFTER the top-k/min-p masks, combining ``top_p`` with
+    ``min_p`` diverges from HuggingFace-style warper pipelines (which
+    evaluate each filter on the distribution as earlier warpers left
+    it, with min-p ordered differently): the nucleus here can admit
+    tokens an HF pipeline at the same settings would drop, and vice
+    versa. Each filter alone matches the standard definition. ``model``
+    must be the dense single-device configuration (``seq_axis=None``).
     """
     _validate(model, prompt, temperature, top_k, top_p, min_p=min_p)
     length = model.max_len
@@ -221,8 +228,10 @@ def generate_fast(
     """KV-cached generation: continue ``prompt`` by ``steps`` tokens.
 
     Same sampling semantics as :func:`generate` (greedy at
-    ``temperature=0``, else softmax sampling keyed per generated token),
-    but compiled as one program — the serving path (the N=1 row of the
+    ``temperature=0``, else softmax sampling keyed per generated token,
+    with the same fixed **top-k → min-p → top-p** filter order and the
+    same HF divergence when ``top_p`` and ``min_p`` combine — see
+    :func:`generate`), but compiled as one program — the serving path (the N=1 row of the
     chunked-prefill kernel: one dense pass for the prompt, one scan
     tick per generated token). Narrower model support than
     :func:`generate`, which handles anything dense ``apply`` can run:

@@ -93,7 +93,7 @@ def _spec_round(
 @functools.partial(jax.jit, static_argnums=(0, 1, 2, 3, 4))
 def _spec_loop(
     tgt, dft, k, pre_bucket, gen_bucket,
-    t_params, d_params, t_cache, d_cache, pre_buf, p_lens,
+    limit, t_params, d_params, t_cache, d_cache, pre_buf, p_lens,
 ):
     """The compiled speculative loop (N rows, greedy — every per-row
     quantity rides the per-row cache clocks).
@@ -108,7 +108,13 @@ def _spec_loop(
     are accepted). Rows that reached their budget freeze (m = 0): they
     keep riding the batch — their rewound clocks make every later
     chunk rewrite the same discarded slots — while the loop runs until
-    EVERY row is done. Row independence (each row's outputs depend
+    EVERY row is done. The budget is the TRACED ``limit`` (= the
+    caller's ``steps``), not the static ``gen_bucket`` shape: rows
+    freeze at ``n >= steps``, so a steps=5 request in a gen_bucket=8
+    program stops after 5 tokens instead of decoding 3 more that the
+    caller slices off — and the one compiled program still serves
+    every steps value in the bucket. Row independence (each row's
+    outputs depend
     only on its own tokens and clock) is what keeps a row's result
     identical whatever the other rows do — the same property the
     serving batch==solo tests pin.
@@ -127,7 +133,7 @@ def _spec_loop(
 
     def body(carry):
         t_cache, d_cache, prev, pos, n, it, out = carry
-        active = n < gen_bucket  # (nb,)
+        active = n < limit  # (nb,)
         t_cache, d_cache, new_prev, new_pos, t, a, m = _spec_round(
             tgt, dft, k, t_params, d_params,
             t_cache, d_cache, prev, pos, active,
@@ -144,7 +150,7 @@ def _spec_loop(
         )
 
     def cond(carry):
-        return jnp.any(carry[4] < gen_bucket)
+        return jnp.any(carry[4] < limit)
 
     _, _, _, _, n, iters, out = jax.lax.while_loop(
         cond, body,
@@ -255,7 +261,7 @@ def _run_spec(
     )
     out, n, iters = _spec_loop(
         tgt, dft, k, pre_bucket, gen_bucket,
-        params, draft_params,
+        jnp.asarray(steps, jnp.int32), params, draft_params,
         sampling._zero_cache(tgt, nb), sampling._zero_cache(dft, nb),
         pre_buf, p_lens,
     )

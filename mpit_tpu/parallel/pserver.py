@@ -40,6 +40,7 @@ from typing import Optional, Sequence
 
 import numpy as np
 
+from mpit_tpu.analysis.runtime import make_lock
 from mpit_tpu.transport import ANY_SOURCE, ANY_TAG, RecvTimeout, Transport
 
 TAG_FETCH = 1
@@ -117,7 +118,7 @@ class PServer:
         self.dead_clients: set[int] = set()
         self._stopped: set[int] = set()
         self.error: Optional[BaseException] = None
-        self._lock = threading.Lock()
+        self._lock = make_lock("PServer._lock")
         if ckpt_every is not None and ckpt_every < 1:
             raise ValueError(
                 "ckpt_every must be >= 1 (None = persist only at teardown)"

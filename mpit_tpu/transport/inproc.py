@@ -15,6 +15,7 @@ import threading
 import time
 from typing import Any, Optional
 
+from mpit_tpu.analysis import runtime as _rt
 from mpit_tpu.transport.base import (
     ANY_SOURCE,
     ANY_TAG,
@@ -49,24 +50,36 @@ class Broker:
     ) -> Message:
         cond = self._conds[dst]
         deadline = None if timeout is None else time.monotonic() + timeout
-        with cond:
-            while True:
-                q = self._queues[dst]
-                # scan in arrival order: preserves per-(src,tag) FIFO, and
-                # gives ANY_SOURCE the MPI arrival-order semantics
-                for i, msg in enumerate(q):
-                    if msg.matches(src, tag):
-                        del q[i]
-                        return msg
-                if deadline is None:
-                    cond.wait()
-                else:
-                    remaining = deadline - time.monotonic()
-                    if remaining <= 0 or not cond.wait(remaining):
-                        raise RecvTimeout(
-                            f"rank {dst}: no message from src={src} "
-                            f"tag={tag} within {timeout}s"
-                        )
+        # RT102 instrumentation: register this recv as a waiter so the
+        # runtime checker can flag two protocol roles racing for one tag
+        checker = _rt.active_checker()
+        token = (
+            checker.on_recv_enter(self, dst, src, tag)
+            if checker is not None
+            else None
+        )
+        try:
+            with cond:
+                while True:
+                    q = self._queues[dst]
+                    # scan in arrival order: preserves per-(src,tag) FIFO,
+                    # and gives ANY_SOURCE the MPI arrival-order semantics
+                    for i, msg in enumerate(q):
+                        if msg.matches(src, tag):
+                            del q[i]
+                            return msg
+                    if deadline is None:
+                        cond.wait()
+                    else:
+                        remaining = deadline - time.monotonic()
+                        if remaining <= 0 or not cond.wait(remaining):
+                            raise RecvTimeout(
+                                f"rank {dst}: no message from src={src} "
+                                f"tag={tag} within {timeout}s"
+                            )
+        finally:
+            if token is not None:
+                checker.on_recv_exit(token)
 
     def peek(self, dst: int, src: int = ANY_SOURCE, tag: int = ANY_TAG) -> bool:
         with self._conds[dst]:

@@ -37,6 +37,7 @@ import struct
 import threading
 from typing import Any, Optional, Sequence
 
+from mpit_tpu.analysis.runtime import make_lock
 from mpit_tpu.transport.base import (
     ANY_SOURCE,
     ANY_TAG,
@@ -97,12 +98,14 @@ class SocketTransport(Transport):
         # reconnect fencing: newest accept-ordered connection seq per src
         self._accept_seq = 0
         self._src_seq: dict[int, int] = {}
-        self._src_seq_lock = threading.Lock()
+        self._src_seq_lock = make_lock("SocketTransport._src_seq_lock")
         self._out: dict[int, socket.socket] = {}
-        self._out_cache_lock = threading.Lock()  # guards the dict only
+        self._out_cache_lock = make_lock(
+            "SocketTransport._out_cache_lock"
+        )  # guards the dict only
         # per-destination lock: a slow connect/send to one rank must not
         # serialize traffic to healthy ranks
-        self._dst_locks: dict[int, threading.Lock] = {}
+        self._dst_locks: dict[int, Any] = {}
         # per-destination outbound queues drained by lazily-created sender
         # threads: isend returns immediately, and because send() rides the
         # same queue, send/isend to one dst stay FIFO (the MPI order rule)
@@ -156,11 +159,13 @@ class SocketTransport(Transport):
         except (ConnectionError, OSError):
             return
 
-    def _dst_lock(self, dst: int) -> threading.Lock:
+    def _dst_lock(self, dst: int):
         with self._out_cache_lock:
             lock = self._dst_locks.get(dst)
             if lock is None:
-                lock = self._dst_locks[dst] = threading.Lock()
+                lock = self._dst_locks[dst] = make_lock(
+                    f"SocketTransport._dst_locks[{dst}]"
+                )
             return lock
 
     def _connection(self, dst: int) -> socket.socket:

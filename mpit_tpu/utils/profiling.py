@@ -32,7 +32,7 @@ def annotate(name: str):
     return jax.profiler.TraceAnnotation(name)
 
 
-def force_completion(*results) -> float:
+def force_completion(*results) -> float:  # mpit-analysis: host-sync-barrier
     """Proof of device execution, not just dispatch — THE one copy.
 
     On the axon tunnel platform ``jax.block_until_ready`` returns before

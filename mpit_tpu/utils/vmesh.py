@@ -34,6 +34,32 @@ _RENDEZVOUS_FLAGS = {
     # 15-minute hang.
     "--xla_cpu_collective_call_terminate_timeout_seconds": 120,
 }
+# Flag registration varies across jaxlib builds, and an unknown XLA_FLAGS
+# entry is FATAL at backend init (parse_flags_from_env.cc aborts the
+# process) — so the rendezvous flags are probed once in a throwaway
+# subprocess before being adopted. The verdict is cached in the environment:
+# child processes (mpit_tpu.launch ranks re-run this module) inherit it and
+# skip the probe.
+_PROBE_ENV = "MPIT_XLA_RENDEZVOUS_FLAGS_OK"
+
+
+def _rendezvous_flags_supported() -> bool:
+    cached = os.environ.get(_PROBE_ENV)
+    if cached is not None:
+        return cached == "1"
+    flag_str = " ".join(f"{k}={v}" for k, v in _RENDEZVOUS_FLAGS.items())
+    code = (
+        "import os; "
+        f"os.environ['XLA_FLAGS'] = {flag_str!r}; "
+        "os.environ['JAX_PLATFORMS'] = 'cpu'; "
+        "import jax; "
+        "jax.config.update('jax_platforms', 'cpu'); "
+        "jax.devices()"
+    )
+    rc = run_bounded(code, timeout=60, quiet=True)
+    ok = rc == 0  # unknown flag -> SIGABRT; hang -> None; both mean "no"
+    os.environ[_PROBE_ENV] = "1" if ok else "0"
+    return ok
 
 
 def run_bounded(
@@ -82,9 +108,11 @@ def force_virtual_devices(n: int, platform: str = "cpu") -> None:
     flags = os.environ.get("XLA_FLAGS", "")
     for flag in (_COUNT_FLAG, *_RENDEZVOUS_FLAGS):
         flags = re.sub(flag + r"=\d+", "", flags)
-    extra = " ".join(
-        [f"{_COUNT_FLAG}={n}"]
-        + [f"{k}={v}" for k, v in _RENDEZVOUS_FLAGS.items()]
+    rendezvous = (
+        [f"{k}={v}" for k, v in _RENDEZVOUS_FLAGS.items()]
+        if _rendezvous_flags_supported()
+        else []
     )
+    extra = " ".join([f"{_COUNT_FLAG}={n}"] + rendezvous)
     os.environ["XLA_FLAGS"] = " ".join((flags + " " + extra).split())
     repin_platform(platform)

@@ -1,0 +1,20 @@
+#!/usr/bin/env bash
+# Distributed-correctness lint gate.
+#
+#   scripts/lint.sh                 # fail on findings not in the baseline
+#   scripts/lint.sh --update        # accept the current findings as baseline
+#   scripts/lint.sh path/to/file.py # lint specific paths (vs the baseline)
+#
+# Exit codes: 0 clean vs baseline, 1 new findings, 2 usage error.
+# The linter parses, never imports, the scanned code and initializes no
+# jax backend — safe for pre-commit hooks.
+set -euo pipefail
+
+cd "$(dirname "$0")/.."
+
+if [[ "${1:-}" == "--update" ]]; then
+    shift
+    exec python -m mpit_tpu.analysis --write-baseline "${@:-mpit_tpu/}"
+fi
+
+exec python -m mpit_tpu.analysis "${@:-mpit_tpu/}"

@@ -1,0 +1,11 @@
+"""Seeded MPT001: collective with a literal axis name, no binding context.
+
+This file is parsed by the linter tests, never imported or executed.
+"""
+
+from jax import lax
+
+
+def bad_mean(x):
+    # "rows" is never bound by any shard_map/Mesh/P spec in this module
+    return lax.psum(x, "rows")

@@ -1,0 +1,8 @@
+"""Seeded MPT002: hard-coded literal tag at a transport send site.
+
+This file is parsed by the linter tests, never imported or executed.
+"""
+
+
+def push_update(transport, payload):
+    transport.send(0, 42, payload)  # 42 claims a tag outside the registry

@@ -1,0 +1,8 @@
+"""Seeded MPT003: TAG_* constant colliding with the canonical registry.
+
+TAG_FETCH = 1 in mpit_tpu/parallel/pserver.py owns this value; a second
+module claiming it corrupts the fetch mailbox the moment they share a
+broker. This file is parsed by the linter tests, never imported.
+"""
+
+TAG_CLASH = 1

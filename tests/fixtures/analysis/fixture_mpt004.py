@@ -1,0 +1,15 @@
+"""Seeded MPT004: jit static_argnums drifted off the wrapped signature.
+
+The c166392 failure class: the function lost parameters but the wrapper
+still pins index 7. This file is parsed by the linter tests, never
+imported or executed.
+"""
+
+import functools
+
+import jax
+
+
+@functools.partial(jax.jit, static_argnums=(0, 7))
+def step(model, batch):
+    return model, batch

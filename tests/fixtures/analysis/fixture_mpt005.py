@@ -1,0 +1,13 @@
+"""Seeded MPT005: host-device sync inside a loop (linted as hot path).
+
+This file is parsed by the linter tests (with ``Config(hot_all=True)``),
+never imported or executed.
+"""
+
+
+def train(step_fn, batches):
+    total = 0.0
+    for batch in batches:
+        loss = step_fn(batch)
+        total += loss.item()  # device->host round-trip every iteration
+    return total

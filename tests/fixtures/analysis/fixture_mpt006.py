@@ -1,0 +1,14 @@
+"""Seeded MPT006: indefinitely-blocking socket call under a held lock.
+
+This file is parsed by the linter tests, never imported or executed.
+"""
+
+
+class Sender:
+    def __init__(self, sock, lock):
+        self.sock = sock
+        self._send_lock = lock
+
+    def flush(self, frame):
+        with self._send_lock:
+            self.sock.sendall(frame)  # one slow peer stalls every sender

@@ -1,0 +1,426 @@
+"""mpit_tpu.analysis: linter rules, baseline discipline, CLI, runtime checker.
+
+Three layers, mirroring the subsystem:
+
+- the repo SELF-CHECK: linting ``mpit_tpu/`` must produce exactly the
+  checked-in baseline (``analysis-baseline.json``) — a new finding anywhere
+  in the package fails here before it fails in CI;
+- seeded FIXTURES (``tests/fixtures/analysis/``): each file triggers
+  exactly its one rule, pinning both directions (the rule fires on its
+  target pattern, and fires on nothing else in the fixture);
+- the RUNTIME checker: a seeded lock-order inversion and a seeded tag
+  collision are detected, and clean transport traffic — including a
+  multi-thread stress run — reports zero findings.
+"""
+
+from __future__ import annotations
+
+import json
+import subprocess
+import sys
+import threading
+import time
+from pathlib import Path
+
+import pytest
+
+from mpit_tpu.analysis import findings as findings_mod
+from mpit_tpu.analysis import lint, runtime
+from mpit_tpu.analysis.findings import Finding
+from mpit_tpu.transport import ANY_SOURCE, ANY_TAG, Broker
+
+REPO = Path(__file__).resolve().parent.parent
+PKG = REPO / "mpit_tpu"
+FIXTURES = Path(__file__).resolve().parent / "fixtures" / "analysis"
+BASELINE = REPO / lint.BASELINE_FILENAME
+
+
+# ---------------------------------------------------------------- self-check
+
+
+def test_repo_matches_baseline():
+    """The package linted against the checked-in baseline is clean — the
+    acceptance gate ``python -m mpit_tpu.analysis mpit_tpu/`` enforces."""
+    findings = lint.run_lint([PKG])
+    baseline = findings_mod.load_baseline(BASELINE)
+    new = findings_mod.new_findings(findings, baseline)
+    assert new == [], "\n".join(f.format() for f in new)
+
+
+def test_baseline_is_not_stale():
+    """Every baselined fingerprint still occurs — fixed violations must
+    leave the baseline, or it masks a future regression of the same
+    shape."""
+    findings = lint.run_lint([PKG])
+    from collections import Counter
+
+    current = Counter(f.fingerprint for f in findings)
+    baseline = findings_mod.load_baseline(BASELINE)
+    stale = {
+        fp: n for fp, n in baseline.items() if current.get(fp, 0) < n
+    }
+    assert not stale, f"baselined but no longer present: {sorted(stale)}"
+
+
+# ------------------------------------------------------------------ fixtures
+
+
+@pytest.mark.parametrize(
+    "fixture, rule",
+    [
+        ("fixture_mpt001.py", "MPT001"),
+        ("fixture_mpt002.py", "MPT002"),
+        ("fixture_mpt003.py", "MPT003"),
+        ("fixture_mpt004.py", "MPT004"),
+        ("fixture_mpt005.py", "MPT005"),
+        ("fixture_mpt006.py", "MPT006"),
+    ],
+)
+def test_fixture_triggers_exactly_its_rule(fixture, rule):
+    findings = lint.run_lint(
+        [FIXTURES / fixture], lint.Config(hot_all=True)
+    )
+    assert {f.rule for f in findings} == {rule}, [
+        f.format() for f in findings
+    ]
+
+
+def test_fixtures_are_never_collected():
+    """The seeded-bug files must stay parse-only: no test_ prefix, and
+    nothing imports them (they contain deliberate defects)."""
+    for py in FIXTURES.glob("*.py"):
+        assert py.name.startswith("fixture_")
+
+
+# --------------------------------------------------------- rule specifics
+
+
+def _lint_source(tmp_path, source, config=None):
+    f = tmp_path / "mod.py"
+    f.write_text(source)
+    return lint.run_lint([f], config or lint.Config(hot_all=True))
+
+
+def test_inline_ignore_suppresses(tmp_path):
+    findings = _lint_source(
+        tmp_path,
+        "def f(xs):\n"
+        "    for x in xs:\n"
+        "        x.item()  # mpit-analysis: ignore[MPT005]\n",
+    )
+    assert findings == []
+
+
+def test_inline_ignore_is_rule_scoped(tmp_path):
+    findings = _lint_source(
+        tmp_path,
+        "def f(xs):\n"
+        "    for x in xs:\n"
+        "        x.item()  # mpit-analysis: ignore[MPT001]\n",
+    )
+    assert [f.rule for f in findings] == ["MPT005"]
+
+
+def test_host_sync_barrier_marker(tmp_path):
+    """A def carrying the marker is exempt (body and call sites), the
+    utils/profiling.force_completion contract."""
+    findings = _lint_source(
+        tmp_path,
+        "def sync(x):  # mpit-analysis: host-sync-barrier\n"
+        "    return float(x)\n"
+        "def f(xs):\n"
+        "    for x in xs:\n"
+        "        sync(x)\n",
+    )
+    assert findings == []
+
+
+def test_bound_axis_not_flagged(tmp_path):
+    """A literal axis the module itself binds (shard_map / P spec) is
+    fine — only the copied-out-of-context collective fires MPT001."""
+    findings = _lint_source(
+        tmp_path,
+        "import jax\n"
+        "from jax import lax\n"
+        "from jax.sharding import PartitionSpec as P\n"
+        "def step(x):\n"
+        "    return lax.psum(x, 'dp')\n"
+        "f = jax.shard_map(step, mesh=None, in_specs=P('dp'),"
+        " out_specs=P())\n",
+    )
+    assert findings == []
+
+
+def test_jit_static_argnames_drift(tmp_path):
+    findings = _lint_source(
+        tmp_path,
+        "import jax\n"
+        "@jax.jit(static_argnames=('gone',))\n"
+        "def f(model, batch):\n"
+        "    return batch\n",
+    )
+    assert [f.rule for f in findings] == ["MPT004"]
+
+
+def test_jit_consistent_statics_clean(tmp_path):
+    findings = _lint_source(
+        tmp_path,
+        "import functools\n"
+        "import jax\n"
+        "@functools.partial(jax.jit, static_argnums=(0,),"
+        " static_argnames=('batch',))\n"
+        "def f(model, batch):\n"
+        "    return batch\n",
+    )
+    assert findings == []
+
+
+def test_baseline_counts_surplus(tmp_path):
+    """The first baseline[fp] occurrences are accepted; a surplus COPY of
+    a baselined violation is still new."""
+    f = Finding(
+        rule="MPT005", path="a.py", line=3, col=0,
+        symbol="f", message="m", text="x.item()",
+    )
+    twin = Finding(
+        rule="MPT005", path="a.py", line=9, col=0,
+        symbol="f", message="m", text="x.item()",
+    )
+    assert f.fingerprint == twin.fingerprint  # line-number-free
+    bl = tmp_path / "bl.json"
+    findings_mod.write_baseline(bl, [f])
+    baseline = findings_mod.load_baseline(bl)
+    assert findings_mod.new_findings([f], baseline) == []
+    assert findings_mod.new_findings([f, twin], baseline) == [twin]
+
+
+# ----------------------------------------------------------------------- CLI
+
+
+def _cli(*args):
+    return subprocess.run(
+        [sys.executable, "-m", "mpit_tpu.analysis", *args],
+        capture_output=True, text=True, cwd=REPO, timeout=120,
+    )
+
+
+def test_cli_repo_scan_exits_clean():
+    proc = _cli(str(PKG))
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+
+
+def test_cli_new_finding_exits_nonzero():
+    proc = _cli(
+        "--no-baseline", str(FIXTURES / "fixture_mpt002.py"),
+        "--format", "json",
+    )
+    assert proc.returncode == 1
+    doc = json.loads(proc.stdout)
+    assert [f["rule"] for f in doc["findings"]] == ["MPT002"]
+
+
+def test_cli_list_rules():
+    proc = _cli("--list-rules")
+    assert proc.returncode == 0
+    for rule_id in ("MPT001", "MPT002", "MPT003", "MPT004", "MPT005",
+                    "MPT006"):
+        assert rule_id in proc.stdout
+
+
+# ------------------------------------------------------------ runtime: RT101
+
+
+def test_rt101_seeded_lock_inversion():
+    """Two threads acquiring {A, B} in opposite orders — the classic
+    inversion — is caught from the ORDER GRAPH alone, no temporal
+    overlap needed."""
+    with runtime.checking() as checker:
+        a = runtime.make_lock("A")
+        b = runtime.make_lock("B")
+
+        def ab():
+            with a:
+                with b:
+                    pass
+
+        def ba():
+            with b:
+                with a:
+                    pass
+
+        t1 = threading.Thread(target=ab)
+        t1.start(); t1.join()
+        t2 = threading.Thread(target=ba)
+        t2.start(); t2.join()
+    rules = [f.rule for f in checker.findings]
+    assert rules == ["RT101"], checker.findings
+    assert "A" in checker.findings[0].message
+    assert "B" in checker.findings[0].message
+
+
+def test_rt101_consistent_order_clean():
+    with runtime.checking() as checker:
+        a = runtime.make_lock("A")
+        b = runtime.make_lock("B")
+        for _ in range(3):
+            with a:
+                with b:
+                    pass
+            with b:  # B alone afterwards is NOT an inversion
+                pass
+    assert checker.findings == []
+
+
+def test_make_lock_plain_when_inactive():
+    lock = runtime.make_lock("x")
+    assert not isinstance(lock, runtime._TrackedLock)
+    with lock:
+        pass
+
+
+# ------------------------------------------------------------ runtime: RT102
+
+
+def _spin_until(pred, timeout=5.0):
+    deadline = time.monotonic() + timeout
+    while not pred():
+        if time.monotonic() > deadline:
+            raise AssertionError("condition not reached")
+        time.sleep(0.005)
+
+
+def test_rt102_seeded_tag_collision():
+    """Two threads blocked in recv on the same (dst, tag) — two protocol
+    roles claiming one tag — is flagged; both recvs then complete."""
+    with runtime.checking() as checker:
+        broker = Broker(2)
+        t = broker.transports()[0]
+        results = []
+
+        def role(name):
+            results.append((name, t.recv(src=1, tag=7, timeout=10).payload))
+
+        th1 = threading.Thread(target=role, args=("fetcher",))
+        th2 = threading.Thread(target=role, args=("pusher",))
+        th1.start()
+        _spin_until(lambda: len(checker._waiters) >= 1)
+        th2.start()
+        _spin_until(lambda: len(checker._waiters) >= 2)
+        broker.transports()[1].send(0, 7, "x")
+        broker.transports()[1].send(0, 7, "y")
+        th1.join(10); th2.join(10)
+    assert [f.rule for f in checker.findings] == ["RT102"]
+    assert "tag 7" in checker.findings[0].message
+    assert sorted(p for _, p in results) == ["x", "y"]
+
+
+def test_rt102_wildcard_dispatcher_exempt():
+    """recv(ANY_TAG) is the single-dispatcher pattern (the pserver loop)
+    and must not collide with a concrete-tag waiter."""
+    with runtime.checking() as checker:
+        broker = Broker(2)
+        t = broker.transports()[0]
+
+        def dispatcher():
+            t.recv(src=ANY_SOURCE, tag=ANY_TAG, timeout=10)
+
+        def role():
+            t.recv(src=1, tag=3, timeout=10)
+
+        th1 = threading.Thread(target=dispatcher)
+        th2 = threading.Thread(target=role)
+        th1.start()
+        _spin_until(lambda: len(checker._waiters) >= 1)
+        th2.start()
+        _spin_until(lambda: len(checker._waiters) >= 2)
+        src = broker.transports()[1]
+        # tag 9 first: only the wildcard can match it, so it can't steal
+        # the role's tag-3 message afterwards
+        src.send(0, 9, "disp")
+        th1.join(10)
+        src.send(0, 3, "role")
+        th2.join(10)
+    assert checker.findings == []
+
+
+def test_rt102_stress_distinct_tags_clean_then_seeded_collision():
+    """The stress satellite: N threads hammer one broker. Distinct
+    per-role tags -> zero findings (no false positives under real
+    concurrency); then one seeded duplicate-tag pair -> exactly one
+    RT102."""
+    n_roles, msgs = 8, 50
+    with runtime.checking() as checker:
+        broker = Broker(2)
+        rx, tx = broker.transports()
+        got = [0] * n_roles
+
+        def role(i):
+            for _ in range(msgs):
+                m = rx.recv(src=1, tag=100 + i, timeout=30)
+                assert m.payload == i
+                got[i] += 1
+
+        threads = [
+            threading.Thread(target=role, args=(i,))
+            for i in range(n_roles)
+        ]
+        for th in threads:
+            th.start()
+        for _ in range(msgs):
+            for i in range(n_roles):
+                tx.send(0, 100 + i, i)
+        for th in threads:
+            th.join(60)
+        assert got == [msgs] * n_roles
+        assert checker.findings == []  # clean under load
+
+        # seeded collision: two fresh roles claim tag 100 concurrently
+        def clash():
+            rx.recv(src=1, tag=100, timeout=10)
+
+        c1 = threading.Thread(target=clash)
+        c2 = threading.Thread(target=clash)
+        c1.start()
+        _spin_until(lambda: len(checker._waiters) >= 1)
+        c2.start()
+        _spin_until(lambda: len(checker._waiters) >= 2)
+        tx.send(0, 100, 0)
+        tx.send(0, 100, 0)
+        c1.join(10); c2.join(10)
+    assert [f.rule for f in checker.findings] == ["RT102"]
+
+
+# ----------------------------------------------- runtime: transport is clean
+
+
+def test_socket_transport_clean_under_checker():
+    """The real socket transport's lock discipline (per-dst send locks,
+    outbound-cache lock) produces NO findings on healthy traffic — the
+    zero-false-positives half of the acceptance bar."""
+    from mpit_tpu.transport import SocketTransport
+
+    with runtime.checking() as checker:
+        import socket as _socket
+
+        def _free_port():
+            s = _socket.socket()
+            s.bind(("127.0.0.1", 0))
+            port = s.getsockname()[1]
+            s.close()
+            return port
+
+        addrs = [("127.0.0.1", _free_port()), ("127.0.0.1", _free_port())]
+        t0 = SocketTransport(0, 2, addresses=addrs)
+        t1 = SocketTransport(1, 2, addresses=addrs)
+        try:
+            for i in range(20):
+                t0.send(1, 5, {"step": i})
+                assert t1.recv(src=0, tag=5, timeout=10).payload == {
+                    "step": i
+                }
+                t1.send(0, 6, i)
+                assert t0.recv(src=1, tag=6, timeout=10).payload == i
+        finally:
+            t0.close()
+            t1.close()
+    assert checker.findings == []

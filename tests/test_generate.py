@@ -759,7 +759,23 @@ def test_eos_truncation_on_serving_paths(topo8):
 # ----------------------------------------------------------- property-based
 
 
-from hypothesis import given, settings, strategies as st  # noqa: E402
+try:
+    from hypothesis import given, settings, strategies as st  # noqa: E402
+except ImportError:  # container without hypothesis: only the property
+    # tests below skip — the 700 lines of example tests above still run
+    class _DummyStrategies:
+        def __getattr__(self, name):
+            return lambda *a, **k: None
+
+    st = _DummyStrategies()
+
+    def settings(*a, **k):
+        return lambda f: f
+
+    def given(*a, **k):
+        return lambda f: pytest.mark.skip(
+            reason="property tier needs hypothesis"
+        )(f)
 
 _PROP_MODEL = None
 _PROP_PARAMS = None

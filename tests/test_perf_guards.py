@@ -396,12 +396,23 @@ def test_composed_step_compiles_clean_and_donates():
         vocab_size=29, num_layers=2, d_model=32, num_heads=8, max_len=32,
         compute_dtype=jnp.float32, seq_axis="sp",
     )
-    tr = ComposedParallelTrainer(model, optax.sgd(0.1, momentum=0.9), topo)
     rng = np.random.default_rng(0)
     x = rng.integers(0, 29, (8, 32)).astype(np.int32)
     y = np.roll(x, -1, axis=1).astype(np.int32)
-    state = tr.init_state(jax.random.key(0), x[:2, :16])
-    txt = _compiled_text(tr._step, state, jnp.asarray(x), jnp.asarray(y))
+    try:
+        tr = ComposedParallelTrainer(
+            model, optax.sgd(0.1, momentum=0.9), topo
+        )
+        state = tr.init_state(jax.random.key(0), x[:2, :16])
+        txt = _compiled_text(
+            tr._step, state, jnp.asarray(x), jnp.asarray(y)
+        )
+    except Exception as e:  # old jaxlibs can't SPMD-partition the
+        if "PartitionId instruction is not supported" in str(e):
+            pytest.skip(  # partial-manual (axis_names=) shard_map mode
+                "backend cannot compile partial-manual shard_map"
+            )
+        raise
     _assert_clean(txt)
     assert _alias_count(txt) == len(jax.tree.leaves(state))
 

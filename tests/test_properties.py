@@ -11,6 +11,7 @@ import pytest
 
 # integration tier — excluded from the smoke run (hypothesis property sweeps)
 pytestmark = pytest.mark.slow
+pytest.importorskip("hypothesis", reason="property tier needs hypothesis")
 from hypothesis import given, settings, strategies as st
 
 from mpit_tpu import native

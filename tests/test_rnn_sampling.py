@@ -210,3 +210,13 @@ def test_training_params_serve_directly(topo8):
     out = generate_rnn(model, state.params, [1, 2, 3], 5)
     assert out == _slow(model, state.params, [1, 2, 3], 5)
     mpit_tpu.finalize()
+
+
+def test_empty_tuple_is_explicit_empty_batch(topo8):
+    """prompts=() is the one unambiguous empty-batch spelling and maps
+    to [] (mirroring generate_batch's []->[] on the transformer path);
+    the empty LIST stays rejected as a solo empty prompt."""
+    model, params = _model_params()
+    assert generate_rnn(model, params, (), 3) == []
+    with pytest.raises(ValueError, match="prompt of 0 tokens"):
+        generate_rnn(model, params, [], 3)

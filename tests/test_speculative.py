@@ -194,3 +194,35 @@ def test_draft_with_smaller_max_len(topo8):
     want = generate_fast(tgt, tp, prompt, 20)
     got = generate_speculative(tgt, tp, dft, dp, prompt, 20, k=4)
     assert got == want
+
+
+def test_loop_gates_on_steps_not_bucket(topo8):
+    """steps=5 buckets to gen_bucket=8, but the while_loop freezes rows
+    at n >= steps: a never-agreeing draft must run at most ~steps
+    verification chunks (k=1 emits >= 1 token per chunk), not bucket
+    many — and the output still matches the target-only decode."""
+    tgt, dft = _target(), _draft()
+    tp, dp = _init(tgt, 0), _init(dft, 7)
+    prompt = PROMPTS[0]
+    got, stats = generate_speculative(
+        tgt, tp, dft, dp, prompt, 5, k=1, return_stats=True
+    )
+    assert got == generate_fast(tgt, tp, prompt, 5)
+    # tok0 comes from the prefill; each chunk emits at least one token,
+    # so even zero acceptances need only steps-1 = 4 chunks. Running to
+    # the bucket would need up to 7.
+    assert stats["iterations"] <= 4
+
+
+def test_steps_below_bucket_rows_match_solo(topo8):
+    """Batched rows under a steps < gen_bucket budget stay pinned to
+    their solo calls (the freeze-at-steps path rides per-row clocks)."""
+    tgt, dft = _target(), _draft()
+    tp, dp = _init(tgt, 0), _init(dft, 7)
+    rows = generate_speculative_batch(
+        tgt, tp, dft, dp, PROMPTS, 5, k=3
+    )
+    for i, prompt in enumerate(PROMPTS):
+        assert rows[i] == generate_speculative(
+            tgt, tp, dft, dp, prompt, 5, k=3
+        )
