@@ -1,18 +1,25 @@
 """mpit_tpu.analysis — distributed-correctness linter + runtime checker.
 
-Two halves (ISSUE 1):
+Two halves (ISSUE 1, cross-module pass ISSUE 2):
 
 - a static AST pass over the package (:mod:`~mpit_tpu.analysis.lint`,
-  rules MPT001–MPT006) catching the distributed/JAX hazard classes that
+  rules MPT001–MPT008) catching the distributed/JAX hazard classes that
   have actually bitten this codebase: unbound collective axis names,
-  transport-tag indiscipline, jit static-argument drift (commit c166392),
-  host syncs in hot loops, and blocking I/O under locks;
+  transport-tag indiscipline, jit static-argument drift (commit c166392,
+  wrapper chains included), host syncs in hot loops, blocking I/O under
+  locks, pickle wire-format drift, and protocol-role divergence. The
+  cross-module rules share a whole-program name-resolution index
+  (:mod:`~mpit_tpu.analysis.graph`) and a protocol-role model
+  (:mod:`~mpit_tpu.analysis.protocol`) — still AST-only, scanned code is
+  never imported;
 - an opt-in runtime checker (:mod:`~mpit_tpu.analysis.runtime`, rules
   RT101/RT102) instrumenting the transport layer's locks and mailboxes for
   lock-order cycles and concurrent tag reuse.
 
-CLI: ``python -m mpit_tpu.analysis [--format json|text] [path]`` — exits 0
-when the scan matches the checked-in baseline. See ``docs/ANALYSIS.md``.
+CLI: ``python -m mpit_tpu.analysis [--format json|text] [--fix] [path]`` —
+exits 0 when the scan matches the checked-in baseline; ``--fix`` first
+rewrites mechanically-fixable MPT002 sites (known literal tag → ``TAG_*``
+name + import). See ``docs/ANALYSIS.md``.
 
 This ``__init__`` stays import-light (PEP 562 lazy attributes): the
 transports import :mod:`~mpit_tpu.analysis.runtime` on their hot

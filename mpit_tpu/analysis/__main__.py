@@ -3,10 +3,15 @@
 ``python -m mpit_tpu.analysis [options] [path ...]``
 
 Scans the given files/directories (default: the installed ``mpit_tpu``
-package) with rules MPT001–MPT006, subtracts the checked-in baseline, and
-exits 0 when nothing new was found. ``--write-baseline`` refreshes the
-baseline from the current scan (review the diff — every line you accept is
-a violation you are signing off on).
+package) with rules MPT001–MPT008 — including the cross-module passes
+(pickle wire-format drift, protocol-role pairing, wrapper-taint jit
+drift), which resolve imports and constants across the whole scan set
+without importing anything — subtracts the checked-in baseline, and exits
+0 when nothing new was found. ``--write-baseline`` refreshes the baseline
+from the current scan (review the diff — every line you accept is a
+violation you are signing off on). ``--fix`` first rewrites the
+mechanically-fixable MPT002 sites (known literal tag → ``TAG_*`` name +
+import) in place, then lints the result.
 
 Exit codes: 0 clean (vs baseline), 1 new findings, 2 usage/baseline error.
 """
@@ -29,7 +34,7 @@ def _default_scan_path() -> str:
 def main(argv=None) -> int:
     parser = argparse.ArgumentParser(
         prog="python -m mpit_tpu.analysis",
-        description="Distributed-correctness linter (rules MPT001-MPT006).",
+        description="Distributed-correctness linter (rules MPT001-MPT008).",
     )
     parser.add_argument(
         "paths",
@@ -63,6 +68,12 @@ def main(argv=None) -> int:
         action="store_true",
         help="print the rule table and exit",
     )
+    parser.add_argument(
+        "--fix",
+        action="store_true",
+        help="rewrite fixable MPT002 sites (known literal tag -> TAG_* "
+        "constant + import) in place before linting",
+    )
     args = parser.parse_args(argv)
 
     if args.list_rules:
@@ -77,6 +88,24 @@ def main(argv=None) -> int:
     for p in paths:
         if not Path(p).exists():
             print(f"error: no such path: {p}", file=sys.stderr)
+            return 2
+
+    if args.fix:
+        from mpit_tpu.analysis import fixes
+
+        had_error = False
+        for r in fixes.fix_paths(paths):
+            if r.error:
+                had_error = True
+                print(f"fix: {r.path}: {r.error}", file=sys.stderr)
+                continue
+            detail = f"rewrote {r.replaced} literal tag site(s)"
+            if r.imported:
+                detail += f", imported {', '.join(r.imported)}"
+            if r.skipped:
+                detail += f", left {r.skipped} suppressed site(s)"
+            print(f"fix: {r.path}: {detail}")
+        if had_error:
             return 2
 
     all_findings = lint.run_lint(paths)

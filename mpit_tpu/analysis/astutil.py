@@ -5,7 +5,23 @@ the linter must run in a bare CI container and never initialize a backend).
 from __future__ import annotations
 
 import ast
+import io
+import tokenize
 from typing import Iterator, Optional
+
+
+def iter_comments(source_lines: list) -> Iterator[tuple]:
+    """(lineno, text) for every real COMMENT token. Marker scans must use
+    this rather than regexing raw lines: a marker QUOTED inside a docstring
+    (e.g. this package documenting its own ``# mpit-analysis: ...`` syntax)
+    is not an opt-in."""
+    readline = io.StringIO("\n".join(source_lines) + "\n").readline
+    try:
+        for tok in tokenize.generate_tokens(readline):
+            if tok.type == tokenize.COMMENT:
+                yield tok.start[0], tok.string
+    except (tokenize.TokenError, IndentationError, SyntaxError):
+        return
 
 
 def build_parents(tree: ast.AST) -> dict:

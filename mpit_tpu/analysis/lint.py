@@ -57,6 +57,16 @@ class Config:
     # that doesn't contain it (cross-module collisions against the
     # canonical protocol tags)
     canonical_tag_registry: bool = True
+    # path components marking transport-boundary modules for the pickle
+    # wire-format rule (modules may also opt in with a
+    # `# mpit-analysis: wire-boundary` marker comment)
+    wire_parts: Sequence[str] = ("transport", "native")
+    # the canonical wire pickle-protocol constant: its name, and an
+    # optional value override for tests (default: extracted from
+    # transport/socket_transport.py — scan set first, installed package
+    # as fallback; never imported)
+    wire_protocol_name: str = "WIRE_PICKLE_PROTOCOL"
+    wire_pickle_protocol: Optional[int] = None
 
 
 @dataclasses.dataclass
@@ -94,6 +104,17 @@ class ModuleCtx:
 class Project:
     modules: list  # list[ModuleCtx]
     config: Config
+    # lazily-built cross-module name-resolution index (analysis/graph.py);
+    # per-file rules never touch it, cross-module rules share one build
+    _graph: object = dataclasses.field(default=None, repr=False)
+
+    @property
+    def graph(self):
+        if self._graph is None:
+            from mpit_tpu.analysis import graph as graph_mod
+
+            self._graph = graph_mod.ModuleGraph(self.modules)
+        return self._graph
 
 
 def _parse_ignores(source_lines: list) -> dict:

@@ -1,0 +1,281 @@
+"""Protocol-role model: per-role send/recv tag sequences, statically.
+
+The host-async PS protocol is a conversation between two roles — the
+pserver's wildcard-recv dispatch loop and the pclient's send/recv call
+pattern — and its hardest failure class is cross-rank: a tag one role sends
+that the counterpart never receives (the message parks forever and teardown
+hangs), or both roles blocking in recv for a tag only the *other* side's
+later send would satisfy. Rank-local lint rules cannot see either; this
+module extracts the static halves from the AST so MPT008 can.
+
+A module opts into a role with a marker comment anywhere at the top level::
+
+    # mpit-analysis: protocol-role[client->server]
+
+meaning "this module implements role ``client``, whose counterpart role is
+``server``". Several modules may share one role (``pclient.py`` and
+``ps_roles.py`` are both ``client``); their operations merge. The markers
+live with the code — ``parallel/pserver.py``, ``parallel/pclient.py`` and
+``parallel/ps_roles.py`` carry them — so the model needs no path
+configuration and fixture packages participate the same way.
+
+Extracted per role, with tags resolved to integers through the module graph
+(``TAG_PARAM`` imported from ``pserver`` resolves to 4; unresolvable tag
+expressions are skipped — conservative, no finding):
+
+- **sends**: ``send``/``isend`` call sites (3+ args: the transport shape),
+  including ONE level of local indirection — a module-local function that
+  forwards a tag parameter to a transport send (``PClient._scatter``)
+  counts its call sites (``self._scatter(TAG_PUSH_EASGD, ...)``) as sends
+  of the resolved tag;
+- **recvs**: ``recv``/``irecv``/``probe`` sites; a missing/``-1``/
+  ``ANY_TAG`` tag is a *wildcard* recv (the dispatcher pattern);
+- **dispatch tags**: ``== TAG_X`` / ``!= TAG_X`` / ``in (TAG_X, ...)``
+  comparisons against ``TAG_``-named constants in a module that also has a
+  wildcard recv — the tags its dispatch loop actually handles.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import re
+from typing import Iterable, Optional
+
+from mpit_tpu.analysis import astutil
+
+ROLE_MARKER_RE = re.compile(
+    r"#\s*mpit-analysis:\s*protocol-role\[\s*([A-Za-z0-9_]+)\s*->"
+    r"\s*([A-Za-z0-9_]+)\s*\]"
+)
+
+_TAG_NAME_RE = re.compile(r"^TAG_[A-Z0-9_]+$")
+_SEND_NAMES = {"send", "isend"}
+_RECV_NAMES = {"recv", "irecv", "probe"}
+_WILDCARD_NAMES = {"ANY_TAG"}
+
+
+@dataclasses.dataclass(frozen=True)
+class ProtoOp:
+    """One protocol operation at one source location."""
+
+    kind: str  # "send" | "recv" | "dispatch"
+    tag: Optional[int]  # None = wildcard (recv only)
+    tag_text: str  # the tag expression as written (for messages)
+    rel: str
+    line: int
+    col: int
+    symbol: str  # enclosing function qualname
+
+    @property
+    def is_wildcard(self) -> bool:
+        return self.tag is None
+
+
+@dataclasses.dataclass
+class RoleModel:
+    """The merged protocol surface of every module claiming one role."""
+
+    role: str
+    counterpart: str
+    rels: list  # contributing module rel paths
+    ops: list  # all ProtoOps
+
+    @property
+    def sends(self) -> list:
+        return [op for op in self.ops if op.kind == "send"]
+
+    @property
+    def concrete_recvs(self) -> list:
+        return [
+            op
+            for op in self.ops
+            if op.kind == "recv" and not op.is_wildcard
+        ]
+
+    @property
+    def has_wildcard_recv(self) -> bool:
+        return any(
+            op.kind == "recv" and op.is_wildcard for op in self.ops
+        )
+
+    @property
+    def dispatch_tags(self) -> set:
+        return {op.tag for op in self.ops if op.kind == "dispatch"}
+
+    @property
+    def sent_tags(self) -> set:
+        return {op.tag for op in self.sends}
+
+    @property
+    def handled_tags(self) -> set:
+        """Tags this role can consume: concrete recvs + dispatch branches."""
+        return self.dispatch_tags | {
+            op.tag for op in self.concrete_recvs
+        }
+
+    def sequences(self) -> dict:
+        """Per enclosing function: its send/recv ops in source order (the
+        input to the cross-wait check; dispatch ops are capabilities, not
+        blocking points, and stay out)."""
+        seqs: dict = {}
+        for op in self.ops:
+            if op.kind == "dispatch":
+                continue
+            seqs.setdefault((op.rel, op.symbol), []).append(op)
+        for seq in seqs.values():
+            seq.sort(key=lambda op: (op.line, op.col))
+        return seqs
+
+
+def module_role(source_lines) -> Optional[tuple]:
+    """(role, counterpart) from the marker comment, or None. Only real
+    COMMENT tokens count — a marker quoted in a docstring is not an
+    opt-in (this module's own docstring shows one)."""
+    for _, text in astutil.iter_comments(source_lines):
+        m = ROLE_MARKER_RE.search(text)
+        if m:
+            return m.group(1), m.group(2)
+    return None
+
+
+def _tag_value(graph, info, node) -> tuple:
+    """(resolved | None, is_wildcard). Unresolvable -> (None, False)."""
+    if node is None:
+        return None, True  # recv() default tag is ANY_TAG
+    val = astutil.int_constant(node)
+    if val is None:
+        dotted = astutil.dotted_name(node)
+        if dotted is not None:
+            if dotted.split(".")[-1] in _WILDCARD_NAMES:
+                return None, True
+            val = graph.resolve_constant(info, dotted)
+    if val == -1:
+        return None, True
+    return val, False
+
+
+def _send_wrappers(tree: ast.Module) -> dict:
+    """Module-local functions that forward a parameter into a transport
+    send's tag slot: name -> index of that parameter in the call signature
+    (``self`` excluded for methods — callers don't pass it)."""
+    out: dict = {}
+    for node in ast.walk(tree):
+        if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        params = [a.arg for a in node.args.posonlyargs + node.args.args]
+        call_params = params[1:] if params[:1] == ["self"] else params
+        for sub in ast.walk(node):
+            if not isinstance(sub, ast.Call):
+                continue
+            if astutil.call_last_name(sub) not in _SEND_NAMES:
+                continue
+            if len(sub.args) + len(sub.keywords) < 3:
+                continue
+            tag_arg = astutil.get_arg(sub, 1, "tag")
+            if isinstance(tag_arg, ast.Name) and tag_arg.id in call_params:
+                out[node.name] = call_params.index(tag_arg.id)
+    return out
+
+
+def _op(mod, node, kind, tag, text) -> ProtoOp:
+    return ProtoOp(
+        kind=kind,
+        tag=tag,
+        tag_text=text,
+        rel=mod.rel,
+        line=getattr(node, "lineno", 0),
+        col=getattr(node, "col_offset", 0),
+        symbol=astutil.enclosing_symbol(node, mod.parents),
+    )
+
+
+def _dispatch_tag_nodes(node: ast.Compare) -> Iterable:
+    """TAG_*-named operands of an ==/!=/in comparison."""
+    if not all(
+        isinstance(op, (ast.Eq, ast.NotEq, ast.In)) for op in node.ops
+    ):
+        return
+    for operand in (node.left, *node.comparators):
+        cands = (
+            operand.elts
+            if isinstance(operand, (ast.Tuple, ast.List, ast.Set))
+            else [operand]
+        )
+        for cand in cands:
+            dotted = astutil.dotted_name(cand)
+            if dotted and _TAG_NAME_RE.match(dotted.split(".")[-1]):
+                yield cand, dotted
+
+
+def extract_module_ops(mod, graph) -> list:
+    """Every protocol op in one role module (tags graph-resolved)."""
+    info = graph.module_for_rel(mod.rel)
+    wrappers = _send_wrappers(mod.tree)
+    ops: list = []
+    saw_wildcard_recv = False
+    dispatch_candidates: list = []
+    for node in ast.walk(mod.tree):
+        if isinstance(node, ast.Compare):
+            for cand, dotted in _dispatch_tag_nodes(node):
+                val = graph.resolve_constant(info, dotted)
+                if val is not None:
+                    dispatch_candidates.append(
+                        _op(mod, node, "dispatch", val, dotted)
+                    )
+            continue
+        if not isinstance(node, ast.Call):
+            continue
+        name = astutil.call_last_name(node)
+        if name in _SEND_NAMES:
+            if len(node.args) + len(node.keywords) < 3:
+                continue
+            tag_arg = astutil.get_arg(node, 1, "tag")
+            val, wild = _tag_value(graph, info, tag_arg)
+            if val is not None and not wild:
+                ops.append(
+                    _op(mod, node, "send", val, ast.unparse(tag_arg))
+                )
+        elif name in _RECV_NAMES:
+            tag_arg = astutil.get_arg(node, 1, "tag")
+            val, wild = _tag_value(graph, info, tag_arg)
+            if wild:
+                saw_wildcard_recv = True
+                ops.append(_op(mod, node, "recv", None, "ANY_TAG"))
+            elif val is not None:
+                ops.append(
+                    _op(mod, node, "recv", val, ast.unparse(tag_arg))
+                )
+        elif name in wrappers:
+            tag_arg = astutil.get_arg(node, wrappers[name], "tag")
+            if tag_arg is None:
+                continue
+            val, wild = _tag_value(graph, info, tag_arg)
+            if val is not None and not wild:
+                ops.append(
+                    _op(mod, node, "send", val, ast.unparse(tag_arg))
+                )
+    if saw_wildcard_recv:
+        # dispatch branches only mean "handled" when a wildcard recv
+        # actually routes messages into them
+        ops.extend(dispatch_candidates)
+    return ops
+
+
+def extract_roles(project) -> dict:
+    """role name -> RoleModel, merged over every marked module in scope."""
+    graph = project.graph
+    roles: dict = {}
+    for mod in project.modules:
+        marked = module_role(mod.source_lines)
+        if marked is None:
+            continue
+        role, counterpart = marked
+        model = roles.get(role)
+        if model is None:
+            model = roles[role] = RoleModel(
+                role=role, counterpart=counterpart, rels=[], ops=[]
+            )
+        model.rels.append(mod.rel)
+        model.ops.extend(extract_module_ops(mod, graph))
+    return roles

@@ -13,10 +13,20 @@ from mpit_tpu.analysis.rules import (
     host_sync,
     jit_signature,
     locks,
+    protocol_roles,
     tags,
+    wire_format,
 )
 
-RULE_MODULES = (collectives, tags, jit_signature, host_sync, locks)
+RULE_MODULES = (
+    collectives,
+    tags,
+    jit_signature,
+    host_sync,
+    locks,
+    wire_format,
+    protocol_roles,
+)
 
 # rule id -> (title, one-line rationale); the CLI's --list-rules output and
 # the docs table are generated from this single source

@@ -12,10 +12,24 @@ the edit. Checked statically:
 - every name in ``static_argnames``/``donate_argnames`` must be a
   parameter name (skipped when it takes ``**kwargs``).
 
-Covered shapes: ``@jax.jit(...)`` / ``@functools.partial(jax.jit, ...)``
-decorators, and module-level ``f = jax.jit(g, static_argnums=...)``
-assignments where ``g`` is a def in the same module. Non-literal index/name
-expressions are skipped (no constant folding).
+Covered shapes — the direct sites, plus the wrapper chains the module
+graph can see through (cross-module, via imports and aliases):
+
+- ``@jax.jit(...)`` / ``@functools.partial(jax.jit, ...)`` decorators;
+- ``f = jax.jit(target, ...)`` where ``target`` resolves through any chain
+  of aliases, ``functools.partial`` links (each link SHIFTS the positional
+  frame: ``partial(g, x)`` consumes ``g``'s first parameter, so index 0 of
+  the jitted callable is ``g``'s second), and pure pass-through wrappers
+  (``def w(*a, **k): return g(*a, **k)``);
+- bare decorators that resolve to a jit factory: either an assignment
+  ``jit_static = functools.partial(jax.jit, static_argnums=...)`` or a def
+  whose body returns ``jax.jit(<its first parameter>, static_argnums=...)``
+  — every ``@jit_static`` application is checked against the decorated
+  function's signature, wherever the factory lives.
+
+Non-literal index/name expressions are skipped (no constant folding), as
+is any chain the graph cannot resolve (star imports, dynamic dispatch) —
+conservative in the no-finding direction.
 """
 
 from __future__ import annotations
@@ -24,12 +38,14 @@ import ast
 from typing import Iterable, Optional
 
 from mpit_tpu.analysis import astutil
+from mpit_tpu.analysis.graph import CallableInfo
 
 RULES = {
     "MPT004": (
         "jit-static-drift",
         "jit static_argnums/static_argnames (or donate_*) out of range / "
-        "not in the wrapped function's signature",
+        "not in the wrapped function's signature (wrapper chains "
+        "included)",
     ),
 }
 
@@ -90,11 +106,23 @@ def _str_tuple(node: ast.AST) -> Optional[list]:
     return None
 
 
-def _check(mod, site: ast.AST, keywords: list, fn: ast.FunctionDef):
-    pos_params = [a.arg for a in fn.args.posonlyargs + fn.args.args]
-    all_names = set(pos_params) | {a.arg for a in fn.args.kwonlyargs}
+def _check(mod, site: ast.AST, keywords: list, target: CallableInfo):
+    """Validate static/donate kwargs against the resolved callable's
+    EFFECTIVE signature (positional frame shifted past partial-bound
+    leading parameters; keyword-bound names removed)."""
+    fn = target.fn
+    pos_all = [a.arg for a in fn.args.posonlyargs + fn.args.args]
+    pos_params = pos_all[target.bound_pos :]
+    all_names = (
+        set(pos_params) | {a.arg for a in fn.args.kwonlyargs}
+    ) - target.bound_names
     has_varargs = fn.args.vararg is not None
     has_varkw = fn.args.kwarg is not None
+    via = (
+        f" (reached through a {target.depth}-link wrapper chain)"
+        if target.depth
+        else ""
+    )
     for kw in keywords:
         if kw.arg in _INDEX_KW and not has_varargs:
             idxs = _int_tuple(kw.value)
@@ -105,8 +133,8 @@ def _check(mod, site: ast.AST, keywords: list, fn: ast.FunctionDef):
                         site,
                         f"{kw.arg} index {idx} out of range for "
                         f"{fn.name}() with {len(pos_params)} positional "
-                        "parameters — signature drifted under its jit "
-                        "wrapper (the c166392 failure class)",
+                        f"parameters{via} — signature drifted under its "
+                        "jit wrapper (the c166392 failure class)",
                     )
         elif kw.arg in _NAME_KW and not has_varkw:
             names = _str_tuple(kw.value)
@@ -116,37 +144,96 @@ def _check(mod, site: ast.AST, keywords: list, fn: ast.FunctionDef):
                         "MPT004",
                         site,
                         f"{kw.arg} names {name!r}, which is not a "
-                        f"parameter of {fn.name}() — signature drifted "
-                        "under its jit wrapper",
+                        f"parameter of {fn.name}(){via} — signature "
+                        "drifted under its jit wrapper",
                     )
+
+
+def _factory_jit_kws(fn) -> Optional[list]:
+    """static/donate keyword list of a decorator factory: a def whose body
+    returns ``jax.jit(<its first parameter>, ...kwargs...)``."""
+    params = [a.arg for a in fn.args.posonlyargs + fn.args.args]
+    if not params:
+        return None
+    for node in ast.walk(fn):
+        if not (
+            isinstance(node, ast.Return)
+            and isinstance(node.value, ast.Call)
+        ):
+            continue
+        call = node.value
+        if not _is_jit(call.func):
+            continue
+        if (
+            call.args
+            and isinstance(call.args[0], ast.Name)
+            and call.args[0].id == params[0]
+        ):
+            return call.keywords
+    return None
+
+
+def _decorator_factory_kws(graph, info, dec: ast.AST) -> Optional[list]:
+    """kwargs applied by a BARE decorator (``@jit_static``) that resolves
+    to a jit factory — a partial-of-jit assignment or a factory def."""
+    dotted = astutil.dotted_name(dec)
+    if dotted is None:
+        return None
+    if dotted.split(".")[-1] in _JIT_NAMES:
+        return None  # plain @jax.jit with no kwargs: nothing to check
+    r = graph.resolve(info, dotted)
+    if r is None:
+        return None
+    if r.kind == "assign" and isinstance(r.value, ast.Call):
+        return _jit_keywords(r.value)
+    if r.kind == "function":
+        return _factory_jit_kws(r.value)
+    return None
+
+
+def _local_callable(local_defs, graph, info, node) -> Optional[CallableInfo]:
+    """Resolve a jit target: function-scope defs first (the trainer
+    pattern — ``jax.jit(step)`` right under ``def step`` in a method),
+    then the module graph's alias/partial/wrapper chains."""
+    if isinstance(node, ast.Name) and node.id in local_defs:
+        fn = local_defs[node.id]
+        return CallableInfo(fn=fn, module=info, bound_pos=0)
+    if graph is None:
+        return None
+    return graph.resolve_callable(info, node)
 
 
 def run(project) -> Iterable:
+    graph = project.graph
     for mod in project.modules:
-        # module-level defs by name, for the assignment form
-        defs = {
+        info = graph.module_for_rel(mod.rel)
+        # every def in the module by bare name (function-scope included),
+        # for jit-assignment targets the graph's module-level view misses
+        local_defs = {
             n.name: n
             for n in ast.walk(mod.tree)
-            if isinstance(n, ast.FunctionDef)
+            if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))
         }
         for node in ast.walk(mod.tree):
-            if isinstance(node, ast.FunctionDef):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                target = CallableInfo(fn=node, module=info)
                 for dec in node.decorator_list:
-                    if not isinstance(dec, ast.Call):
-                        continue
-                    kws = _jit_keywords(dec)
-                    if kws is not None:
-                        yield from _check(mod, dec, kws, node)
+                    if isinstance(dec, ast.Call):
+                        kws = _jit_keywords(dec)
+                        if kws:
+                            yield from _check(mod, dec, kws, target)
+                    else:
+                        kws = _decorator_factory_kws(graph, info, dec)
+                        if kws:
+                            yield from _check(mod, dec, kws, target)
             elif isinstance(node, ast.Assign):
-                if not (
-                    isinstance(node.value, ast.Call)
-                    and _is_jit(node.value.func)
-                    and node.value.args
-                    and isinstance(node.value.args[0], ast.Name)
-                ):
+                if not isinstance(node.value, ast.Call):
                     continue
-                fn = defs.get(node.value.args[0].id)
-                if fn is not None:
-                    yield from _check(
-                        mod, node.value, node.value.keywords, fn
-                    )
+                call = node.value
+                if not (_is_jit(call.func) and call.args):
+                    continue
+                resolved = _local_callable(
+                    local_defs, graph, info, call.args[0]
+                )
+                if resolved is not None:
+                    yield from _check(mod, call, call.keywords, resolved)

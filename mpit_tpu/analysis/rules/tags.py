@@ -86,8 +86,10 @@ def _canonical_registry() -> list:
     return out
 
 
-def _literal_tag_findings(mod) -> Iterable:
-    for node in ast.walk(mod.tree):
+def iter_literal_tag_sites(tree: ast.Module) -> Iterable:
+    """(call node, tag literal node, value) for every MPT002-shaped site —
+    shared by the rule (findings) and ``--fix`` (rewrites)."""
+    for node in ast.walk(tree):
         if not isinstance(node, ast.Call):
             continue
         name = astutil.call_last_name(node)
@@ -106,6 +108,12 @@ def _literal_tag_findings(mod) -> Iterable:
         val = astutil.int_constant(tag_arg)
         if val is None or val == -1:  # ANY_TAG wildcard
             continue
+        yield node, tag_arg, val
+
+
+def _literal_tag_findings(mod) -> Iterable:
+    for node, _tag_arg, val in iter_literal_tag_sites(mod.tree):
+        name = astutil.call_last_name(node)
         yield mod.finding(
             "MPT002",
             node,

@@ -1,0 +1,209 @@
+"""MPT007 — pickle protocol drift at a transport boundary.
+
+The wire format is ``length + pickle(payload)`` and both brokers (socket
+and native) must keep emitting the SAME pickle protocol: readers
+auto-detect (the protocol id is embedded in the stream, which is why
+``pickle.loads`` has nothing to pin and is not checked), but a *writer*
+that drifts — a module hard-coding a different number, omitting
+``protocol=`` (the interpreter default moves across versions), or passing
+``pickle.HIGHEST_PROTOCOL``/``-1`` (explicitly version-dependent) — makes
+frames that a mixed-version peer may not parse, and the failure is a
+corrupted-looking stream on the OTHER rank, far from the bad dumps call.
+
+The canonical protocol is the ``WIRE_PICKLE_PROTOCOL`` constant in
+``transport/socket_transport.py`` (taken from the scan set when covered,
+else from the installed package next to this rule — never imported).
+Checked only at transport boundaries: modules under a ``transport/`` or
+``native/`` path component (``Config.wire_parts``), or any module carrying
+a ``# mpit-analysis: wire-boundary`` marker comment. Every ``pickle.dumps``
+there must pin ``protocol=`` to the canonical constant *by name* — a
+literal equal to the canonical value is still flagged, because a future
+bump of the constant would silently strand it.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from pathlib import Path, PurePosixPath
+from typing import Iterable, Optional
+
+from mpit_tpu.analysis import astutil
+
+RULES = {
+    "MPT007": (
+        "pickle-protocol-drift",
+        "pickle.dumps at a transport boundary whose protocol= is absent, "
+        "literal, interpreter-dependent, or resolves to a value other "
+        "than the canonical wire constant",
+    ),
+}
+
+WIRE_MARKER_RE = re.compile(r"#\s*mpit-analysis:\s*wire-boundary")
+
+_CANONICAL_REL_SUFFIX = "transport/socket_transport.py"
+_VERSION_DEPENDENT = {"HIGHEST_PROTOCOL", "DEFAULT_PROTOCOL"}
+
+
+def _pickle_dumps_names(tree: ast.Module) -> tuple:
+    """(module aliases of ``pickle``, bare names bound to ``dumps``)."""
+    mod_aliases, fn_names = set(), set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Import):
+            for alias in node.names:
+                if alias.name == "pickle":
+                    mod_aliases.add(alias.asname or "pickle")
+        elif isinstance(node, ast.ImportFrom) and node.module == "pickle":
+            for alias in node.names:
+                if alias.name == "dumps":
+                    fn_names.add(alias.asname or "dumps")
+    return mod_aliases, fn_names
+
+
+def _is_dumps_call(call: ast.Call, mod_aliases, fn_names) -> bool:
+    dotted = astutil.dotted_name(call.func)
+    if dotted is None:
+        return False
+    parts = dotted.split(".")
+    if len(parts) == 1:
+        return parts[0] in fn_names
+    return parts[-1] == "dumps" and parts[0] in mod_aliases
+
+
+def canonical_protocol(project) -> Optional[tuple]:
+    """(value, constant name, where) for the wire's canonical pickle
+    protocol, or None when it can't be located (then nothing is checked —
+    there is no contract to drift from)."""
+    name = project.config.wire_protocol_name
+    override = project.config.wire_pickle_protocol
+    if override is not None:
+        return int(override), name, "config override"
+    graph = project.graph
+    for mod in project.modules:
+        if not mod.rel.endswith(_CANONICAL_REL_SUFFIX):
+            continue
+        info = graph.module_for_rel(mod.rel)
+        if info is not None and name in info.constants:
+            return info.constants[name], name, mod.rel
+    # scan set doesn't cover the transport: fall back to the installed
+    # package relative to this file (parsed, never imported)
+    canon = Path(__file__).resolve().parents[2] / "transport" / (
+        "socket_transport.py"
+    )
+    try:
+        tree = ast.parse(canon.read_text())
+    except (OSError, SyntaxError):
+        return None
+    for node in tree.body:
+        if isinstance(node, ast.Assign) and len(node.targets) == 1:
+            tgt = node.targets[0]
+            if isinstance(tgt, ast.Name) and tgt.id == name:
+                val = astutil.int_constant(node.value)
+                if val is not None:
+                    return val, name, "mpit_tpu/" + _CANONICAL_REL_SUFFIX
+    return None
+
+
+def _is_wire_module(mod, config) -> bool:
+    parts = PurePosixPath(mod.rel).parts[:-1]
+    if any(p in config.wire_parts for p in parts):
+        return True
+    # real COMMENT tokens only — this rule's own docstring quotes the marker
+    return any(
+        WIRE_MARKER_RE.search(text)
+        for _, text in astutil.iter_comments(mod.source_lines)
+    )
+
+
+def _check_dumps(mod, info, graph, call, canon_value, canon_name, where):
+    proto = astutil.get_arg(call, 1, "protocol")
+    if proto is None:
+        yield mod.finding(
+            "MPT007",
+            call,
+            "pickle.dumps on the wire without protocol= — the "
+            "interpreter default drifts across versions; pin "
+            f"protocol={canon_name} (={canon_value}, {where})",
+        )
+        return
+    lit = astutil.int_constant(proto)
+    if lit is not None:
+        if lit == -1:
+            yield mod.finding(
+                "MPT007",
+                call,
+                "pickle.dumps(protocol=-1) is interpreter-dependent "
+                f"(highest available) — pin protocol={canon_name} "
+                f"(={canon_value})",
+            )
+        elif lit != canon_value:
+            yield mod.finding(
+                "MPT007",
+                call,
+                f"pickle protocol drift: dumps pins protocol={lit} but "
+                f"the wire contract is {canon_name}={canon_value} "
+                f"({where}) — mixed ranks on one socket corrupt frames "
+                "silently",
+            )
+        else:
+            yield mod.finding(
+                "MPT007",
+                call,
+                f"pickle.dumps hard-codes protocol={lit}; it matches "
+                f"{canon_name} today, but a bump of the constant would "
+                f"silently strand this site — use {canon_name} itself",
+            )
+        return
+    dotted = astutil.dotted_name(proto)
+    if dotted is None:
+        return  # dynamic expression: out of static scope
+    if dotted.split(".")[-1] in _VERSION_DEPENDENT:
+        yield mod.finding(
+            "MPT007",
+            call,
+            f"pickle.dumps(protocol={dotted}) is interpreter-dependent "
+            f"— pin protocol={canon_name} (={canon_value})",
+        )
+        return
+    resolved = graph.resolve_constant(info, proto)
+    if resolved is None:
+        # unresolvable name: accept only the canonical spelling (covers
+        # linting a single file whose import chain is off the scan set)
+        if dotted.split(".")[-1] != canon_name:
+            yield mod.finding(
+                "MPT007",
+                call,
+                f"pickle.dumps protocol= names {dotted!r}, which does "
+                f"not resolve to the wire contract {canon_name}="
+                f"{canon_value} ({where})",
+            )
+    elif resolved != canon_value:
+        yield mod.finding(
+            "MPT007",
+            call,
+            f"pickle protocol drift: {dotted} resolves to {resolved} "
+            f"but the wire contract is {canon_name}={canon_value} "
+            f"({where})",
+        )
+
+
+def run(project) -> Iterable:
+    canon = canonical_protocol(project)
+    if canon is None:
+        return
+    canon_value, canon_name, where = canon
+    graph = project.graph
+    for mod in project.modules:
+        if not _is_wire_module(mod, project.config):
+            continue
+        mod_aliases, fn_names = _pickle_dumps_names(mod.tree)
+        if not mod_aliases and not fn_names:
+            continue
+        info = graph.module_for_rel(mod.rel)
+        for node in ast.walk(mod.tree):
+            if isinstance(node, ast.Call) and _is_dumps_call(
+                node, mod_aliases, fn_names
+            ):
+                yield from _check_dumps(
+                    mod, info, graph, node, canon_value, canon_name, where
+                )

@@ -29,6 +29,7 @@ from mpit_tpu.transport.base import (
     RecvTimeout,
     Transport,
 )
+from mpit_tpu.transport.socket_transport import WIRE_PICKLE_PROTOCOL
 
 __all__ = [
     "NativeBroker",
@@ -141,7 +142,9 @@ class NativeBroker:
     def _send(self, src: int, dst: int, tag: int, payload: Any) -> None:
         if not 0 <= dst < self.size:
             raise ValueError(f"dst {dst} out of range [0, {self.size})")
-        blob = pickle.dumps(payload, protocol=5)
+        # same pin as the socket wire: both brokers serve one protocol,
+        # and a drifted writer corrupts frames for mixed-version peers
+        blob = pickle.dumps(payload, protocol=WIRE_PICKLE_PROTOCOL)
         with self._op():
             rc = self._lib.mpit_broker_send(
                 self._h, src, dst, tag, blob, len(blob)

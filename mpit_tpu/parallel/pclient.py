@@ -27,6 +27,10 @@ from mpit_tpu.parallel.pserver import (
 )
 from mpit_tpu.transport import Transport
 
+# mpit-analysis: protocol-role[client->server]
+# (the client side of the PS wire protocol — MPT008 pairs every send/recv
+# here against the dispatch loop in pserver.py)
+
 
 class PClient:
     """Client stub: fetch / push against a set of sharded pservers.

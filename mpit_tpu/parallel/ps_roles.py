@@ -25,6 +25,11 @@ from mpit_tpu.parallel import common
 from mpit_tpu.parallel.pclient import PClient
 from mpit_tpu.utils.params import FlatParamSpec, unflatten_params
 
+# mpit-analysis: protocol-role[client->server]
+# (shared client-role body for both runtimes; its transport traffic all
+# flows through PClient, so MPT008 merges this module into the client
+# role's op set)
+
 
 def make_local_step(
     model, optimizer: optax.GradientTransformation,

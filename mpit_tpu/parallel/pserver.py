@@ -43,6 +43,10 @@ import numpy as np
 from mpit_tpu.analysis.runtime import make_lock
 from mpit_tpu.transport import ANY_SOURCE, ANY_TAG, RecvTimeout, Transport
 
+# mpit-analysis: protocol-role[server->client]
+# (this module IS the server side of the PS wire protocol; the MPT008
+# cross-module pass pairs every tag below against the client role's
+# send/recv pattern in pclient.py / ps_roles.py)
 TAG_FETCH = 1
 TAG_PUSH_EASGD = 2
 TAG_PUSH_DELTA = 3

@@ -30,4 +30,7 @@ from mpit_tpu.transport.base import (  # noqa: F401
     Transport,
 )
 from mpit_tpu.transport.inproc import Broker, InProcTransport  # noqa: F401
-from mpit_tpu.transport.socket_transport import SocketTransport  # noqa: F401
+from mpit_tpu.transport.socket_transport import (  # noqa: F401
+    WIRE_PICKLE_PROTOCOL,
+    SocketTransport,
+)

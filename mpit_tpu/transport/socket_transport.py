@@ -5,7 +5,8 @@ reference's multi-node MPI case, SURVEY.md §2 distributed-backend row). Data
 parallel *gradient* traffic should ride XLA collectives over ICI — this
 transport is for the PS protocol's small, latency-tolerant messages.
 
-Wire format: 8-byte big-endian length + pickle(protocol 5) of
+Wire format: 8-byte big-endian length + pickle (``WIRE_PICKLE_PROTOCOL``,
+the canonical pin every wire writer must name — lint rule MPT007) of
 (src, tag, payload). Each rank listens on one port; outbound connections are
 cached per destination. A background acceptor/reader thread feeds a local
 :class:`Broker` mailbox, so recv semantics (tags, ANY_SOURCE, per-(src,tag)
@@ -48,6 +49,14 @@ from mpit_tpu.transport.base import (
 from mpit_tpu.transport.inproc import Broker
 
 _LEN = struct.Struct(">Q")
+
+# The wire's ONE pickle protocol. Readers auto-detect (the id is embedded
+# in the stream), but every WRITER must pin this — an unpinned dumps rides
+# the interpreter default, which moves across Python versions, and a
+# mixed-version peer then sees unparseable frames on an otherwise healthy
+# socket. Every dumps feeding a frame (here and in mpit_tpu/native) must
+# name this constant; the MPT007 lint rule enforces exactly that.
+WIRE_PICKLE_PROTOCOL = 5
 
 
 def _addresses(size: int, base_port: int) -> list[tuple[str, int]]:
@@ -231,7 +240,9 @@ class SocketTransport(Transport):
         """Genuinely asynchronous: the frame (serialized NOW — the payload
         is captured at call time, per MPI buffer semantics) is handed to the
         dst's sender thread; the handle completes when it is written."""
-        blob = pickle.dumps((self.rank, tag, payload), protocol=5)
+        blob = pickle.dumps(
+            (self.rank, tag, payload), protocol=WIRE_PICKLE_PROTOCOL
+        )
         frame = _LEN.pack(len(blob)) + blob
         return self._send_queue(dst).enqueue(frame)
 

@@ -3,6 +3,7 @@
 #
 #   scripts/lint.sh                 # fail on findings not in the baseline
 #   scripts/lint.sh --update        # accept the current findings as baseline
+#   scripts/lint.sh --fix           # rewrite fixable MPT002 sites, then gate
 #   scripts/lint.sh path/to/file.py # lint specific paths (vs the baseline)
 #
 # Exit codes: 0 clean vs baseline, 1 new findings, 2 usage error.
@@ -15,6 +16,11 @@ cd "$(dirname "$0")/.."
 if [[ "${1:-}" == "--update" ]]; then
     shift
     exec python -m mpit_tpu.analysis --write-baseline "${@:-mpit_tpu/}"
+fi
+
+if [[ "${1:-}" == "--fix" ]]; then
+    shift
+    exec python -m mpit_tpu.analysis --fix "${@:-mpit_tpu/}"
 fi
 
 exec python -m mpit_tpu.analysis "${@:-mpit_tpu/}"

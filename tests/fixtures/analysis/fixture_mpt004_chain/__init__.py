@@ -1,0 +1,7 @@
+"""Seeded MPT004 wrapper-chain package.
+
+``top.py`` jits a callable reached through a 3-link chain (import alias →
+``functools.partial`` → assignment) whose ``static_argnums`` is out of
+range for the EFFECTIVE signature (the partial consumed one leading
+positional). Parsed by the linter tests, never imported.
+"""

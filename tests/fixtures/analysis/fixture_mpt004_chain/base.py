@@ -1,0 +1,5 @@
+"""Bottom of the wrapper chain: the real def."""
+
+
+def base_step(model, batch, extra):
+    return batch

@@ -1,0 +1,7 @@
+"""Middle links: import alias + partial binding the leading positional."""
+
+import functools
+
+from fixture_mpt004_chain.base import base_step as aliased_step
+
+bound_step = functools.partial(aliased_step, None)

@@ -1,0 +1,11 @@
+"""Top of the chain: the jit site whose static_argnums drifted.
+
+``bound_step`` is effectively ``base_step(batch, extra)`` — two positional
+parameters — so index 4 is out of range.
+"""
+
+import jax
+
+from fixture_mpt004_chain.mid import bound_step
+
+fast_step = jax.jit(bound_step, static_argnums=(4,))

@@ -1,0 +1,15 @@
+"""Seeded MPT007: pickle-protocol drift at a marked wire boundary.
+
+The module opts into the wire-format rule with the marker comment below;
+its one ``dumps`` pins a protocol that drifted off the canonical
+``WIRE_PICKLE_PROTOCOL`` contract. This file is parsed by the linter
+tests, never imported or executed.
+"""
+
+import pickle
+
+# mpit-analysis: wire-boundary
+
+
+def frame(payload):
+    return pickle.dumps(payload, protocol=4)  # drifted off the wire contract

@@ -1,0 +1,6 @@
+"""Seeded MPT008 package: a two-role protocol with one unpaired send.
+
+``client.py`` and ``server.py`` carry the protocol-role markers;
+``tags.py`` is their registry (values off the canonical 1-6 range so
+MPT003 stays quiet). Parsed by the linter tests, never imported.
+"""

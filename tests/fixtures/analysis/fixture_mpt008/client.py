@@ -1,0 +1,16 @@
+"""Client role: one paired exchange, plus the seeded orphan send."""
+
+from fixture_mpt008.tags import TAG_ORPHAN, TAG_REP, TAG_REQ
+
+# mpit-analysis: protocol-role[client->server]
+
+
+def exchange(transport, rank, payload):
+    transport.send(rank, TAG_REQ, payload)
+    return transport.recv(rank, TAG_REP)
+
+
+def leak(transport, rank, payload):
+    # the seeded defect: no server dispatch branch handles ORPHAN, so this
+    # message parks in the server mailbox forever
+    transport.send(rank, TAG_ORPHAN, payload)

@@ -1,0 +1,12 @@
+"""Server role: wildcard-recv dispatch loop that handles REQ only."""
+
+from fixture_mpt008.tags import TAG_REP, TAG_REQ
+
+# mpit-analysis: protocol-role[server->client]
+
+
+def serve(transport):
+    while True:
+        msg = transport.recv(-1, -1)
+        if msg.tag == TAG_REQ:
+            transport.send(msg.src, TAG_REP, "center")

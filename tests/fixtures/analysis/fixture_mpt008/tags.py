@@ -1,0 +1,5 @@
+"""Tag registry for the seeded two-role protocol."""
+
+TAG_REQ = 11
+TAG_REP = 12
+TAG_ORPHAN = 13

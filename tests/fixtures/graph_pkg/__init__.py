@@ -1,0 +1,8 @@
+"""Fixture package for analysis/graph.py unit tests.
+
+Exercises every resolution shape the module graph supports: import
+aliasing (``import x as y``, ``from x import y as z``, relative imports),
+constant/assign chains, ``functools.partial`` accumulation, pass-through
+wrappers, star-import refusal, and the cycle guard. Parsed by the tests,
+never imported.
+"""

@@ -1,0 +1,5 @@
+"""Constant registry: literals, folded unary, an assign chain."""
+
+BASE = 7
+DERIVED = BASE  # assign chain, resolves to 7
+NEG = -1  # UnaryOp(USub) folding

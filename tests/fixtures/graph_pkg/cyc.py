@@ -1,0 +1,7 @@
+"""Assignment cycle: resolution must terminate at MAX_DEPTH, not hang.
+
+(Would NameError at import time — this package is only ever parsed.)
+"""
+
+A = B  # noqa: F821
+B = A

@@ -1,0 +1,14 @@
+"""Callable-chain bottom: a real def, a partial, a pass-through wrapper."""
+
+import functools
+
+
+def inner(a, b, c):
+    return a
+
+
+def passthrough(*args, **kwargs):
+    return inner(*args, **kwargs)
+
+
+bound = functools.partial(inner, 1, b=2)

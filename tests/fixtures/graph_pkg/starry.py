@@ -1,0 +1,5 @@
+"""Star import: names it COULD provide must be refused, never guessed."""
+
+from graph_pkg.consts import *
+
+LOCAL = 3

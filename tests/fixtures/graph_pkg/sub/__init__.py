@@ -1,0 +1,1 @@
+"""Subpackage, for relative-import resolution."""

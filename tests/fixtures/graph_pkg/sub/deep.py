@@ -1,0 +1,5 @@
+"""Relative imports across package levels."""
+
+from ..consts import BASE as UP
+from ..funcs import inner as up_inner
+from .sibling import NEAR

@@ -1,0 +1,3 @@
+"""Same-level relative-import target."""
+
+NEAR = 21

@@ -1,0 +1,11 @@
+"""Every alias spelling the graph must follow, plus a stacked partial."""
+
+import functools
+
+import graph_pkg.consts as cc
+from graph_pkg import consts
+from graph_pkg.consts import BASE as RENAMED
+from graph_pkg.funcs import bound as rebound
+from graph_pkg.funcs import passthrough as forwarded
+
+double = functools.partial(rebound, 3)

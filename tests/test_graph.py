@@ -1,0 +1,158 @@
+"""analysis/graph.py: the whole-program name-resolution index.
+
+Everything here runs against ``tests/fixtures/graph_pkg`` — a package that
+is parsed, never imported (half of it would NameError on import, which is
+the point: the graph must work on code the linter cannot run).
+"""
+
+from __future__ import annotations
+
+from pathlib import Path
+
+import pytest
+
+from mpit_tpu.analysis import lint
+from mpit_tpu.analysis.graph import (
+    MAX_DEPTH,
+    ModuleGraph,
+    module_name_for_rel,
+)
+
+GRAPH_PKG = Path(__file__).resolve().parent / "fixtures" / "graph_pkg"
+
+
+@pytest.fixture(scope="module")
+def graph():
+    mods = [
+        lint.load_module(ap, rel)
+        for ap, rel in lint.collect_files([GRAPH_PKG])
+    ]
+    return ModuleGraph([m for m in mods if m is not None])
+
+
+def _info(graph, name):
+    info = graph.module(name)
+    assert info is not None, sorted(graph.by_name)
+    return info
+
+
+# ------------------------------------------------------------- module names
+
+
+@pytest.mark.parametrize(
+    "rel, name",
+    [
+        ("mpit_tpu/parallel/pserver.py", "mpit_tpu.parallel.pserver"),
+        ("graph_pkg/__init__.py", "graph_pkg"),
+        ("graph_pkg/sub/__init__.py", "graph_pkg.sub"),
+        ("solo.py", "solo"),
+    ],
+)
+def test_module_name_for_rel(rel, name):
+    assert module_name_for_rel(rel) == name
+
+
+def test_graph_indexes_all_modules(graph):
+    assert {
+        "graph_pkg",
+        "graph_pkg.consts",
+        "graph_pkg.funcs",
+        "graph_pkg.uses",
+        "graph_pkg.starry",
+        "graph_pkg.sub",
+        "graph_pkg.sub.deep",
+        "graph_pkg.sub.sibling",
+    } <= set(graph.by_name)
+
+
+# --------------------------------------------------------------- constants
+
+
+@pytest.mark.parametrize(
+    "module, dotted, value",
+    [
+        ("graph_pkg.consts", "BASE", 7),
+        ("graph_pkg.consts", "DERIVED", 7),  # assign chain
+        ("graph_pkg.consts", "NEG", -1),  # folded UnaryOp
+        ("graph_pkg.uses", "RENAMED", 7),  # from x import y as z
+        ("graph_pkg.uses", "cc.BASE", 7),  # import x.y as z
+        ("graph_pkg.uses", "consts.BASE", 7),  # from pkg import module
+        ("graph_pkg.sub.deep", "UP", 7),  # from ..consts import
+        ("graph_pkg.sub.deep", "NEAR", 21),  # from .sibling import
+    ],
+)
+def test_resolve_constant(graph, module, dotted, value):
+    assert graph.resolve_constant(_info(graph, module), dotted) == value
+
+
+def test_star_import_refused(graph):
+    """``starry.py`` star-imports consts: BASE *would* be in scope at
+    runtime, but the graph must refuse to guess — while names the module
+    binds itself still resolve."""
+    starry = _info(graph, "graph_pkg.starry")
+    assert "graph_pkg.consts" in starry.star_imports
+    assert graph.resolve_constant(starry, "BASE") is None
+    assert graph.resolve_constant(starry, "LOCAL") == 3
+
+
+def test_assignment_cycle_terminates(graph):
+    cyc = _info(graph, "graph_pkg.cyc")
+    assert graph.resolve_constant(cyc, "A") is None
+    assert graph.resolve_constant(cyc, "B") is None
+
+
+def test_off_graph_names_resolve_to_none(graph):
+    uses = _info(graph, "graph_pkg.uses")
+    assert graph.resolve_constant(uses, "functools.reduce") is None
+    assert graph.resolve_constant(uses, "nonexistent") is None
+
+
+# --------------------------------------------------------------- callables
+
+
+def test_resolve_callable_through_stacked_partials(graph):
+    """uses.double = partial(rebound, 3); rebound = funcs.bound =
+    partial(inner, 1, b=2) — the chain bottoms out at ``inner`` with TWO
+    leading positionals consumed and ``b`` keyword-bound."""
+    uses = _info(graph, "graph_pkg.uses")
+    ci = graph.resolve_callable(uses, "double")
+    assert ci is not None
+    assert ci.fn.name == "inner"
+    assert ci.module.name == "graph_pkg.funcs"
+    assert ci.bound_pos == 2
+    assert ci.bound_names == frozenset({"b"})
+    assert ci.depth >= 3  # alias -> assign -> partial -> partial
+
+
+def test_resolve_callable_through_passthrough_wrapper(graph):
+    uses = _info(graph, "graph_pkg.uses")
+    ci = graph.resolve_callable(uses, "forwarded")
+    assert ci is not None
+    assert ci.fn.name == "inner"
+    assert ci.bound_pos == 0
+
+
+def test_resolve_callable_alias_across_modules(graph):
+    deep = _info(graph, "graph_pkg.sub.deep")
+    ci = graph.resolve_callable(deep, "up_inner")
+    assert ci is not None
+    assert ci.fn.name == "inner"
+    assert ci.module.name == "graph_pkg.funcs"
+
+
+def test_max_depth_is_a_cycle_guard():
+    # direct unit check: a synthetic 2-module alias cycle ends at MAX_DEPTH
+    import ast as _ast
+
+    class _Ctx:
+        def __init__(self, rel, src):
+            self.rel, self.tree = rel, _ast.parse(src)
+
+    g = ModuleGraph(
+        [
+            _Ctx("a.py", "from b import x as x\n"),
+            _Ctx("b.py", "from a import x as x\n"),
+        ]
+    )
+    assert MAX_DEPTH >= 8
+    assert g.resolve_constant(g.module("a"), "x") is None

@@ -1,0 +1,84 @@
+"""The CI lint gate, exercised exactly the way CI runs it.
+
+The acceptance contract for the analysis subsystem:
+
+- ``python -m mpit_tpu.analysis --format json`` over the package exits 0
+  with ZERO non-baseline findings (and the baseline itself stays small and
+  reviewed);
+- the whole-package scan is fast enough for a pre-commit hook;
+- the scan IMPORTS NOTHING it analyzes — it must be safe on code that
+  would crash, hang, or initialize a TPU backend at import time.
+"""
+
+from __future__ import annotations
+
+import json
+import subprocess
+import sys
+import time
+from pathlib import Path
+
+from mpit_tpu.analysis import lint
+
+REPO = Path(__file__).resolve().parent.parent
+PKG = REPO / "mpit_tpu"
+
+
+def _cli(*args):
+    return subprocess.run(
+        [sys.executable, "-m", "mpit_tpu.analysis", *args],
+        capture_output=True, text=True, cwd=REPO, timeout=120,
+    )
+
+
+def test_gate_json_exits_clean_with_no_new_findings():
+    proc = _cli("--format", "json", str(PKG))
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    doc = json.loads(proc.stdout)
+    assert doc["findings"] == []
+    assert doc["baselined"] > 0  # the baseline is in use, not bypassed
+    assert doc["total_scanned"] == doc["baselined"]
+
+
+def test_gate_script_passes():
+    proc = subprocess.run(
+        ["bash", str(REPO / "scripts" / "lint.sh")],
+        capture_output=True, text=True, cwd=REPO, timeout=120,
+    )
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+
+
+def test_gate_fails_on_a_new_finding(tmp_path):
+    bad = tmp_path / "drifted.py"
+    bad.write_text(
+        "import pickle\n"
+        "# mpit-analysis: wire-boundary\n"
+        "def frame(x):\n"
+        "    return pickle.dumps(x, protocol=4)\n"
+    )
+    proc = _cli("--format", "json", "--no-baseline", str(bad))
+    assert proc.returncode == 1
+    doc = json.loads(proc.stdout)
+    assert [f["rule"] for f in doc["findings"]] == ["MPT007"]
+
+
+def test_whole_package_scan_is_fast():
+    """< 5 s in-process for the full package, cross-module passes
+    included — the pre-commit-hook budget from the acceptance bar."""
+    start = time.monotonic()
+    lint.run_lint([PKG])
+    assert time.monotonic() - start < 5.0
+
+
+def test_scan_never_imports_analyzed_code(tmp_path):
+    """Linting a module whose import has a visible side effect must not
+    trigger that side effect (and must not crash on its bare
+    ``raise``)."""
+    marker = tmp_path / "imported.marker"
+    mod = tmp_path / "boobytrap.py"
+    mod.write_text(
+        f"open({str(marker)!r}, 'w').close()\n"
+        "raise RuntimeError('imported, not parsed')\n"
+    )
+    lint.run_lint([mod])
+    assert not marker.exists()
