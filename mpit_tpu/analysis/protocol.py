@@ -24,10 +24,11 @@ Extracted per role, with tags resolved to integers through the module graph
 expressions are skipped — conservative, no finding):
 
 - **sends**: ``send``/``isend`` call sites (3+ args: the transport shape),
-  including ONE level of local indirection — a module-local function that
-  forwards a tag parameter to a transport send (``PClient._scatter``)
-  counts its call sites (``self._scatter(TAG_PUSH_EASGD, ...)``) as sends
-  of the resolved tag;
+  including module-local indirection to a fixpoint — a function that
+  forwards a tag parameter toward a transport send, directly
+  (``PClient._send_with_retry``) or through another wrapper
+  (``PClient._scatter`` riding the retry helper), counts its call sites
+  (``self._scatter(TAG_PUSH_EASGD, ...)``) as sends of the resolved tag;
 - **recvs**: ``recv``/``irecv``/``probe`` sites; a missing/``-1``/
   ``ANY_TAG`` tag is a *wildcard* recv (the dispatcher pattern);
 - **dispatch tags**: ``== TAG_X`` / ``!= TAG_X`` / ``in (TAG_X, ...)``
@@ -158,23 +159,43 @@ def _tag_value(graph, info, node) -> tuple:
 def _send_wrappers(tree: ast.Module) -> dict:
     """Module-local functions that forward a parameter into a transport
     send's tag slot: name -> index of that parameter in the call signature
-    (``self`` excluded for methods — callers don't pass it)."""
+    (``self`` excluded for methods — callers don't pass it).
+
+    Computed to a fixpoint: a function forwarding its tag parameter into
+    a *known wrapper* is itself a wrapper, so chains like
+    ``PClient._scatter -> PClient._send_with_retry -> transport.send``
+    still resolve their call sites' concrete tags."""
     out: dict = {}
-    for node in ast.walk(tree):
-        if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
-            continue
-        params = [a.arg for a in node.args.posonlyargs + node.args.args]
-        call_params = params[1:] if params[:1] == ["self"] else params
-        for sub in ast.walk(node):
-            if not isinstance(sub, ast.Call):
+    changed = True
+    while changed:
+        changed = False
+        for node in ast.walk(tree):
+            if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
                 continue
-            if astutil.call_last_name(sub) not in _SEND_NAMES:
+            if node.name in out:
                 continue
-            if len(sub.args) + len(sub.keywords) < 3:
-                continue
-            tag_arg = astutil.get_arg(sub, 1, "tag")
-            if isinstance(tag_arg, ast.Name) and tag_arg.id in call_params:
-                out[node.name] = call_params.index(tag_arg.id)
+            params = [a.arg for a in node.args.posonlyargs + node.args.args]
+            call_params = params[1:] if params[:1] == ["self"] else params
+            for sub in ast.walk(node):
+                if not isinstance(sub, ast.Call):
+                    continue
+                callee = astutil.call_last_name(sub)
+                if callee in _SEND_NAMES:
+                    if len(sub.args) + len(sub.keywords) < 3:
+                        continue
+                    tag_idx = 1
+                elif callee in out and callee != node.name:
+                    tag_idx = out[callee]
+                else:
+                    continue
+                tag_arg = astutil.get_arg(sub, tag_idx, "tag")
+                if (
+                    isinstance(tag_arg, ast.Name)
+                    and tag_arg.id in call_params
+                ):
+                    out[node.name] = call_params.index(tag_arg.id)
+                    changed = True
+                    break
     return out
 
 

@@ -76,6 +76,17 @@ def main(argv=None) -> int:
     if ns.n < 1:
         p.error("-n must be >= 1")
 
+    # chaos knobs are inherited by every rank (env passthrough below):
+    # fault injection silently active in a "real" run is a support
+    # nightmare, so say it loudly once at launch (docs/ROBUSTNESS.md)
+    chaos_env = sorted(k for k in os.environ if k.startswith("MPIT_CHAOS_"))
+    if chaos_env:
+        print(
+            "[launch] CHAOS fault injection active in all ranks: "
+            + " ".join(f"{k}={os.environ[k]}" for k in chaos_env),
+            file=sys.stderr,
+        )
+
     # one extra port for the jax.distributed coordinator (rank 0 binds it)
     reserving, ports = _reserve_ports(ns.n + (1 if ns.jax_distributed else 0))
     coord_sock, coord_port = None, None

@@ -7,11 +7,27 @@ splits the flat vector across the server partition (``partition_bounds``),
 talks the tag protocol over ``mpit_tpu.transport``, and leaves all actual
 training math to the caller — compute stays jit-compiled on device, only
 flat numpy chunks cross the transport.
+
+Fault tolerance (docs/ROBUSTNESS.md; the reference would simply hang):
+
+- :meth:`fetch` retries with exponential backoff, and every FETCH carries
+  a fresh *attempt id* that the server echoes in its PARAM reply — a
+  stale reply belonging to a timed-out earlier attempt (or a
+  chaos-duplicated one) is discarded instead of being mis-assembled into
+  the wrong chunk slot.
+- pushes carry an ``(epoch, seq, chunk)`` envelope; the server's dedup
+  window applies each (epoch, seq) exactly once, so send retries after a
+  connection reset (and duplicated frames) can never double-apply.
+- transient send failures (``ConnectionError``/``OSError``) are retried
+  with the same backoff schedule before surfacing to the caller.
 """
 
 from __future__ import annotations
 
+import itertools
+import os
 import threading
+import time
 from typing import Optional, Sequence
 
 import numpy as np
@@ -25,7 +41,7 @@ from mpit_tpu.parallel.pserver import (
     TAG_STOP,
     partition_bounds,
 )
-from mpit_tpu.transport import Transport
+from mpit_tpu.transport import RecvTimeout, Transport
 
 # mpit-analysis: protocol-role[client->server]
 # (the client side of the PS wire protocol — MPT008 pairs every send/recv
@@ -41,6 +57,18 @@ class PClient:
     zero-payload HEARTBEATs to every server so the server watchdog
     (``PServer(client_timeout=...)``) doesn't declare this client dead
     during long local compute between exchanges. Stopped by :meth:`stop`.
+
+    Retry knobs: ``timeout`` is the *per-attempt* PARAM wait;
+    ``max_retries`` extra attempts follow the first, each preceded by an
+    exponential backoff (``backoff_base * 2**k``, capped at
+    ``backoff_max``). Worst-case fetch latency per server is therefore
+    ``(max_retries + 1) * timeout`` plus the backoff sum.
+
+    Accounting: ``push_sent[rank]`` counts chunks *successfully handed to
+    the transport* per server — under fault injection that excludes
+    resets (never delivered), so it is exactly the number the server
+    should have applied (drops/blackholes excepted); the chaos acceptance
+    test pins ``server.counts == client sends`` on it.
     """
 
     def __init__(
@@ -50,12 +78,27 @@ class PClient:
         param_size: int,
         timeout: Optional[float] = 60.0,
         heartbeat_interval: Optional[float] = None,
+        max_retries: int = 3,
+        backoff_base: float = 0.05,
+        backoff_max: float = 2.0,
     ):
+        if max_retries < 0:
+            raise ValueError("max_retries must be >= 0")
         self.transport = transport
         self.server_ranks = list(server_ranks)
         self.param_size = int(param_size)
         self.bounds = partition_bounds(self.param_size, len(self.server_ranks))
         self.timeout = timeout
+        self.max_retries = int(max_retries)
+        self.backoff_base = float(backoff_base)
+        self.backoff_max = float(backoff_max)
+        # identity for the server-side dedup window: a replacement client
+        # on a reused rank must not look like replays of its predecessor
+        self._epoch = int.from_bytes(os.urandom(8), "big")
+        self._attempt_ids = itertools.count(1)
+        self._push_seq = itertools.count(1)
+        self.push_sent: dict[int, int] = {r: 0 for r in self.server_ranks}
+        self.stale_params_dropped = 0
         self._hb_stop = threading.Event()
         self._hb_thread: Optional[threading.Thread] = None
         if heartbeat_interval is not None:
@@ -80,16 +123,99 @@ class PClient:
                     # thread exits only via stop().
                     pass
 
+    # -- retry plumbing ---------------------------------------------------
+
+    def _backoff(self, attempt: int) -> None:
+        time.sleep(min(self.backoff_base * (2 ** attempt), self.backoff_max))
+
+    def _send_with_retry(self, rank: int, tag: int, payload) -> None:
+        """Send, absorbing up to ``max_retries`` transient transport
+        failures with backoff. Safe for at-most-once payloads only when
+        the receiver deduplicates (push envelopes) or the message is
+        idempotent (FETCH, STOP)."""
+        for attempt in range(self.max_retries + 1):
+            try:
+                self.transport.send(rank, tag, payload)
+                return
+            except (ConnectionError, OSError):
+                if attempt == self.max_retries:
+                    raise
+                self._backoff(attempt)
+
+    def _send_fetch(self, rank: int) -> int:
+        attempt_id = next(self._attempt_ids)
+        self.transport.send(rank, TAG_FETCH, attempt_id)
+        return attempt_id
+
+    def _await_param(self, rank: int, attempt_id: Optional[int]) -> np.ndarray:
+        """Collect one server's PARAM chunk, retrying the whole
+        FETCH→PARAM attempt on timeout or send failure. Replies tagged
+        with an attempt id other than the live one are stale — consumed
+        and discarded so they can never be assembled into this (or a
+        later) fetch."""
+        last_exc: Optional[BaseException] = None
+        for attempt in range(self.max_retries + 1):
+            if attempt > 0:
+                self._backoff(attempt - 1)
+            if attempt_id is None:  # (re)issue this attempt's FETCH
+                try:
+                    attempt_id = self._send_fetch(rank)
+                except (ConnectionError, OSError) as e:
+                    last_exc = e
+                    continue
+            deadline = (
+                None if self.timeout is None
+                else time.monotonic() + self.timeout
+            )
+            while True:
+                remaining = (
+                    None if deadline is None
+                    else deadline - time.monotonic()
+                )
+                if remaining is not None and remaining <= 0:
+                    last_exc = RecvTimeout(
+                        f"PARAM from server {rank} not received within "
+                        f"{self.timeout}s (attempt {attempt + 1})"
+                    )
+                    break
+                try:
+                    msg = self.transport.recv(
+                        rank, TAG_PARAM, timeout=remaining
+                    )
+                except RecvTimeout as e:
+                    last_exc = e
+                    break
+                payload = msg.payload
+                if isinstance(payload, tuple) and len(payload) == 2:
+                    got_id, chunk = payload
+                    if got_id != attempt_id:
+                        self.stale_params_dropped += 1
+                        continue  # a timed-out attempt's late reply
+                    return chunk
+                return payload  # legacy un-id'd reply
+            attempt_id = None  # attempt dead: the next one re-sends
+        raise RecvTimeout(
+            f"fetch from server {rank} failed after "
+            f"{self.max_retries + 1} attempts"
+        ) from last_exc
+
+    # -- protocol ---------------------------------------------------------
+
     def fetch(self) -> np.ndarray:
         """Gather the full flat center from all servers (async fan-out:
         request every chunk before waiting on any — the reference's
-        ``async_fetch_param`` shape, SURVEY.md §3(b))."""
+        ``async_fetch_param`` shape, SURVEY.md §3(b)); per-server
+        retry-with-backoff on timeout, attempt-id'd against stale
+        replies."""
+        attempts: dict[int, Optional[int]] = {}
         for rank in self.server_ranks:
-            self.transport.send(rank, TAG_FETCH, None)
+            try:
+                attempts[rank] = self._send_fetch(rank)
+            except (ConnectionError, OSError):
+                attempts[rank] = None  # the retry path re-sends
         out = np.empty(self.param_size, np.float32)
         for rank, (start, end) in zip(self.server_ranks, self.bounds):
-            msg = self.transport.recv(rank, TAG_PARAM, timeout=self.timeout)
-            out[start:end] = msg.payload
+            out[start:end] = self._await_param(rank, attempts[rank])
         return out
 
     def push_easgd(self, flat_params: np.ndarray) -> None:
@@ -101,12 +227,27 @@ class PClient:
         self._scatter(TAG_PUSH_DELTA, flat_delta)
 
     def stop(self) -> None:
-        """Detach from every server (teardown protocol, SURVEY.md §3(e))."""
+        """Detach from every server (teardown protocol, SURVEY.md §3(e)).
+
+        Attempts ALL servers even when some sends fail — skipping the
+        rest would leave healthy servers waiting for a STOP that never
+        comes (until their watchdog fires). Errors are collected and
+        re-raised as one aggregate at the end."""
         self._hb_stop.set()
         if self._hb_thread is not None:
             self._hb_thread.join(timeout=5)
+        errors: list[tuple[int, BaseException]] = []
         for rank in self.server_ranks:
-            self.transport.send(rank, TAG_STOP, None)
+            try:
+                self._send_with_retry(rank, TAG_STOP, None)
+            except Exception as e:
+                errors.append((rank, e))
+        if errors:
+            raise RuntimeError(
+                "STOP failed for server rank(s) "
+                f"{[r for r, _ in errors]}: "
+                f"{'; '.join(repr(e) for _, e in errors)}"
+            ) from errors[0][1]
 
     def _scatter(self, tag: int, flat: np.ndarray) -> None:
         flat = np.asarray(flat, np.float32)
@@ -114,5 +255,12 @@ class PClient:
             raise ValueError(
                 f"flat vector shape {flat.shape} != ({self.param_size},)"
             )
+        # one seq per logical push: every server's chunk shares it, and a
+        # send retry re-offers the same (epoch, seq) — the server window
+        # turns at-least-once delivery into exactly-once application
+        seq = next(self._push_seq)
         for rank, (start, end) in zip(self.server_ranks, self.bounds):
-            self.transport.send(rank, tag, flat[start:end])
+            self._send_with_retry(
+                rank, tag, (self._epoch, seq, flat[start:end])
+            )
+            self.push_sent[rank] += 1

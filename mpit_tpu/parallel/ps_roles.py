@@ -15,6 +15,7 @@ stay protocol-identical.
 
 from __future__ import annotations
 
+import logging
 from typing import Callable, Optional
 
 import jax
@@ -23,7 +24,10 @@ import optax
 
 from mpit_tpu.parallel import common
 from mpit_tpu.parallel.pclient import PClient
+from mpit_tpu.transport import RecvTimeout
 from mpit_tpu.utils.params import FlatParamSpec, unflatten_params
+
+logger = logging.getLogger("mpit_tpu.parallel.ps_roles")
 
 # mpit-analysis: protocol-role[client->server]
 # (shared client-role body for both runtimes; its transport traffic all
@@ -62,11 +66,22 @@ def client_train_loop(
     algo: str,
     alpha: float,
     seed: int,
+    max_exchange_failures: Optional[int] = None,
+    exchange_stats: Optional[dict] = None,
 ) -> list[float]:
     """The pclient side of SURVEY.md §3(b): τ jit-compiled local steps, then
     push/pull per ``algo`` ("easgd" or "downpour"). Returns per-step losses.
     Does NOT send stop — the caller owns teardown (it may want a final
     ``client.fetch()`` for evaluation first).
+
+    Graceful degradation (docs/ROBUSTNESS.md): with
+    ``max_exchange_failures`` set, a failed exchange (timeout after the
+    client's retries, or a transport error) logs, SKIPS the round — the
+    client keeps training on its local params against the stale center —
+    and only escalates once that many *consecutive* rounds have failed
+    (any success resets the count). ``None`` keeps fail-fast semantics.
+    ``exchange_stats`` (when provided) is filled with
+    ``{"skipped_rounds", "exchange_failures"}`` totals.
 
     Loss scalars stay ON DEVICE between exchanges and are host-fetched in
     one batched transfer at each τ boundary (where the param flatten
@@ -84,6 +99,9 @@ def client_train_loop(
     last_pull = np.asarray(flatten_params(params)[0])
     losses: list[float] = []
     pending: list = []
+    consecutive_failures = 0
+    skipped_rounds = 0
+    total_failures = 0
 
     def flush():
         if pending:
@@ -97,20 +115,47 @@ def client_train_loop(
         if (step + 1) % tau == 0:
             flush()
             flat = np.asarray(flatten_params(params)[0])
-            if algo == "easgd":
-                # fetch BEFORE push so the client's elastic move uses the
-                # pre-push center — the paper's update order (both moves on
-                # the old center), and the same order goptim.easgd_round
-                # implements for the collective path. Push-then-fetch would
-                # couple against a center already moved by this client's own
-                # push (an alpha*(1-alpha) effective move).
-                center = client.fetch()
-                client.push_easgd(flat)
-                flat = flat - alpha * (flat - center)
-            else:
-                client.push_delta(flat - last_pull)
-                flat = client.fetch()
-                last_pull = flat
+            try:
+                if algo == "easgd":
+                    # fetch BEFORE push so the client's elastic move uses the
+                    # pre-push center — the paper's update order (both moves on
+                    # the old center), and the same order goptim.easgd_round
+                    # implements for the collective path. Push-then-fetch would
+                    # couple against a center already moved by this client's own
+                    # push (an alpha*(1-alpha) effective move).
+                    center = client.fetch()
+                    client.push_easgd(flat)
+                    flat = flat - alpha * (flat - center)
+                else:
+                    client.push_delta(flat - last_pull)
+                    # the pushed delta now belongs to the server: a fetch
+                    # failure below must not get it re-pushed next round
+                    last_pull = flat
+                    flat = client.fetch()
+                    last_pull = flat
+            except (RecvTimeout, ConnectionError, OSError) as e:
+                total_failures += 1
+                consecutive_failures += 1
+                if max_exchange_failures is None:
+                    raise  # fail-fast semantics (degradation not enabled)
+                if consecutive_failures >= max_exchange_failures:
+                    raise RuntimeError(
+                        f"PS exchange failed {consecutive_failures} rounds "
+                        "in a row — escalating instead of training further "
+                        "against an unreachable center"
+                    ) from e
+                skipped_rounds += 1
+                logger.warning(
+                    "PS exchange failed (%r); skipping round on the stale "
+                    "center (%d consecutive failure(s))",
+                    e,
+                    consecutive_failures,
+                )
+                continue  # params stay local this round
+            consecutive_failures = 0
             params = unflatten_params(spec, jnp.asarray(flat))
     flush()  # steps % tau remainder
+    if exchange_stats is not None:
+        exchange_stats["skipped_rounds"] = skipped_rounds
+        exchange_stats["exchange_failures"] = total_failures
     return losses

@@ -26,6 +26,12 @@ from mpit_tpu.parallel import common, ps_roles
 from mpit_tpu.parallel.pclient import PClient
 from mpit_tpu.parallel.pserver import PServer, partition_bounds, spawn_server_thread
 from mpit_tpu.transport import Broker
+from mpit_tpu.transport.chaos import (
+    ChaosConfig,
+    FaultLog,
+    config_from_env,
+    wrap_transports,
+)
 from mpit_tpu.utils.params import flatten_params, unflatten_params
 
 
@@ -58,6 +64,19 @@ class AsyncPSTrainer:
         Client rejoin needs no persistence: a replacement client on a
         dead client's rank fetches the live center and its first message
         revives it at the server watchdog (tests/test_failure.py).
+      chaos: fault-injection schedule (docs/ROBUSTNESS.md). When set —
+        or when any ``MPIT_CHAOS_*`` env knob is — every transport is
+        wrapped in a :class:`ChaosTransport` sharing one fault log
+        (``stats["chaos_faults"]``); the run must then survive on the
+        retry/dedup/degradation machinery below.
+      max_exchange_failures: graceful degradation — a client's failed
+        exchange (after PClient's own retries) skips the round on the
+        stale center; this many CONSECUTIVE failures escalate to an
+        error. ``None`` = fail on the first exchange error.
+      fetch_timeout / fetch_retries: forwarded to each PClient — the
+        per-attempt PARAM wait and the retry budget for FETCH/PARAM
+        and push sends. Chaos tests drop these to sub-second values so
+        injected losses resolve quickly.
     """
 
     def __init__(
@@ -76,6 +95,10 @@ class AsyncPSTrainer:
         ckpt_dir: Optional[str] = None,
         ckpt_every: Optional[int] = 100,
         resume: bool = True,
+        chaos: Optional[ChaosConfig] = None,
+        max_exchange_failures: Optional[int] = 3,
+        fetch_timeout: float = 60.0,
+        fetch_retries: int = 3,
     ):
         if algo not in ("easgd", "downpour"):
             raise ValueError(f"unknown algo {algo!r}")
@@ -109,6 +132,19 @@ class AsyncPSTrainer:
         self.ckpt_dir = ckpt_dir
         self.ckpt_every = None if ckpt_every is None else int(ckpt_every)
         self.resume = bool(resume)
+        if max_exchange_failures is not None and max_exchange_failures < 1:
+            raise ValueError(
+                "max_exchange_failures must be >= 1 (None = fail fast)"
+            )
+        if fetch_timeout <= 0:
+            raise ValueError("fetch_timeout must be positive")
+        if fetch_retries < 0:
+            raise ValueError("fetch_retries must be >= 0")
+        self.chaos = chaos
+        self.max_exchange_failures = max_exchange_failures
+        self.fetch_timeout = float(fetch_timeout)
+        self.fetch_retries = int(fetch_retries)
+        self.fault_log: Optional[FaultLog] = None
         # one compiled local step shared by all client threads (same shapes,
         # one compile; XLA releases the GIL so clients genuinely overlap)
         self._local_step = ps_roles.make_local_step(
@@ -150,6 +186,12 @@ class AsyncPSTrainer:
 
         broker = self._make_broker(self.num_servers + self.num_clients)
         transports = broker.transports()
+        # fault injection: explicit config wins, env knobs activate it for
+        # launcher-driven runs (MPIT_CHAOS_*; see launch.py's diagnostic)
+        chaos_cfg = self.chaos if self.chaos is not None else config_from_env()
+        self.fault_log = None
+        if chaos_cfg is not None:
+            transports, self.fault_log = wrap_transports(transports, chaos_cfg)
         server_ranks = list(range(self.num_servers))
         client_ranks = list(
             range(self.num_servers, self.num_servers + self.num_clients)
@@ -187,6 +229,8 @@ class AsyncPSTrainer:
 
         losses = [[] for _ in range(self.num_clients)]
         errors: list[BaseException] = []
+        clients: list = [None] * self.num_clients
+        exchange_stats: list[dict] = [{} for _ in range(self.num_clients)]
 
         def client_main(c: int):
             client = None
@@ -198,14 +242,19 @@ class AsyncPSTrainer:
                     else None
                 )
                 client = PClient(
-                    tp, server_ranks, flat0.size, heartbeat_interval=hb
+                    tp, server_ranks, flat0.size, heartbeat_interval=hb,
+                    timeout=self.fetch_timeout,
+                    max_retries=self.fetch_retries,
                 )
+                clients[c] = client
                 xs = shard_for_worker(x, c, self.num_clients)
                 ys = shard_for_worker(y, c, self.num_clients)
                 losses[c] = ps_roles.client_train_loop(
                     client, self._local_step, self.optimizer, spec,
                     xs, ys, steps, batch_size, self.tau, self.algo,
                     self.alpha, seed=seed + 1000 + c,
+                    max_exchange_failures=self.max_exchange_failures,
+                    exchange_stats=exchange_stats[c],
                 )
                 client.stop()
             except BaseException as e:  # surface thread failures to caller
@@ -257,7 +306,26 @@ class AsyncPSTrainer:
                 np.mean([l[-1] for l in losses if l]) if any(losses) else np.nan
             ),
             "losses": losses,
+            # robustness accounting (docs/ROBUSTNESS.md): per-client push
+            # sends that reached the transport (== what servers should
+            # have applied under dedup), rounds degraded, stale PARAM
+            # replies the attempt-id check discarded
+            "push_sent": [
+                dict(c.push_sent) if c is not None else {} for c in clients
+            ],
+            "stale_params_dropped": [
+                c.stale_params_dropped if c is not None else 0
+                for c in clients
+            ],
+            "skipped_rounds": [
+                s.get("skipped_rounds", 0) for s in exchange_stats
+            ],
+            "exchange_failures": [
+                s.get("exchange_failures", 0) for s in exchange_stats
+            ],
         }
+        if self.fault_log is not None:
+            stats["chaos_faults"] = self.fault_log.counts()
         return center_params, stats
 
     def evaluate(self, params, x, y, batch: int = 512) -> float:

@@ -15,12 +15,24 @@ the reference's worker→server mapping generalized to BASELINE.json:9's
 "16 workers / 4 pservers" config.
 
 Protocol tags (client → server unless noted):
-  FETCH       ()                server replies PARAM(chunk) to requester
-  PUSH_EASGD  (x_chunk)         center += alpha * (x_chunk - center)
-  PUSH_DELTA  (delta_chunk)     center += server_lr * delta_chunk
-  PARAM       (chunk)           server → client fetch reply
-  STOP        ()                client detaches; server exits when all did
-  HEARTBEAT   ()                liveness only (refreshes the watchdog)
+  FETCH       (attempt_id|None)  server replies PARAM to requester
+  PUSH_EASGD  (envelope)         center += alpha * (x_chunk - center)
+  PUSH_DELTA  (envelope)         center += server_lr * delta_chunk
+  PARAM       ((attempt_id, chunk) | chunk)   server → client fetch reply
+  STOP        ()                 client detaches; server exits when all did
+  HEARTBEAT   ()                 liveness only (refreshes the watchdog)
+
+Fault-tolerant envelopes (docs/ROBUSTNESS.md): a FETCH carrying an
+``attempt_id`` gets it echoed in the PARAM reply, so a client whose
+earlier attempt timed out can discard the stale reply instead of
+mis-assembling chunks across attempts. A push envelope is ``(epoch, seq,
+chunk)``: ``seq`` is the client's per-push counter and ``epoch`` its
+per-instance identity, deduplicated server-side in a sliding window so a
+duplicated/retransmitted push applies **exactly once** (rejects counted
+in ``counts["dup_dropped"]``); a *replacement* client on a reused rank
+has a fresh epoch, so its restarted seq stream is not mistaken for
+replays of its predecessor's. Bare payloads (no envelope) keep the
+legacy apply-always semantics for hand-rolled protocol tests.
 
 Failure detection (a do-better over the reference — SURVEY.md §5: 'a dead
 rank hangs the job'): with ``client_timeout`` set, the server runs a
@@ -55,6 +67,39 @@ TAG_STOP = 5
 TAG_HEARTBEAT = 6
 
 
+class _DedupWindow:
+    """Per-(src, epoch) sliding window of seen push sequence numbers.
+
+    ``admit`` is True exactly once per (src, epoch, seq): a retransmitted
+    or chaos-duplicated push is rejected. A seq at or below ``high -
+    size`` is *also* rejected — outside the window we can no longer tell
+    a stale retransmit from a fresh push, and at-most-once is the safe
+    side of that ambiguity (the client treats a lost push as a skipped
+    round, never as corruption). Single-threaded by design: only the
+    server's recv loop touches it."""
+
+    def __init__(self, size: int = 1024):
+        if size < 1:
+            raise ValueError("dedup window size must be >= 1")
+        self.size = size
+        self._high: dict[tuple[int, int], int] = {}
+        self._seen: dict[tuple[int, int], set[int]] = {}
+
+    def admit(self, src: int, epoch: int, seq: int) -> bool:
+        key = (src, epoch)
+        high = self._high.get(key, 0)
+        seen = self._seen.setdefault(key, set())
+        if seq <= high - self.size or seq in seen:
+            return False
+        seen.add(seq)
+        if seq > high:
+            self._high[key] = seq
+            if len(seen) > self.size:
+                floor = seq - self.size
+                self._seen[key] = {s for s in seen if s > floor}
+        return True
+
+
 def partition_bounds(total: int, num_servers: int) -> list[tuple[int, int]]:
     """Contiguous chunk [start, end) per server (np.array_split boundaries:
     the first ``total % num_servers`` chunks get one extra element)."""
@@ -86,6 +131,7 @@ class PServer:
         client_timeout: Optional[float] = None,
         ckpt_path: Optional[str] = None,
         ckpt_every: Optional[int] = 100,
+        dedup_window: int = 1024,
     ):
         """``client_timeout``: seconds of per-client silence before the
         watchdog declares it dead (requires ``client_ranks``); None keeps
@@ -118,7 +164,8 @@ class PServer:
                 )
         self.client_timeout = client_timeout
         self.counts = {"fetch": 0, "push_easgd": 0, "push_delta": 0,
-                       "heartbeat": 0}
+                       "heartbeat": 0, "dup_dropped": 0}
+        self._dedup = _DedupWindow(dedup_window)
         self.dead_clients: set[int] = set()
         self._stopped: set[int] = set()
         self.error: Optional[BaseException] = None
@@ -176,22 +223,30 @@ class PServer:
                 with self._lock:
                     snapshot = self.center.copy()
                     self.counts["fetch"] += 1
-                self.transport.send(msg.src, TAG_PARAM, snapshot)
+                # echo the client's attempt id so a retrying fetch can
+                # tell this reply from a stale one (None = legacy FETCH)
+                reply = (
+                    snapshot if msg.payload is None
+                    else (msg.payload, snapshot)
+                )
+                self.transport.send(msg.src, TAG_PARAM, reply)
             elif msg.tag == TAG_PUSH_EASGD:
-                with self._lock:
-                    # elastic move toward the client (SURVEY.md §3(c) push)
-                    self.center += self.alpha * (
-                        np.asarray(msg.payload) - self.center
-                    )
-                    self.counts["push_easgd"] += 1
-                    self._updates_since_save += 1
-                self._maybe_persist()
+                if self._admit_push(msg):
+                    with self._lock:
+                        # elastic move toward the client (SURVEY.md §3(c) push)
+                        self.center += self.alpha * (
+                            np.asarray(msg.payload) - self.center
+                        )
+                        self.counts["push_easgd"] += 1
+                        self._updates_since_save += 1
+                    self._maybe_persist()
             elif msg.tag == TAG_PUSH_DELTA:
-                with self._lock:
-                    self.center += self.server_lr * np.asarray(msg.payload)
-                    self.counts["push_delta"] += 1
-                    self._updates_since_save += 1
-                self._maybe_persist()
+                if self._admit_push(msg):
+                    with self._lock:
+                        self.center += self.server_lr * np.asarray(msg.payload)
+                        self.counts["push_delta"] += 1
+                        self._updates_since_save += 1
+                    self._maybe_persist()
             elif msg.tag == TAG_HEARTBEAT:
                 with self._lock:
                     self.counts["heartbeat"] += 1
@@ -202,6 +257,29 @@ class PServer:
             if watchdog:
                 self._expire(last_seen)
         self.persist()  # clean teardown: the final center is never lost
+
+    def _admit_push(self, msg) -> bool:
+        """Unwrap a push envelope and run the exactly-once check.
+
+        ``(epoch, seq, chunk)`` envelopes are deduplicated per (src,
+        epoch); the chunk is rebound onto ``msg.payload`` so the apply
+        path below handles both envelope and legacy bare-chunk pushes
+        identically. Returns False for a replay (counted, not applied).
+        """
+        payload = msg.payload
+        if (
+            isinstance(payload, tuple)
+            and len(payload) == 3
+            and isinstance(payload[0], int)
+            and isinstance(payload[1], int)
+        ):
+            epoch, seq, chunk = payload
+            msg.payload = chunk
+            if not self._dedup.admit(msg.src, epoch, seq):
+                with self._lock:
+                    self.counts["dup_dropped"] += 1
+                return False
+        return True
 
     def _maybe_persist(self) -> None:
         if (

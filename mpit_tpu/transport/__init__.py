@@ -29,6 +29,14 @@ from mpit_tpu.transport.base import (  # noqa: F401
     RecvTimeout,
     Transport,
 )
+from mpit_tpu.transport.chaos import (  # noqa: F401
+    ChaosConfig,
+    ChaosTransport,
+    FaultEvent,
+    FaultLog,
+    config_from_env,
+    wrap_transports,
+)
 from mpit_tpu.transport.inproc import Broker, InProcTransport  # noqa: F401
 from mpit_tpu.transport.socket_transport import (  # noqa: F401
     WIRE_PICKLE_PROTOCOL,

@@ -31,6 +31,8 @@ rank), or ``addresses=`` in the constructor; defaults to
 
 from __future__ import annotations
 
+import collections
+import errno
 import os
 import pickle
 import socket
@@ -191,6 +193,14 @@ class SocketTransport(Transport):
                 self._out[dst] = sock
         return sock
 
+    # transient connect failures retried within the window alongside a
+    # clean refusal: real-DCN startup skew surfaces as timeouts and
+    # unreachable-host/network errors while routes and peers come up,
+    # not only as ECONNREFUSED
+    _TRANSIENT_CONNECT_ERRNOS = frozenset(
+        {errno.ETIMEDOUT, errno.EHOSTUNREACH, errno.ENETUNREACH}
+    )
+
     def _connect_with_retry(self, dst: int) -> socket.socket:
         import time as _time
 
@@ -198,10 +208,18 @@ class SocketTransport(Transport):
         while True:
             try:
                 return socket.create_connection(self._addrs[dst], timeout=30)
-            except ConnectionRefusedError:
-                if _time.monotonic() >= deadline or self._closing.is_set():
+            except OSError as e:
+                transient = (
+                    isinstance(e, (ConnectionRefusedError, TimeoutError))
+                    or e.errno in self._TRANSIENT_CONNECT_ERRNOS
+                )
+                if (
+                    not transient
+                    or _time.monotonic() >= deadline
+                    or self._closing.is_set()
+                ):
                     raise
-                _time.sleep(0.1)  # peer not listening yet (startup skew)
+                _time.sleep(0.1)  # peer not reachable yet (startup skew)
 
     def _evict(self, dst: int) -> None:
         with self._out_cache_lock:
@@ -297,7 +315,11 @@ class _SendQueue:
         self._transport = transport
         self._dst = dst
         self._cond = threading.Condition()
-        self._items: list[tuple[bytes, SendHandle]] = []
+        # deque: the drainer pops from the front on every frame — a list's
+        # pop(0) is O(n) and melts under backlog (a slow peer + isend burst)
+        self._items: collections.deque[tuple[bytes, SendHandle]] = (
+            collections.deque()
+        )
         self._stopped = False
         self._thread = threading.Thread(
             target=self._drain,
@@ -320,7 +342,7 @@ class _SendQueue:
         with self._cond:
             self._stopped = True
             pending = self._items
-            self._items = []
+            self._items = collections.deque()
             self._cond.notify()
         for _frame, h in pending:
             h.set_error(ConnectionError("transport closed with send pending"))
@@ -332,7 +354,7 @@ class _SendQueue:
                     self._cond.wait()
                 if self._stopped and not self._items:
                     return
-                frame, h = self._items.pop(0)
+                frame, h = self._items.popleft()
             try:
                 self._transport._write_frame(self._dst, frame)
             except BaseException as e:

@@ -1,0 +1,38 @@
+#!/usr/bin/env bash
+# Multi-seed chaos soak: run the slow chaos suite across N seed offsets.
+#
+#   scripts/chaos_soak.sh [N_SEEDS] [MAX_SECONDS]
+#
+# Each round shifts every schedule seed by MPIT_CHAOS_SOAK_OFFSET (read by
+# nothing else — the parametrized seeds in tests/test_chaos.py stay the
+# tier-1 contract; the offset just widens the swept space here). Wall-clock
+# is bounded: the loop stops starting new rounds once MAX_SECONDS (default
+# 600) is spent, so CI can pin a budget without killing a round midway.
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+N_SEEDS="${1:-5}"
+MAX_SECONDS="${2:-600}"
+START=$SECONDS
+FAILED=0
+
+for ((i = 0; i < N_SEEDS; i++)); do
+  if ((SECONDS - START >= MAX_SECONDS)); then
+    echo "chaos_soak: budget of ${MAX_SECONDS}s spent after ${i} round(s); stopping" >&2
+    break
+  fi
+  echo "=== chaos soak round $((i + 1))/${N_SEEDS} (seed offset ${i}) ==="
+  if ! env JAX_PLATFORMS=cpu MPIT_CHAOS_SOAK_OFFSET="${i}" \
+      python -m pytest tests/test_chaos.py -q -m slow \
+      -p no:cacheprovider -p no:xdist -p no:randomly; then
+    FAILED=1
+    break
+  fi
+done
+
+if ((FAILED)); then
+  echo "chaos_soak: FAILED at seed offset ${i} — replay with:" >&2
+  echo "  MPIT_CHAOS_SOAK_OFFSET=${i} python -m pytest tests/test_chaos.py -m slow" >&2
+  exit 1
+fi
+echo "chaos_soak: OK"
