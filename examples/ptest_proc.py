@@ -39,10 +39,15 @@ def main():
     from mpit_tpu.data import load_mnist
     from mpit_tpu.data.datasets import shard_for_worker
     from mpit_tpu.models import get_model
+    from mpit_tpu.obs import wrap_from_env, write_fault_log
     from mpit_tpu.parallel import ps_roles
     from mpit_tpu.parallel.pclient import PClient
     from mpit_tpu.parallel.pserver import PServer, partition_bounds
-    from mpit_tpu.transport import SocketTransport
+    from mpit_tpu.transport import (
+        ChaosTransport,
+        SocketTransport,
+        config_from_env as chaos_config_from_env,
+    )
     from mpit_tpu.utils.params import flatten_params, unflatten_params
 
     try:
@@ -71,7 +76,24 @@ def main():
     flat0, spec = flatten_params(params0)
     flat0 = np.asarray(flat0, np.float32)
 
-    tp = SocketTransport(rank, world)
+    # chaos opt-in (docs/ROBUSTNESS.md): MPIT_CHAOS_* knobs wrap the
+    # socket in the fault injector — same contract as thread mode, but
+    # each process has its own FaultLog (faults are recorded sender-side,
+    # so the per-rank union is the whole schedule)
+    base = SocketTransport(rank, world)
+    chaos_cfg = chaos_config_from_env()
+    fault_log = None
+    if chaos_cfg is not None:
+        base = ChaosTransport(base, chaos_cfg)
+        fault_log = base.log
+    # observability opt-in (docs/OBSERVABILITY.md): with any MPIT_OBS_*
+    # knob set the transport is wrapped for tracing/telemetry — e.g.
+    # MPIT_OBS_DIR=/tmp/run writes per-rank journals that
+    # `python -m mpit_tpu.obs merge /tmp/run` turns into one Perfetto
+    # timeline. Unset, this is the identity function. Telemetry wraps
+    # OUTERMOST over chaos so its stream index stays in lockstep with
+    # the chaos schedule (the fault-overlay join key).
+    tp = wrap_from_env(base)
     server_ranks = list(range(num_servers))
     client_ranks = list(range(num_servers, world))
     bounds = partition_bounds(flat0.size, num_servers)
@@ -124,6 +146,14 @@ def main():
                 f"final loss={losses[-1]:.4f}"
             )
         client.stop()
+    obs_dir = os.environ.get("MPIT_OBS_DIR")
+    if fault_log is not None and obs_dir:
+        # per-rank fault log for the merger's --faults overlay (a
+        # directory of faults_rank*.jsonl is accepted there)
+        write_fault_log(
+            fault_log.events(),
+            os.path.join(obs_dir, f"faults_rank{rank}.jsonl"),
+        )
     tp.close()
 
 

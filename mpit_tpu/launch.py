@@ -86,6 +86,16 @@ def main(argv=None) -> int:
             + " ".join(f"{k}={os.environ[k]}" for k in chaos_env),
             file=sys.stderr,
         )
+    # same loud-once courtesy for observability: tracing adds a small
+    # per-message envelope and journal writes, so a run with it armed
+    # should say so (docs/OBSERVABILITY.md)
+    obs_env = sorted(k for k in os.environ if k.startswith("MPIT_OBS_"))
+    if obs_env:
+        print(
+            "[launch] OBS tracing/telemetry active in all ranks: "
+            + " ".join(f"{k}={os.environ[k]}" for k in obs_env),
+            file=sys.stderr,
+        )
 
     # one extra port for the jax.distributed coordinator (rank 0 binds it)
     reserving, ports = _reserve_ports(ns.n + (1 if ns.jax_distributed else 0))

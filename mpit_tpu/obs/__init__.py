@@ -1,0 +1,36 @@
+"""mpit_tpu.obs — distributed tracing + wire telemetry for the PS protocol.
+
+The third subsystem next to ``analysis`` (static/runtime correctness) and
+``transport.chaos`` (fault injection): cross-rank trace/span context
+propagated through the transport (docs/OBSERVABILITY.md), per-(peer, tag)
+wire telemetry, per-rank JSONL event journals, and a merger CLI
+(``python -m mpit_tpu.obs``) that joins them — optionally overlaying a
+chaos FaultLog — into one Perfetto timeline.
+
+Activation: ``AsyncPSTrainer(obs=ObsConfig(...))`` in code, or any
+``MPIT_OBS_*`` env knob for launcher-driven runs (no code changes).
+"""
+
+from mpit_tpu.obs.core import (  # noqa: F401
+    Journal,
+    LogicalClock,
+    NULL_SPAN,
+    ObsConfig,
+    SpanContext,
+    Tracer,
+    config_from_env,
+    span,
+    write_fault_log,
+)
+from mpit_tpu.obs.merge import (  # noqa: F401
+    merge_to_chrome_trace,
+    read_journal,
+    summarize,
+    trace_ids_by_rank,
+)
+from mpit_tpu.obs.telemetry import (  # noqa: F401
+    TelemetryTransport,
+    maybe_wrap,
+    wrap_from_env,
+    wrap_obs_transports,
+)

@@ -1,0 +1,98 @@
+"""CLI: merge per-rank obs journals into a Perfetto-loadable trace.
+
+    python -m mpit_tpu.obs merge RUN_DIR [-o trace.json] [--faults f.jsonl]
+    python -m mpit_tpu.obs summary RUN_DIR
+
+``RUN_DIR`` is the ``MPIT_OBS_DIR`` of the run (or explicit journal
+files). ``merge`` writes Chrome-trace JSON — open it at
+https://ui.perfetto.dev (or chrome://tracing). With ``--faults`` (or a
+``faults.jsonl`` sitting in the run dir) chaos faults render as instant
+events on the rank that suffered them. Exit codes: 0 ok, 2 usage/empty.
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import sys
+
+from mpit_tpu.obs.merge import (
+    expand_journal_paths,
+    merge_to_chrome_trace,
+    summarize,
+    trace_ids_by_rank,
+)
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(
+        prog="python -m mpit_tpu.obs", description=__doc__,
+        formatter_class=argparse.RawDescriptionHelpFormatter,
+    )
+    sub = p.add_subparsers(dest="cmd", required=True)
+
+    mp = sub.add_parser("merge", help="journals -> Chrome-trace JSON")
+    mp.add_argument("paths", nargs="+",
+                    help="run dir (MPIT_OBS_DIR) or journal files")
+    mp.add_argument("-o", "--out", default=None,
+                    help="output file (default: <first dir>/trace.json)")
+    mp.add_argument("--faults", default=None,
+                    help="chaos fault log JSONL (or a directory of "
+                         "faults*.jsonl, process mode) to overlay "
+                         "(default: <run dir>/faults*.jsonl when present)")
+
+    sp = sub.add_parser("summary", help="per-rank event tallies")
+    sp.add_argument("paths", nargs="+")
+
+    ns = p.parse_args(argv)
+    journals = expand_journal_paths(ns.paths)
+    if not journals:
+        print(f"no obs_rank*.jsonl journals under {ns.paths}",
+              file=sys.stderr)
+        return 2
+
+    if ns.cmd == "summary":
+        for rank, row in summarize(journals).items():
+            print(
+                f"rank {rank}: {row['events']} events "
+                f"({row['sends']} sends / {row['recvs']} recvs, "
+                f"{row['bytes']} bytes, {row['traces']} traces)"
+            )
+        return 0
+
+    first_dir = next((q for q in ns.paths if os.path.isdir(q)), None)
+    faults = ns.faults
+    if faults is None and first_dir is not None:
+        candidate = os.path.join(first_dir, "faults.jsonl")
+        if os.path.exists(candidate):
+            faults = candidate
+        elif glob.glob(os.path.join(first_dir, "faults*.jsonl")):
+            # process-mode runs write one fault log per rank; the dir
+            # form hands all of them to read_fault_log
+            faults = first_dir
+    out_path = ns.out or os.path.join(first_dir or ".", "trace.json")
+
+    trace = merge_to_chrome_trace(journals, faults_path=faults)
+    with open(out_path, "w") as f:
+        json.dump(trace, f)
+
+    by_rank = trace_ids_by_rank(journals)
+    all_traces = set().union(*by_rank.values()) if by_rank else set()
+    cross = sum(
+        1 for t in all_traces
+        if sum(1 for ids in by_rank.values() if t in ids) >= 2
+    )
+    n_faults = sum(1 for e in trace["traceEvents"] if e.get("cat") == "chaos")
+    print(
+        f"wrote {out_path}: {len(trace['traceEvents'])} events from "
+        f"{len(by_rank) or len(journals)} rank(s), {len(all_traces)} "
+        f"trace(s) ({cross} cross-rank), {n_faults} fault marker(s) — "
+        "open in https://ui.perfetto.dev"
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

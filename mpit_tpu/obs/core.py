@@ -1,0 +1,316 @@
+"""obs core — span context, logical clock, per-rank event journal.
+
+The reference debugged multi-rank training by reading interleaved per-rank
+``print``s in the mpirun console (SURVEY.md §5); this package is the
+do-better: every transport-level event (send, recv, span, fault) becomes
+one JSONL record in a per-rank journal, causally linked across ranks by a
+trace/span context that rides the wire inside a payload envelope
+(:mod:`mpit_tpu.obs.telemetry`), and ``python -m mpit_tpu.obs merge``
+joins the journals into one Chrome-trace/Perfetto timeline.
+
+Span model
+----------
+
+- ``trace_id``  one logical *exchange* across ranks (a FETCH → PARAM
+  round-trip, a push and its server-side apply). 64-bit random.
+- ``span_id``   one timed operation inside a trace (a send, a recv wait,
+  a ``span()`` region). Unique per process, also the flow-event id that
+  draws the send→recv arrow in Perfetto.
+- ``parent_id`` the enclosing span — a local ``span()`` region for sends
+  made inside it, or the *remote* send span for operations a rank performs
+  in response to a received message (the server's PARAM reply is parented
+  by the client's FETCH send, which is what stitches one trace across the
+  process boundary without the PS protocol code knowing).
+
+Clocks: journals carry wall-clock ``t`` (merging assumes NTP-level skew —
+single-host runs are exact) plus a Lamport logical clock ``clk`` that the
+envelope propagates; ``clk`` gives a causal order that survives clock skew
+and is what the merger validates cross-rank causality against.
+
+Activation mirrors chaos (:func:`mpit_tpu.transport.chaos.config_from_env`):
+obs must never arm implicitly — only recognized ``MPIT_OBS_*`` knobs count.
+
+  MPIT_OBS_DIR        path   journal directory (arms obs; one
+                             obs_rank<r>.jsonl per transport rank)
+  MPIT_OBS_TRACE      0|1    wire trace envelopes + flow linking (default 1)
+  MPIT_OBS_TELEMETRY  0|1    per-(peer, tag) counters/histograms (default 1)
+  MPIT_OBS_SAMPLE     int    journal every Nth wire event per stream
+                             (default 1 = all; counters always see all)
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+import struct
+import threading
+import time
+from typing import Any, Iterable, Mapping, Optional
+
+from mpit_tpu.analysis.runtime import make_lock
+
+# wire envelope marker (telemetry.py wraps payloads as
+# (_ENVELOPE_MARK, trace_id, span_id, clk, payload)); versioned so a
+# mixed-version world fails visibly rather than mis-parsing
+_ENVELOPE_MARK = "__mpit_obs1__"
+
+
+def _new_id() -> int:
+    """Random 63-bit id (json-safe positive int; os.urandom, not
+    ``random`` — ids must not perturb or depend on seeded streams like
+    the chaos schedule's)."""
+    return struct.unpack(">Q", os.urandom(8))[0] >> 1
+
+
+@dataclasses.dataclass(frozen=True)
+class SpanContext:
+    """What crosses the wire: enough to parent the receiver's next ops."""
+
+    trace_id: int
+    span_id: int
+
+
+class LogicalClock:
+    """Thread-safe Lamport clock: ``tick`` before local events, ``observe``
+    on message receipt (clk = max(local, remote) + 1)."""
+
+    def __init__(self):
+        self._lock = make_lock("obs.LogicalClock._lock")
+        self._value = 0
+
+    def tick(self) -> int:
+        with self._lock:
+            self._value += 1
+            return self._value
+
+    def observe(self, remote: int) -> int:
+        with self._lock:
+            self._value = max(self._value, int(remote)) + 1
+            return self._value
+
+    def peek(self) -> int:
+        with self._lock:
+            return self._value
+
+
+class Journal:
+    """Per-rank JSONL event stream, one record per line in
+    :class:`mpit_tpu.utils.metrics.MetricsLogger`'s format (``ts``/``tag``/
+    ``process``/``step`` plus event fields) so existing JSONL tooling reads
+    it unchanged. ``step`` carries the Lamport clock; ``t`` is the precise
+    wall-clock (MetricsLogger's ``ts`` is rounded to 1 ms — too coarse for
+    a µs timeline). The lock serializes concurrent writers (a client
+    thread and its heartbeat timer share one rank's journal) and ``t`` is
+    stamped inside it, so per-rank journal timestamps are monotonically
+    non-decreasing by construction — the property the merged timeline (and
+    its test) relies on."""
+
+    def __init__(self, path: str, rank: int):
+        from mpit_tpu.utils.metrics import MetricsLogger
+
+        self.path = path
+        self.rank = rank
+        self._lock = make_lock("obs.Journal._lock")
+        self._m = MetricsLogger(
+            path, tag="obs", echo=False, all_processes=True
+        )
+
+    # MetricsLogger owns these record keys; caller fields that collide
+    # (e.g. a span arg named "step") are prefixed rather than rejected
+    _RESERVED = ("step", "ts", "tag", "process", "rank", "ev", "t")
+
+    def event(self, ev: str, clk: int, **fields: Any) -> None:
+        for k in self._RESERVED:
+            if k in fields:
+                fields[f"x_{k}"] = fields.pop(k)
+        with self._lock:
+            self._m.log(clk, rank=self.rank, ev=ev, t=time.time(), **fields)
+
+    def close(self) -> None:
+        with self._lock:
+            self._m.close()
+
+
+@dataclasses.dataclass(frozen=True)
+class ObsConfig:
+    """Observability knobs; one frozen config shared by a world's wrappers
+    (the :class:`mpit_tpu.transport.chaos.ChaosConfig` idiom).
+
+    ``dir=None`` keeps counters/histograms but writes no journal (pure
+    in-memory telemetry); ``trace=False`` drops the wire envelope (no
+    cross-rank linking, zero payload growth); ``sample`` journals only
+    every Nth send/recv per (peer, tag) stream — counters still see every
+    message, so summaries stay exact while journal volume shrinks."""
+
+    dir: Optional[str] = None
+    trace: bool = True
+    telemetry: bool = True
+    sample: int = 1
+
+    def __post_init__(self):
+        if self.sample < 1:
+            raise ValueError("sample must be >= 1")
+
+
+_ENV_KNOBS = frozenset(
+    "MPIT_OBS_" + k for k in ("DIR", "TRACE", "TELEMETRY", "SAMPLE")
+)
+
+
+def config_from_env(
+    env: Mapping[str, str] = os.environ,
+) -> Optional[ObsConfig]:
+    """ObsConfig from ``MPIT_OBS_*`` knobs; None when none are set (obs
+    never arms implicitly — same contract as chaos's env activation)."""
+    if not any(k in _ENV_KNOBS for k in env):
+        return None
+    return ObsConfig(
+        dir=env.get("MPIT_OBS_DIR") or None,
+        trace=env.get("MPIT_OBS_TRACE", "1") != "0",
+        telemetry=env.get("MPIT_OBS_TELEMETRY", "1") != "0",
+        sample=int(env.get("MPIT_OBS_SAMPLE", 1)),
+    )
+
+
+class _NullSpan:
+    """The disabled fast path: one shared no-op context manager, so an
+    instrumentation site costs a getattr + an identity check when obs is
+    off (pinned by the micro-benchmark in tests/test_obs.py)."""
+
+    __slots__ = ()
+
+    def __enter__(self):
+        return None
+
+    def __exit__(self, *exc):
+        return False
+
+
+NULL_SPAN = _NullSpan()
+
+
+class _Span:
+    """An open ``span()`` region: journals B/E events and sits on the
+    tracer's thread-local stack so sends made inside it inherit its
+    trace."""
+
+    __slots__ = ("tracer", "name", "ctx", "parent_id", "args")
+
+    def __init__(self, tracer: "Tracer", name: str, args: dict):
+        self.tracer = tracer
+        self.name = name
+        self.args = args
+        self.ctx: Optional[SpanContext] = None
+        self.parent_id: Optional[int] = None
+
+    def __enter__(self) -> SpanContext:
+        t = self.tracer
+        # parent on the enclosing LOCAL span only — never on the thread's
+        # remote parent. The remote parent exists to land a reply send in
+        # the requester's trace (recv → handle → send); letting it parent
+        # explicit spans would chain every exchange round into one
+        # run-length trace via the previous round's PARAM recv.
+        stack = t._stack()
+        parent = stack[-1] if stack else None
+        trace_id = parent.trace_id if parent is not None else _new_id()
+        self.ctx = SpanContext(trace_id, _new_id())
+        self.parent_id = parent.span_id if parent is not None else None
+        t._stack().append(self.ctx)
+        if t.journal is not None:
+            t.journal.event(
+                "span_b", t.clock.tick(), name=self.name,
+                trace=self.ctx.trace_id, span=self.ctx.span_id,
+                parent=self.parent_id, **self.args,
+            )
+        return self.ctx
+
+    def __exit__(self, *exc):
+        t = self.tracer
+        stack = t._stack()
+        if stack and stack[-1] is self.ctx:
+            stack.pop()
+        if t.journal is not None:
+            t.journal.event(
+                "span_e", t.clock.tick(), name=self.name,
+                trace=self.ctx.trace_id, span=self.ctx.span_id,
+            )
+        return False
+
+
+class Tracer:
+    """Per-rank trace state: the logical clock, the journal, and the
+    thread-local context stack + remote parent.
+
+    Context resolution order for an outgoing send (``current_context``):
+
+    1. the innermost open local ``span()`` on THIS thread, else
+    2. the context of the last message THIS thread received (the remote
+       parent — how a server's reply lands in the requester's trace), else
+    3. nothing (the send starts a fresh single-span trace).
+
+    Thread-locality is what makes 2 sound: the PS server is a recv →
+    handle → reply loop on one thread, so "last received" is exactly the
+    message being answered. Concurrent client threads each carry their
+    own stack.
+    """
+
+    def __init__(self, rank: int, clock: Optional[LogicalClock] = None,
+                 journal: Optional[Journal] = None):
+        self.rank = rank
+        self.clock = clock if clock is not None else LogicalClock()
+        self.journal = journal
+        self._tls = threading.local()
+
+    def _stack(self) -> list:
+        stack = getattr(self._tls, "stack", None)
+        if stack is None:
+            stack = self._tls.stack = []
+        return stack
+
+    def current_context(self) -> Optional[SpanContext]:
+        stack = getattr(self._tls, "stack", None)
+        if stack:
+            return stack[-1]
+        return getattr(self._tls, "remote", None)
+
+    def set_remote_parent(self, ctx: Optional[SpanContext]) -> None:
+        self._tls.remote = ctx
+
+    def span(self, name: str, **args: Any) -> _Span:
+        return _Span(self, name, args)
+
+    def close(self) -> None:
+        if self.journal is not None:
+            self.journal.close()
+
+
+def span(transport, name: str, **args: Any):
+    """Instrumentation hook for protocol code: a ``span()`` on the
+    transport's tracer when the transport is obs-wrapped, the shared
+    no-op otherwise. This getattr-and-check IS the guarded fast path —
+    safe to leave in hot protocol loops unconditionally."""
+    tracer = getattr(transport, "obs_tracer", None)
+    if tracer is None:
+        return NULL_SPAN
+    return tracer.span(name, **args)
+
+
+def write_fault_log(events: Iterable, path: str) -> int:
+    """Persist a chaos :class:`~mpit_tpu.transport.chaos.FaultLog`'s
+    events as JSONL for the merger (``--faults``). FaultEvents carry no
+    timestamp by design (they must compare equal across replays); the
+    merger recovers timeline placement by joining ``(src, dst, tag, n)``
+    against the telemetry send events. Returns the event count."""
+    import json
+
+    n = 0
+    parent = os.path.dirname(os.path.abspath(path))
+    os.makedirs(parent, exist_ok=True)
+    with open(path, "w") as f:
+        for e in events:
+            f.write(json.dumps({
+                "ev": "fault", "kind": e.kind, "src": e.src,
+                "dst": e.dst, "tag": e.tag, "n": e.n,
+            }) + "\n")
+            n += 1
+    return n

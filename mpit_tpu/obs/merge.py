@@ -1,0 +1,264 @@
+"""Merge per-rank obs journals into one Chrome-trace/Perfetto timeline.
+
+Input: the ``obs_rank<r>.jsonl`` journals that
+:class:`~mpit_tpu.obs.telemetry.TelemetryTransport` writes, optionally plus
+a chaos fault log persisted by :func:`mpit_tpu.obs.core.write_fault_log`.
+Output: the Chrome Trace Event JSON object format (``{"traceEvents":
+[...]}``), which https://ui.perfetto.dev opens directly.
+
+Rendering:
+
+- each transport rank is one Perfetto *process* track (``pid`` = rank);
+- ``send``/``isend`` and ``recv`` become complete (``ph: "X"``) slices —
+  a send's duration is its time in the transport call, a recv's slice
+  spans the receiver's blocked wait;
+- every traced send emits a *flow* (``ph: "s"`` → ``ph: "f"``, id = the
+  send's span id) that Perfetto draws as an arrow from the send slice to
+  the matching recv slice on the destination rank — the cross-rank trace
+  made visible;
+- ``span_b``/``span_e`` regions (the trainer's per-exchange spans) become
+  nested B/E slices on their rank's track;
+- chaos faults become instant events (``ph: "i"``) on the track of the
+  rank that suffered them (the sending rank — every injected fault is
+  sender-side, docs/ROBUSTNESS.md). FaultEvents deliberately carry no
+  timestamp (replay-comparability), so placement joins the fault's
+  ``(src, dst, tag, n)`` stream coordinates against the telemetry send
+  events, whose stream index is in lockstep with the chaos schedule's.
+
+This module reads only files — it must import neither jax nor the
+transport stack, so the CLI stays fast and safe to run anywhere.
+"""
+
+from __future__ import annotations
+
+import glob
+import json
+import os
+from typing import Iterable, Optional
+
+# protocol tag names for display; source of truth is
+# mpit_tpu/parallel/pserver.py (kept literal here so the merger imports
+# nothing heavier than the standard library)
+TAG_NAMES = {
+    1: "FETCH",
+    2: "PUSH_EASGD",
+    3: "PUSH_DELTA",
+    4: "PARAM",
+    5: "STOP",
+    6: "HEARTBEAT",
+}
+
+
+def _tag_name(tag) -> str:
+    return TAG_NAMES.get(tag, str(tag))
+
+
+def read_journal(path: str) -> list[dict]:
+    """Records of one JSONL journal (malformed lines are skipped — a
+    journal truncated by a killed rank must not sink the whole merge)."""
+    out = []
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                out.append(json.loads(line))
+            except json.JSONDecodeError:
+                continue
+    return out
+
+
+def expand_journal_paths(paths: Iterable[str]) -> list[str]:
+    """Each path may be a journal file or a directory of
+    ``obs_rank*.jsonl``; returns the flat sorted file list."""
+    out: list[str] = []
+    for p in paths:
+        if os.path.isdir(p):
+            out.extend(sorted(glob.glob(os.path.join(p, "obs_rank*.jsonl"))))
+        else:
+            out.append(p)
+    return out
+
+
+def read_fault_log(path: str) -> list[dict]:
+    """Fault records from one JSONL file, or from every ``faults*.jsonl``
+    in a directory (process-mode runs write one fault log per rank —
+    faults are recorded sender-side, so the per-rank union is the whole
+    schedule)."""
+    if os.path.isdir(path):
+        paths = sorted(glob.glob(os.path.join(path, "faults*.jsonl")))
+    else:
+        paths = [path]
+    return [
+        r for p in paths for r in read_journal(p) if r.get("ev") == "fault"
+    ]
+
+
+def _rec_rank(rec: dict):
+    return rec.get("rank", rec.get("process", 0))
+
+
+def _rec_time(rec: dict) -> Optional[float]:
+    # precise "t" preferred; "ts" (1 ms resolution) is the fallback for
+    # hand-written or foreign MetricsLogger streams
+    return rec.get("t", rec.get("ts"))
+
+
+def merge_to_chrome_trace(
+    journal_paths: Iterable[str],
+    faults_path: Optional[str] = None,
+) -> dict:
+    """Chrome-trace JSON object from per-rank journals (+ optional chaos
+    fault log). Wall-clock timestamps are rebased to the earliest event;
+    events within a rank keep journal order (monotonic per rank by the
+    Journal's construction)."""
+    journal_paths = expand_journal_paths(journal_paths)
+    per_rank: dict[int, list[dict]] = {}
+    for path in journal_paths:
+        for rec in read_journal(path):
+            if _rec_time(rec) is None or "ev" not in rec:
+                continue
+            per_rank.setdefault(_rec_rank(rec), []).append(rec)
+
+    t0 = min(
+        (_rec_time(r) for recs in per_rank.values() for r in recs),
+        default=0.0,
+    )
+
+    def us(t: float) -> float:
+        return round((t - t0) * 1e6, 1)
+
+    events: list[dict] = []
+    # (src_rank, dst, tag, n) -> send timestamp in µs, the fault join key
+    send_index: dict[tuple, float] = {}
+
+    for rank in sorted(per_rank):
+        events.append({
+            "ph": "M", "name": "process_name", "pid": rank, "tid": 0,
+            "args": {"name": f"rank {rank}"},
+        })
+        for rec in per_rank[rank]:
+            t = _rec_time(rec)
+            ev = rec["ev"]
+            if ev in ("send", "isend"):
+                ts = us(t)
+                dur = max(rec.get("dur", 0.0) * 1e6, 1.0)
+                args = {
+                    k: rec[k]
+                    for k in ("dst", "n", "bytes", "qdepth", "err", "trace")
+                    if k in rec
+                }
+                args["clk"] = rec.get("step")
+                name = f"{ev} {_tag_name(rec.get('mtag'))}"
+                events.append({
+                    "ph": "X", "name": name, "cat": "wire",
+                    "pid": rank, "tid": 0, "ts": ts, "dur": dur,
+                    "args": args,
+                })
+                if "span" in rec:
+                    events.append({
+                        "ph": "s", "id": f"{rec['span']:x}", "name": "msg",
+                        "cat": "flow", "pid": rank, "tid": 0, "ts": ts,
+                    })
+                key = (rank, rec.get("dst"), rec.get("mtag"), rec.get("n"))
+                send_index.setdefault(key, ts)
+            elif ev == "recv":
+                wait = rec.get("wait", 0.0)
+                end = us(t)
+                ts = max(us(t - wait), 0.0)
+                args = {
+                    k: rec[k]
+                    for k in ("src", "n", "bytes", "trace")
+                    if k in rec
+                }
+                args["clk"] = rec.get("step")
+                events.append({
+                    "ph": "X",
+                    "name": f"recv {_tag_name(rec.get('mtag'))}",
+                    "cat": "wire", "pid": rank, "tid": 0, "ts": ts,
+                    "dur": max(end - ts, 1.0), "args": args,
+                })
+                if "from_span" in rec:
+                    # bind to the enclosing recv slice: arrow head lands
+                    # where the wait ended
+                    events.append({
+                        "ph": "f", "bp": "e", "id": f"{rec['from_span']:x}",
+                        "name": "msg", "cat": "flow", "pid": rank,
+                        "tid": 0, "ts": end,
+                    })
+            elif ev == "span_b":
+                events.append({
+                    "ph": "B", "name": str(rec.get("name", "span")),
+                    "cat": "span", "pid": rank, "tid": 0, "ts": us(t),
+                    "args": {
+                        k: rec[k]
+                        for k in ("trace", "span", "parent", "step")
+                        if k in rec
+                    },
+                })
+            elif ev == "span_e":
+                events.append({
+                    "ph": "E", "name": str(rec.get("name", "span")),
+                    "cat": "span", "pid": rank, "tid": 0, "ts": us(t),
+                })
+
+    if faults_path is not None:
+        for fault in read_fault_log(faults_path):
+            key = (fault["src"], fault["dst"], fault["tag"], fault["n"])
+            ts = send_index.get(key)
+            args = {
+                "dst": fault["dst"],
+                "mtag": _tag_name(fault["tag"]),
+                "n": fault["n"],
+            }
+            if ts is None:
+                # no matching telemetry send (sampled out, or the journal
+                # died first): pin at the timeline origin, visibly marked
+                ts = 0.0
+                args["unplaced"] = True
+            events.append({
+                "ph": "i", "s": "p", "name": f"fault {fault['kind']}",
+                "cat": "chaos", "pid": fault["src"], "tid": 0, "ts": ts,
+                "args": args,
+            })
+
+    events.sort(key=lambda e: (e.get("ts", 0.0), e["pid"]))
+    return {"traceEvents": events, "displayTimeUnit": "ms"}
+
+
+def trace_ids_by_rank(journal_paths: Iterable[str]) -> dict[int, set]:
+    """trace-id sets per rank — the cross-rank assertion helper (a trace
+    spanning client and server appears in >= 2 ranks' sets)."""
+    out: dict[int, set] = {}
+    for path in expand_journal_paths(journal_paths):
+        for rec in read_journal(path):
+            if "trace" in rec:
+                out.setdefault(_rec_rank(rec), set()).add(rec["trace"])
+    return out
+
+
+def summarize(journal_paths: Iterable[str]) -> dict:
+    """Per-rank event/byte tallies for the ``summary`` subcommand."""
+    out: dict[int, dict] = {}
+    for path in expand_journal_paths(journal_paths):
+        for rec in read_journal(path):
+            if "ev" not in rec:
+                continue
+            r = out.setdefault(
+                _rec_rank(rec),
+                {"events": 0, "sends": 0, "recvs": 0, "bytes": 0,
+                 "traces": set()},
+            )
+            r["events"] += 1
+            if rec["ev"] in ("send", "isend"):
+                r["sends"] += 1
+                r["bytes"] += rec.get("bytes", 0)
+            elif rec["ev"] == "recv":
+                r["recvs"] += 1
+            if "trace" in rec:
+                r["traces"].add(rec["trace"])
+    return {
+        rank: {**v, "traces": len(v["traces"])}
+        for rank, v in sorted(out.items())
+    }

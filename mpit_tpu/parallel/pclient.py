@@ -20,6 +20,10 @@ Fault tolerance (docs/ROBUSTNESS.md; the reference would simply hang):
   connection reset (and duplicated frames) can never double-apply.
 - transient send failures (``ConnectionError``/``OSError``) are retried
   with the same backoff schedule before surfacing to the caller.
+- a PARAM reply mangled on the wire (chaos ``corrupt``/``truncate``) is
+  validated against the expected partition length and discarded
+  (``corrupt_params_dropped``); the attempt's timeout then re-issues the
+  FETCH — corruption degrades to the already-handled lost-reply case.
 """
 
 from __future__ import annotations
@@ -99,6 +103,7 @@ class PClient:
         self._push_seq = itertools.count(1)
         self.push_sent: dict[int, int] = {r: 0 for r in self.server_ranks}
         self.stale_params_dropped = 0
+        self.corrupt_params_dropped = 0
         self._hb_stop = threading.Event()
         self._hb_thread: Optional[threading.Thread] = None
         if heartbeat_interval is not None:
@@ -147,12 +152,30 @@ class PClient:
         self.transport.send(rank, TAG_FETCH, attempt_id)
         return attempt_id
 
-    def _await_param(self, rank: int, attempt_id: Optional[int]) -> np.ndarray:
+    def _chunk_ok(self, chunk, expected: int) -> Optional[np.ndarray]:
+        """float32 view of a PARAM chunk, or None when the reply is
+        malformed (chaos ``corrupt`` replaced the frame, ``truncate`` cut
+        the array short, or the shape just doesn't match this server's
+        partition)."""
+        try:
+            arr = np.asarray(chunk, dtype=np.float32)
+        except (TypeError, ValueError):
+            return None
+        if arr.shape != (expected,):
+            return None
+        return arr
+
+    def _await_param(
+        self, rank: int, attempt_id: Optional[int], expected: int
+    ) -> np.ndarray:
         """Collect one server's PARAM chunk, retrying the whole
         FETCH→PARAM attempt on timeout or send failure. Replies tagged
         with an attempt id other than the live one are stale — consumed
         and discarded so they can never be assembled into this (or a
-        later) fetch."""
+        later) fetch. Malformed replies (chaos corrupt/truncate) are
+        likewise discarded — the wait continues and the per-attempt
+        timeout re-issues the FETCH, so a mangled reply is a retriable
+        failure, never a crash or a junk-assembled vector."""
         last_exc: Optional[BaseException] = None
         for attempt in range(self.max_retries + 1):
             if attempt > 0:
@@ -191,8 +214,18 @@ class PClient:
                     if got_id != attempt_id:
                         self.stale_params_dropped += 1
                         continue  # a timed-out attempt's late reply
-                    return chunk
-                return payload  # legacy un-id'd reply
+                    arr = self._chunk_ok(chunk, expected)
+                    if arr is None:
+                        # mangled on the wire: keep waiting; the timeout
+                        # re-fetches (the server won't resend on its own)
+                        self.corrupt_params_dropped += 1
+                        continue
+                    return arr
+                arr = self._chunk_ok(payload, expected)  # legacy un-id'd
+                if arr is None:
+                    self.corrupt_params_dropped += 1
+                    continue
+                return arr
             attempt_id = None  # attempt dead: the next one re-sends
         raise RecvTimeout(
             f"fetch from server {rank} failed after "
@@ -215,7 +248,9 @@ class PClient:
                 attempts[rank] = None  # the retry path re-sends
         out = np.empty(self.param_size, np.float32)
         for rank, (start, end) in zip(self.server_ranks, self.bounds):
-            out[start:end] = self._await_param(rank, attempts[rank])
+            out[start:end] = self._await_param(
+                rank, attempts[rank], end - start
+            )
         return out
 
     def push_easgd(self, flat_params: np.ndarray) -> None:

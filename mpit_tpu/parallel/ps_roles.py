@@ -22,6 +22,7 @@ import jax
 import numpy as np
 import optax
 
+from mpit_tpu.obs.core import span as obs_span
 from mpit_tpu.parallel import common
 from mpit_tpu.parallel.pclient import PClient
 from mpit_tpu.transport import RecvTimeout
@@ -94,7 +95,11 @@ def client_train_loop(
     from mpit_tpu.utils.params import flatten_params
 
     rng = np.random.default_rng(seed)
-    params = unflatten_params(spec, jnp.asarray(client.fetch()))
+    # obs_span is the no-op NULL_SPAN unless the transport is obs-wrapped
+    # (docs/OBSERVABILITY.md) — each span groups one exchange's wire
+    # traffic under a single trace on the merged timeline
+    with obs_span(client.transport, "initial_fetch"):
+        params = unflatten_params(spec, jnp.asarray(client.fetch()))
     opt_state = optimizer.init(params)
     last_pull = np.asarray(flatten_params(params)[0])
     losses: list[float] = []
@@ -115,45 +120,50 @@ def client_train_loop(
         if (step + 1) % tau == 0:
             flush()
             flat = np.asarray(flatten_params(params)[0])
-            try:
-                if algo == "easgd":
-                    # fetch BEFORE push so the client's elastic move uses the
-                    # pre-push center — the paper's update order (both moves on
-                    # the old center), and the same order goptim.easgd_round
-                    # implements for the collective path. Push-then-fetch would
-                    # couple against a center already moved by this client's own
-                    # push (an alpha*(1-alpha) effective move).
-                    center = client.fetch()
-                    client.push_easgd(flat)
-                    flat = flat - alpha * (flat - center)
-                else:
-                    client.push_delta(flat - last_pull)
-                    # the pushed delta now belongs to the server: a fetch
-                    # failure below must not get it re-pushed next round
-                    last_pull = flat
-                    flat = client.fetch()
-                    last_pull = flat
-            except (RecvTimeout, ConnectionError, OSError) as e:
-                total_failures += 1
-                consecutive_failures += 1
-                if max_exchange_failures is None:
-                    raise  # fail-fast semantics (degradation not enabled)
-                if consecutive_failures >= max_exchange_failures:
-                    raise RuntimeError(
-                        f"PS exchange failed {consecutive_failures} rounds "
-                        "in a row — escalating instead of training further "
-                        "against an unreachable center"
-                    ) from e
-                skipped_rounds += 1
-                logger.warning(
-                    "PS exchange failed (%r); skipping round on the stale "
-                    "center (%d consecutive failure(s))",
-                    e,
-                    consecutive_failures,
-                )
-                continue  # params stay local this round
-            consecutive_failures = 0
-            params = unflatten_params(spec, jnp.asarray(flat))
+            with obs_span(
+                client.transport, "exchange",
+                round=(step + 1) // tau, algo=algo,
+            ):
+                try:
+                    if algo == "easgd":
+                        # fetch BEFORE push so the client's elastic move uses
+                        # the pre-push center — the paper's update order (both
+                        # moves on the old center), and the same order
+                        # goptim.easgd_round implements for the collective
+                        # path. Push-then-fetch would couple against a center
+                        # already moved by this client's own push (an
+                        # alpha*(1-alpha) effective move).
+                        center = client.fetch()
+                        client.push_easgd(flat)
+                        flat = flat - alpha * (flat - center)
+                    else:
+                        client.push_delta(flat - last_pull)
+                        # the pushed delta now belongs to the server: a fetch
+                        # failure below must not get it re-pushed next round
+                        last_pull = flat
+                        flat = client.fetch()
+                        last_pull = flat
+                except (RecvTimeout, ConnectionError, OSError) as e:
+                    total_failures += 1
+                    consecutive_failures += 1
+                    if max_exchange_failures is None:
+                        raise  # fail-fast semantics (degradation not enabled)
+                    if consecutive_failures >= max_exchange_failures:
+                        raise RuntimeError(
+                            f"PS exchange failed {consecutive_failures} "
+                            "rounds in a row — escalating instead of "
+                            "training further against an unreachable center"
+                        ) from e
+                    skipped_rounds += 1
+                    logger.warning(
+                        "PS exchange failed (%r); skipping round on the "
+                        "stale center (%d consecutive failure(s))",
+                        e,
+                        consecutive_failures,
+                    )
+                    continue  # params stay local this round
+                consecutive_failures = 0
+                params = unflatten_params(spec, jnp.asarray(flat))
     flush()  # steps % tau remainder
     if exchange_stats is not None:
         exchange_stats["skipped_rounds"] = skipped_rounds

@@ -22,6 +22,9 @@ import numpy as np
 import optax
 
 from mpit_tpu.data.datasets import shard_for_worker
+from mpit_tpu.obs.core import ObsConfig, write_fault_log
+from mpit_tpu.obs.core import config_from_env as obs_config_from_env
+from mpit_tpu.obs.telemetry import wrap_obs_transports
 from mpit_tpu.parallel import common, ps_roles
 from mpit_tpu.parallel.pclient import PClient
 from mpit_tpu.parallel.pserver import PServer, partition_bounds, spawn_server_thread
@@ -69,6 +72,16 @@ class AsyncPSTrainer:
         wrapped in a :class:`ChaosTransport` sharing one fault log
         (``stats["chaos_faults"]``); the run must then survive on the
         retry/dedup/degradation machinery below.
+      obs: observability config (docs/OBSERVABILITY.md). When set — or
+        when any ``MPIT_OBS_*`` env knob is — every transport is wrapped
+        in a :class:`~mpit_tpu.obs.telemetry.TelemetryTransport`
+        OUTERMOST (over chaos, so telemetry stream indices stay in
+        lockstep with the fault schedule's): per-(peer, tag) wire
+        counters land in ``stats["telemetry"]``, per-rank journals under
+        ``obs.dir`` feed ``python -m mpit_tpu.obs merge``, and when
+        chaos is also active the fault log is persisted next to them as
+        ``faults.jsonl`` for the timeline overlay. Unset, no wrapper
+        exists at all — the measured-zero-overhead contract.
       max_exchange_failures: graceful degradation — a client's failed
         exchange (after PClient's own retries) skips the round on the
         stale center; this many CONSECUTIVE failures escalate to an
@@ -96,6 +109,7 @@ class AsyncPSTrainer:
         ckpt_every: Optional[int] = 100,
         resume: bool = True,
         chaos: Optional[ChaosConfig] = None,
+        obs: Optional[ObsConfig] = None,
         max_exchange_failures: Optional[int] = 3,
         fetch_timeout: float = 60.0,
         fetch_retries: int = 3,
@@ -141,6 +155,7 @@ class AsyncPSTrainer:
         if fetch_retries < 0:
             raise ValueError("fetch_retries must be >= 0")
         self.chaos = chaos
+        self.obs = obs
         self.max_exchange_failures = max_exchange_failures
         self.fetch_timeout = float(fetch_timeout)
         self.fetch_retries = int(fetch_retries)
@@ -192,6 +207,15 @@ class AsyncPSTrainer:
         self.fault_log = None
         if chaos_cfg is not None:
             transports, self.fault_log = wrap_transports(transports, chaos_cfg)
+        # observability wraps OUTERMOST over chaos: counters see every
+        # attempted send (faults included), latency includes injected
+        # delay, and the per-(dst, tag) stream index stays in lockstep
+        # with the chaos schedule's — the merger's fault-placement key
+        obs_cfg = self.obs if self.obs is not None else obs_config_from_env()
+        obs_transports: list = []
+        if obs_cfg is not None:
+            transports = wrap_obs_transports(transports, obs_cfg)
+            obs_transports = transports
         server_ranks = list(range(self.num_servers))
         client_ranks = list(
             range(self.num_servers, self.num_servers + self.num_clients)
@@ -326,6 +350,19 @@ class AsyncPSTrainer:
         }
         if self.fault_log is not None:
             stats["chaos_faults"] = self.fault_log.counts()
+        if obs_transports:
+            stats["telemetry"] = [t.summary() for t in obs_transports]
+            if obs_cfg.dir is not None and self.fault_log is not None:
+                import os
+
+                write_fault_log(
+                    self.fault_log.events(),
+                    os.path.join(obs_cfg.dir, "faults.jsonl"),
+                )
+            for t in obs_transports:
+                # flush/close journals now — the broker dies with this
+                # call, and a merge may run immediately after train()
+                t.obs_tracer.close()
         return center_params, stats
 
     def evaluate(self, params, x, y, batch: int = 512) -> float:

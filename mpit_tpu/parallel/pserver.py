@@ -32,7 +32,11 @@ duplicated/retransmitted push applies **exactly once** (rejects counted
 in ``counts["dup_dropped"]``); a *replacement* client on a reused rank
 has a fresh epoch, so its restarted seq stream is not mistaken for
 replays of its predecessor's. Bare payloads (no envelope) keep the
-legacy apply-always semantics for hand-rolled protocol tests.
+legacy apply-always semantics for hand-rolled protocol tests. A frame
+mangled on the wire (chaos ``corrupt``/``truncate`` — a
+``CorruptedPayload`` marker or a wrong-shape chunk) is dropped whole and
+counted in ``counts["malformed_dropped"]``; it never consumes a dedup
+slot and never reaches the apply path.
 
 Failure detection (a do-better over the reference — SURVEY.md §5: 'a dead
 rank hangs the job'): with ``client_timeout`` set, the server runs a
@@ -53,7 +57,13 @@ from typing import Optional, Sequence
 import numpy as np
 
 from mpit_tpu.analysis.runtime import make_lock
-from mpit_tpu.transport import ANY_SOURCE, ANY_TAG, RecvTimeout, Transport
+from mpit_tpu.transport import (
+    ANY_SOURCE,
+    ANY_TAG,
+    CorruptedPayload,
+    RecvTimeout,
+    Transport,
+)
 
 # mpit-analysis: protocol-role[server->client]
 # (this module IS the server side of the PS wire protocol; the MPT008
@@ -164,7 +174,8 @@ class PServer:
                 )
         self.client_timeout = client_timeout
         self.counts = {"fetch": 0, "push_easgd": 0, "push_delta": 0,
-                       "heartbeat": 0, "dup_dropped": 0}
+                       "heartbeat": 0, "dup_dropped": 0,
+                       "malformed_dropped": 0}
         self._dedup = _DedupWindow(dedup_window)
         self.dead_clients: set[int] = set()
         self._stopped: set[int] = set()
@@ -219,6 +230,17 @@ class PServer:
                 last_seen[msg.src] = time.monotonic()
                 # a late message from a declared-dead client revives it
                 self.dead_clients.discard(msg.src)
+            if isinstance(msg.payload, CorruptedPayload):
+                # an unparseable frame: in a real stack the tag itself
+                # would be unreadable, so no dispatch — drop it (counted)
+                # and let the sender's retry/timeout absorb the loss. It
+                # still refreshed liveness above: garbage is a sign of
+                # life.
+                with self._lock:
+                    self.counts["malformed_dropped"] += 1
+                if watchdog:
+                    self._expire(last_seen)
+                continue
             if msg.tag == TAG_FETCH:
                 with self._lock:
                     snapshot = self.center.copy()
@@ -259,13 +281,17 @@ class PServer:
         self.persist()  # clean teardown: the final center is never lost
 
     def _admit_push(self, msg) -> bool:
-        """Unwrap a push envelope and run the exactly-once check.
+        """Unwrap a push envelope, validate the chunk, and run the
+        exactly-once check.
 
         ``(epoch, seq, chunk)`` envelopes are deduplicated per (src,
-        epoch); the chunk is rebound onto ``msg.payload`` so the apply
-        path below handles both envelope and legacy bare-chunk pushes
-        identically. Returns False for a replay (counted, not applied).
-        """
+        epoch); the validated chunk is rebound onto ``msg.payload`` so
+        the apply path below handles both envelope and legacy bare-chunk
+        pushes identically. Returns False for a replay or a malformed
+        chunk (both counted, never applied). Validation runs BEFORE the
+        dedup admit: a chaos-truncated frame must not consume its
+        (epoch, seq) slot — a clean retransmit of the same push should
+        still be able to land."""
         payload = msg.payload
         if (
             isinstance(payload, tuple)
@@ -274,12 +300,38 @@ class PServer:
             and isinstance(payload[1], int)
         ):
             epoch, seq, chunk = payload
-            msg.payload = chunk
+            arr = self._validate_chunk(chunk)
+            if arr is None:
+                with self._lock:
+                    self.counts["malformed_dropped"] += 1
+                return False
+            msg.payload = arr
             if not self._dedup.admit(msg.src, epoch, seq):
                 with self._lock:
                     self.counts["dup_dropped"] += 1
                 return False
+            return True
+        arr = self._validate_chunk(payload)
+        if arr is None:
+            with self._lock:
+                self.counts["malformed_dropped"] += 1
+            return False
+        msg.payload = arr
         return True
+
+    def _validate_chunk(self, chunk) -> Optional[np.ndarray]:
+        """float32 view/copy of an update chunk, or None when the frame
+        is malformed (chaos ``corrupt``/``truncate``, or just the wrong
+        shape for this server's partition) — the safe side of
+        at-most-once: an unparseable update is dropped whole, never
+        partially or wrongly applied."""
+        try:
+            arr = np.asarray(chunk, dtype=np.float32)
+        except (TypeError, ValueError):
+            return None
+        if arr.shape != self.center.shape:
+            return None
+        return arr
 
     def _maybe_persist(self) -> None:
         if (

@@ -32,6 +32,7 @@ from mpit_tpu.transport.base import (  # noqa: F401
 from mpit_tpu.transport.chaos import (  # noqa: F401
     ChaosConfig,
     ChaosTransport,
+    CorruptedPayload,
     FaultEvent,
     FaultLog,
     config_from_env,

@@ -23,6 +23,14 @@ untouched, so per-(src, tag) FIFO of *delivered* messages is preserved):
 - ``jitter``     constant extra latency on every send from a slow rank
 - ``kill_after`` rank goes silent after its N-th sent message (a dead
                  host doesn't fail cleanly; it just stops talking)
+- ``corrupt``    the frame arrives but its payload is garbage — delivered
+                 as a :class:`CorruptedPayload` marker (bit-rot / bad
+                 deserialization). Receivers must drop it and let the
+                 sender's retry/timeout machinery absorb the loss.
+- ``truncate``   the frame is cut mid-stream: every array in the payload
+                 arrives at half length (envelope scalars survive — a
+                 length-prefixed read that stopped early). Payloads with
+                 nothing array-like to cut degrade to ``CorruptedPayload``.
 
 Determinism scope: per-stream decisions are always seed-determined. The
 *total order* of the fault log is deterministic whenever each (dst, tag)
@@ -45,9 +53,12 @@ Env knobs (read by :func:`config_from_env`; any set knob activates chaos):
   MPIT_CHAOS_SLOW_RANKS    csv     ranks the jitter applies to
   MPIT_CHAOS_KILL_RANK     int     rank to kill
   MPIT_CHAOS_KILL_AFTER    int     ...after this many sent messages
+  MPIT_CHAOS_CORRUPT       float   P(payload corruption)     (default 0)
+  MPIT_CHAOS_TRUNCATE      float   P(frame truncation)       (default 0)
   MPIT_CHAOS_TAGS          csv     restrict faults to these tags (all)
   MPIT_CHAOS_<K>_TAGS      csv     narrow one kind further; K in DROP,
-                                   DUP, DELAY, RESET, BLACKHOLE
+                                   DUP, DELAY, RESET, BLACKHOLE,
+                                   CORRUPT, TRUNCATE
 """
 
 from __future__ import annotations
@@ -58,6 +69,8 @@ import random
 import threading
 import time
 from typing import Any, Iterable, Mapping, Optional, Sequence
+
+import numpy as np
 
 from mpit_tpu.transport.base import Transport
 
@@ -85,6 +98,43 @@ class FaultEvent:
     dst: int
     tag: int
     n: int
+
+
+@dataclasses.dataclass(frozen=True)
+class CorruptedPayload:
+    """What a ``corrupt`` fault delivers in place of the real payload (and
+    what ``truncate`` degrades to when the payload has nothing array-like
+    to cut): the frame-layer model of an unparseable frame. Receivers must
+    treat it like garbage off the wire — drop the message and let the
+    sender's retry/timeout path absorb the loss (docs/ROBUSTNESS.md);
+    ``np.asarray`` on it raises, so an unhardened apply path fails loudly
+    rather than silently training on junk. Carries its stream coordinates
+    for debuggability only — protocol code must not dispatch on them."""
+
+    src: int = -1
+    dst: int = -1
+    tag: int = -1
+    n: int = -1
+
+
+def _truncate_payload(payload: Any) -> Optional[Any]:
+    """Payload with every ndarray cut to half length (a length-prefixed
+    frame whose stream ended early: envelope scalars — epoch/seq/trace
+    ids — decoded before the cut survive, the bulk array data did not).
+    Returns None when nothing was truncatable (caller degrades to
+    :class:`CorruptedPayload` — a cut tiny frame is just unparseable)."""
+    if isinstance(payload, np.ndarray):
+        if payload.ndim >= 1 and payload.shape[0] > 1:
+            return payload[: payload.shape[0] // 2]
+        return None
+    if isinstance(payload, tuple):
+        out, cut = [], False
+        for item in payload:
+            t = _truncate_payload(item)
+            out.append(item if t is None else t)
+            cut = cut or t is not None
+        return tuple(out) if cut else None
+    return None
 
 
 class FaultLog:
@@ -130,7 +180,8 @@ class ChaosConfig:
 
     ``scripted`` pins exact faults for regression tests: a mapping from
     ``(src, dst, tag, n)`` to a fault kind (``"drop" | "duplicate" |
-    "reset"``) applied to exactly that message, ahead of any probability
+    "reset" | "corrupt" | "truncate"``) applied to exactly that message,
+    ahead of any probability
     draw. ``tags``/``edges`` restrict the *probabilistic* faults (scripted
     entries already name their target precisely); the per-fault
     ``<kind>_tags`` fields narrow one fault kind further (None = inherit
@@ -148,31 +199,39 @@ class ChaosConfig:
     jitter_s: float = 0.0
     slow_ranks: tuple[int, ...] = ()
     kill_after: Mapping[int, int] = dataclasses.field(default_factory=dict)
+    corrupt: float = 0.0
+    truncate: float = 0.0
     tags: Optional[tuple[int, ...]] = None
     drop_tags: Optional[tuple[int, ...]] = None
     duplicate_tags: Optional[tuple[int, ...]] = None
     delay_tags: Optional[tuple[int, ...]] = None
     reset_tags: Optional[tuple[int, ...]] = None
     blackhole_tags: Optional[tuple[int, ...]] = None
+    corrupt_tags: Optional[tuple[int, ...]] = None
+    truncate_tags: Optional[tuple[int, ...]] = None
     edges: Optional[tuple[tuple[int, int], ...]] = None
     scripted: Mapping[tuple[int, int, int, int], str] = dataclasses.field(
         default_factory=dict
     )
 
+    _KINDS = ("drop", "duplicate", "delay", "reset", "blackhole",
+              "corrupt", "truncate")
+
     def __post_init__(self):
-        for name in ("drop", "duplicate", "delay", "reset", "blackhole"):
+        for name in self._KINDS:
             p = getattr(self, name)
             if not 0.0 <= p <= 1.0:
                 raise ValueError(f"{name} must be a probability, got {p}")
         if self.blackhole_len < 1:
             raise ValueError("blackhole_len must be >= 1")
         for key, kind in self.scripted.items():
-            if kind not in ("drop", "duplicate", "reset"):
+            if kind not in ("drop", "duplicate", "reset", "corrupt",
+                            "truncate"):
                 raise ValueError(
                     f"scripted[{key}]: unknown fault kind {kind!r}"
                 )
         if self.tags is not None:
-            for name in ("drop", "duplicate", "delay", "reset", "blackhole"):
+            for name in self._KINDS:
                 per = getattr(self, f"{name}_tags")
                 if per is not None and not set(per) <= set(self.tags):
                     raise ValueError(
@@ -203,8 +262,9 @@ _ENV_KNOBS = frozenset(
     for k in (
         "SEED", "DROP", "DUP", "DELAY", "DELAY_S", "RESET", "BLACKHOLE",
         "BLACKHOLE_LEN", "JITTER_S", "SLOW_RANKS", "KILL_RANK",
-        "KILL_AFTER", "TAGS", "DROP_TAGS", "DUP_TAGS", "DELAY_TAGS",
-        "RESET_TAGS", "BLACKHOLE_TAGS",
+        "KILL_AFTER", "CORRUPT", "TRUNCATE", "TAGS", "DROP_TAGS",
+        "DUP_TAGS", "DELAY_TAGS", "RESET_TAGS", "BLACKHOLE_TAGS",
+        "CORRUPT_TAGS", "TRUNCATE_TAGS",
     )
 )
 
@@ -243,12 +303,16 @@ def config_from_env(env: Mapping[str, str] = os.environ) -> Optional[ChaosConfig
         jitter_s=_f("MPIT_CHAOS_JITTER_S", 0.0),
         slow_ranks=_csv_ints("MPIT_CHAOS_SLOW_RANKS") or (),
         kill_after=kill_after,
+        corrupt=_f("MPIT_CHAOS_CORRUPT", 0.0),
+        truncate=_f("MPIT_CHAOS_TRUNCATE", 0.0),
         tags=_csv_ints("MPIT_CHAOS_TAGS"),
         drop_tags=_csv_ints("MPIT_CHAOS_DROP_TAGS"),
         duplicate_tags=_csv_ints("MPIT_CHAOS_DUP_TAGS"),
         delay_tags=_csv_ints("MPIT_CHAOS_DELAY_TAGS"),
         reset_tags=_csv_ints("MPIT_CHAOS_RESET_TAGS"),
         blackhole_tags=_csv_ints("MPIT_CHAOS_BLACKHOLE_TAGS"),
+        corrupt_tags=_csv_ints("MPIT_CHAOS_CORRUPT_TAGS"),
+        truncate_tags=_csv_ints("MPIT_CHAOS_TRUNCATE_TAGS"),
     )
 
 
@@ -316,15 +380,30 @@ class ChaosTransport(Transport):
         if scripted == "duplicate":
             self._record("duplicate", dst, tag, n)
 
+        wire = payload  # what actually goes down; mangled by corrupt/truncate
+        if scripted == "corrupt":
+            self._record("corrupt", dst, tag, n)
+            wire = CorruptedPayload(self.rank, dst, tag, n)
+        elif scripted == "truncate":
+            self._record("truncate", dst, tag, n)
+            cut = _truncate_payload(payload)
+            wire = (
+                cut if cut is not None
+                else CorruptedPayload(self.rank, dst, tag, n)
+            )
+
         if cfg.applies(self.rank, dst, tag) and scripted is None:
             rng = random.Random(_mix(cfg.seed, self.rank, dst, tag, n))
-            # fixed draw order — the replay contract
+            # fixed draw order — the replay contract; new kinds append
+            # their draws at the END so old seeds replay old schedules
             r_drop = rng.random()
             r_dup = rng.random()
             r_delay = rng.random()
             delay_amount = rng.random() * cfg.delay_s
             r_reset = rng.random()
             r_black = rng.random()
+            r_corrupt = rng.random()
+            r_trunc = rng.random()
 
             with self._lock:
                 in_hole = n < self._blackhole_until.get((dst, tag), 0)
@@ -347,6 +426,19 @@ class ChaosTransport(Transport):
             if r_drop < cfg.drop and cfg.allows("drop", tag):
                 self._record("drop", dst, tag, n)
                 return
+            # at most one mangle per message (elif): a frame is either
+            # corrupted whole or cut short, and the draws above already
+            # happened so the elif can't shift anyone's random stream
+            if r_corrupt < cfg.corrupt and cfg.allows("corrupt", tag):
+                self._record("corrupt", dst, tag, n)
+                wire = CorruptedPayload(self.rank, dst, tag, n)
+            elif r_trunc < cfg.truncate and cfg.allows("truncate", tag):
+                self._record("truncate", dst, tag, n)
+                cut = _truncate_payload(payload)
+                wire = (
+                    cut if cut is not None
+                    else CorruptedPayload(self.rank, dst, tag, n)
+                )
             if cfg.jitter_s > 0 and self.rank in cfg.slow_ranks:
                 self._record("jitter", dst, tag, n)
                 time.sleep(cfg.jitter_s)
@@ -358,7 +450,7 @@ class ChaosTransport(Transport):
                 deliveries = 2
 
         for _ in range(deliveries):
-            self.inner.send(dst, tag, payload)
+            self.inner.send(dst, tag, wire)
 
     # -- passthrough ------------------------------------------------------
 

@@ -1,0 +1,36 @@
+#!/usr/bin/env bash
+# One-command Perfetto timeline demo (docs/OBSERVABILITY.md):
+#
+#   scripts/trace_demo.sh [OUT_DIR] [MAX_SECONDS]
+#
+# Runs a tiny 3-rank process-mode PS training (1 server, 2 clients over
+# SocketTransport) with obs tracing armed and mild chaos drops so the
+# fault overlay has something to show, then merges the per-rank journals:
+#
+#   OUT_DIR/obs_rank{0,1,2}.jsonl   per-rank event journals
+#   OUT_DIR/trace.json              open in https://ui.perfetto.dev
+#
+# Wall-clock is bounded: the training run is killed at MAX_SECONDS
+# (default 120) rather than hanging the shell.
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+OUT_DIR="${1:-/tmp/mpit_trace_demo}"
+MAX_SECONDS="${2:-120}"
+
+rm -rf "$OUT_DIR"
+mkdir -p "$OUT_DIR"
+
+echo "=== trace_demo: 3-rank easgd run, journals -> $OUT_DIR ==="
+env JAX_PLATFORMS=cpu \
+    MPIT_OBS_DIR="$OUT_DIR" \
+    MPIT_CHAOS_SEED=7 MPIT_CHAOS_DROP=0.03 MPIT_CHAOS_TAGS=1,4 \
+    timeout -k 10 "$MAX_SECONDS" \
+    python -m mpit_tpu.launch -n 3 examples/ptest_proc.py \
+    --model mlp --steps 12 --train-size 256 --algo ps-easgd
+
+echo "=== trace_demo: merging journals ==="
+python -m mpit_tpu.obs merge "$OUT_DIR" -o "$OUT_DIR/trace.json"
+python -m mpit_tpu.obs summary "$OUT_DIR"
+
+echo "trace_demo: OK — open $OUT_DIR/trace.json in https://ui.perfetto.dev"

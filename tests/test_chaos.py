@@ -449,3 +449,131 @@ class TestStopAggregation:
             client.stop()
         # the healthy server still got its STOP — no watchdog-only exit
         assert tps[1].recv(2, TAG_STOP, timeout=1).payload is None
+
+
+class TestCorruptTruncate:
+    """Recv-path frame faults: the message ARRIVES, but mangled. The PS
+    protocol must surface these as retriable exchange failures — dropped
+    and counted, never a crash, never junk applied to the center."""
+
+    def test_determinism_and_replay(self):
+        cfg = ChaosConfig(seed=13, corrupt=0.2, truncate=0.2)
+        log1, log2 = _run_pattern(cfg), _run_pattern(cfg)
+        assert log1.events() == log2.events()
+        assert set(log1.counts()) == {"corrupt", "truncate"}
+
+    def test_new_draws_do_not_shift_old_kinds(self):
+        # the replay contract across kinds: arming corrupt/truncate (their
+        # draws are APPENDED after the original six) must leave the same
+        # seed's drop/duplicate/reset schedule bit-identical
+        base = ChaosConfig(seed=9, drop=0.3, duplicate=0.3, reset=0.1)
+        plus = ChaosConfig(
+            seed=9, drop=0.3, duplicate=0.3, reset=0.1,
+            corrupt=0.5, truncate=0.3,
+        )
+        ev_base = _run_pattern(base).events()
+        ev_plus = _run_pattern(plus).events()
+        old = tuple(
+            e for e in ev_plus
+            if e.kind in ("drop", "duplicate", "reset")
+        )
+        assert old == ev_base
+        assert any(e.kind == "corrupt" for e in ev_plus)
+        assert any(e.kind == "truncate" for e in ev_plus)
+
+    def test_truncate_cuts_arrays_keeps_envelope_scalars(self):
+        from mpit_tpu.transport.chaos import _truncate_payload
+
+        env = (7, 3, np.arange(10, dtype=np.float32))
+        cut = _truncate_payload(env)
+        assert cut[0] == 7 and cut[1] == 3 and len(cut[2]) == 5
+        # nothing array-like to cut -> the caller degrades to corruption
+        assert _truncate_payload(None) is None
+        assert _truncate_payload(42) is None
+
+    def test_corrupted_payload_resists_apply(self):
+        from mpit_tpu.transport import CorruptedPayload
+
+        with pytest.raises((TypeError, ValueError)):
+            np.asarray(CorruptedPayload(), dtype=np.float32)
+
+    def test_scripted_corrupt_param_fetch_retries(self):
+        cfg = ChaosConfig(scripted={(0, 1, TAG_PARAM, 0): "corrupt"})
+        tps, server, thread, log = _ps_world("server", cfg, center=5.0)
+        client = PClient(
+            tps[1], [0], DIM, timeout=0.3, max_retries=2, backoff_base=0.01
+        )
+        out = client.fetch()  # first reply is garbage; retry resolves it
+        np.testing.assert_array_equal(out, np.full(DIM, 5.0, np.float32))
+        assert client.corrupt_params_dropped == 1
+        assert server.counts["fetch"] == 2
+        assert [e.kind for e in log.events()] == ["corrupt"]
+        client.stop()
+        thread.join(timeout=5)
+        assert server.error is None
+
+    def test_truncated_push_dropped_as_malformed(self):
+        cfg = ChaosConfig(scripted={(1, 0, TAG_PUSH_EASGD, 0): "truncate"})
+        tps, server, thread, log = _ps_world("client", cfg)
+        client = PClient(tps[1], [0], DIM, timeout=1.0, backoff_base=0.01)
+        client.push_easgd(np.ones(DIM, np.float32))  # arrives half-length
+        client.push_easgd(np.ones(DIM, np.float32))  # clean
+        client.fetch()  # barrier: per-(src, tag) FIFO, pushes are done
+        client.stop()
+        thread.join(timeout=5)
+        assert server.error is None
+        assert server.counts["malformed_dropped"] == 1
+        assert server.counts["push_easgd"] == 1  # only the clean one
+        assert server.counts["dup_dropped"] == 0  # no dedup slot consumed
+        np.testing.assert_array_equal(
+            server.snapshot(), np.full(DIM, 0.5, np.float32)
+        )
+
+    def test_corrupt_fetch_dropped_no_crash(self):
+        cfg = ChaosConfig(scripted={(1, 0, TAG_FETCH, 0): "corrupt"})
+        tps, server, thread, log = _ps_world("client", cfg, center=3.0)
+        client = PClient(
+            tps[1], [0], DIM, timeout=0.3, max_retries=2, backoff_base=0.01
+        )
+        out = client.fetch()  # garbled FETCH never answered; retry is
+        np.testing.assert_array_equal(out, np.full(DIM, 3.0, np.float32))
+        assert server.counts["malformed_dropped"] == 1
+        assert server.counts["fetch"] == 1
+        client.stop()
+        thread.join(timeout=5)
+        assert server.error is None
+
+    def test_config_env_and_validation(self):
+        cfg = config_from_env({
+            "MPIT_CHAOS_CORRUPT": "0.1",
+            "MPIT_CHAOS_TRUNCATE": "0.2",
+            "MPIT_CHAOS_TRUNCATE_TAGS": "2,4",
+            "MPIT_CHAOS_TAGS": "1,2,4",
+        })
+        assert cfg.corrupt == 0.1 and cfg.truncate == 0.2
+        assert cfg.truncate_tags == (2, 4)
+        with pytest.raises(ValueError, match="probability"):
+            ChaosConfig(truncate=2.0)
+        with pytest.raises(ValueError, match="subset"):
+            ChaosConfig(tags=(1,), corrupt_tags=(4,))
+        # scripted accepts the new kinds
+        ChaosConfig(scripted={(0, 1, 2, 0): "corrupt",
+                              (0, 1, 2, 1): "truncate"})
+
+    def test_trainer_survives_corrupt_truncate(self, mnist):
+        x_tr, y_tr, *_ = mnist
+        cfg = ChaosConfig(
+            seed=21, corrupt=0.08, truncate=0.08,
+            tags=(TAG_FETCH, TAG_PARAM, TAG_PUSH_EASGD),
+        )
+        trainer = _chaos_trainer(cfg)
+        _, stats = trainer.train(x_tr, y_tr, steps=24, batch_size=32)
+        assert all(np.isfinite(l).all() for l in stats["losses"] if l)
+        faults = stats["chaos_faults"]
+        assert faults.get("corrupt", 0) + faults.get("truncate", 0) > 0
+        counts = stats["server_counts"][0]
+        sent = sum(pc.get(0, 0) for pc in stats["push_sent"])
+        # a mangled push is LOST (dropped as malformed), never mis-applied:
+        # applied <= sent, and every gap is accounted for by a mangle
+        assert counts["push_easgd"] <= sent
+        assert sent - counts["push_easgd"] <= sum(faults.values())

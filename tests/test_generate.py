@@ -761,8 +761,11 @@ def test_eos_truncation_on_serving_paths(topo8):
 
 try:
     from hypothesis import given, settings, strategies as st  # noqa: E402
-except ImportError:  # container without hypothesis: only the property
-    # tests below skip — the 700 lines of example tests above still run
+except ImportError:  # container without the dev extra: ONLY the property
+    # tests below skip (via pytest.importorskip's canonical path, same as
+    # tests/test_properties.py) — a module-level importorskip would throw
+    # away the ~700 lines of example tests above, so the guard is scoped
+    # to the @given-decorated tests alone
     class _DummyStrategies:
         def __getattr__(self, name):
             return lambda *a, **k: None
@@ -773,9 +776,14 @@ except ImportError:  # container without hypothesis: only the property
         return lambda f: f
 
     def given(*a, **k):
-        return lambda f: pytest.mark.skip(
-            reason="property tier needs hypothesis"
-        )(f)
+        def _deco(f):
+            def _skip(*args, **kwargs):
+                pytest.importorskip(
+                    "hypothesis", reason="property tier needs hypothesis"
+                )
+            return _skip
+
+        return _deco
 
 _PROP_MODEL = None
 _PROP_PARAMS = None

@@ -1,0 +1,452 @@
+"""mpit_tpu.obs tests (docs/OBSERVABILITY.md).
+
+Layers under test: the disabled fast path's overhead contract (no wrapper,
+no span object, pinned by a micro-benchmark), cross-rank trace propagation
+through the real PS protocol (client fetch and server reply share one
+trace id), telemetry counters/sampling, the Perfetto merger (valid JSON,
+per-rank monotonic timestamps, chaos faults as placed instant events), and
+the AsyncPSTrainer integration — the ISSUE acceptance run: a 2-client
+easgd job under chaos whose merged timeline has >= 1 cross-rank trace and
+fault markers.
+"""
+
+import json
+import os
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from mpit_tpu.obs import (
+    NULL_SPAN,
+    Journal,
+    ObsConfig,
+    config_from_env,
+    maybe_wrap,
+    merge_to_chrome_trace,
+    read_journal,
+    span,
+    summarize,
+    trace_ids_by_rank,
+    wrap_obs_transports,
+    write_fault_log,
+)
+from mpit_tpu.obs.__main__ import main as obs_main
+from mpit_tpu.parallel.pclient import PClient
+from mpit_tpu.parallel.pserver import (
+    TAG_FETCH,
+    TAG_PARAM,
+    PServer,
+    spawn_server_thread,
+)
+from mpit_tpu.transport import Broker, FaultEvent, SocketTransport
+
+DIM = 8
+
+
+class TestConfig:
+    def test_env_arming_recognized_knobs_only(self):
+        assert config_from_env({}) is None
+        assert config_from_env({"OTHER": "1"}) is None
+        # unrecognized MPIT_OBS_* must not arm (the chaos contract)
+        assert config_from_env({"MPIT_OBS_FOO": "1"}) is None
+        cfg = config_from_env({
+            "MPIT_OBS_DIR": "/tmp/x",
+            "MPIT_OBS_SAMPLE": "3",
+            "MPIT_OBS_TRACE": "0",
+        })
+        assert cfg.dir == "/tmp/x" and cfg.sample == 3 and not cfg.trace
+        assert cfg.telemetry
+        # any single recognized knob arms
+        assert config_from_env({"MPIT_OBS_TELEMETRY": "1"}) is not None
+
+    def test_sample_validation(self):
+        with pytest.raises(ValueError, match="sample"):
+            ObsConfig(sample=0)
+
+
+class TestDisabledFastPath:
+    """The overhead contract: MPIT_OBS_* unset means no wrapper exists and
+    the protocol-side hook is a getattr returning one shared no-op."""
+
+    def test_maybe_wrap_identity(self):
+        tp = Broker(1).transports()[0]
+        assert maybe_wrap(tp, None) is tp
+
+    def test_span_hook_is_shared_noop(self):
+        tp = Broker(1).transports()[0]
+        s1 = span(tp, "a", step=1)
+        s2 = span(tp, "b")
+        assert s1 is NULL_SPAN and s2 is NULL_SPAN  # no allocation at all
+        with s1 as ctx:
+            assert ctx is None
+
+    def test_span_hook_micro_benchmark(self):
+        # a deliberately generous ceiling (the hook measures ~0.3 µs);
+        # catches an accidental de-optimization (journal/alloc on the
+        # disabled path), not scheduler noise
+        tp = Broker(1).transports()[0]
+        n = 50_000
+        t0 = time.perf_counter()
+        for _ in range(n):
+            with span(tp, "hot"):
+                pass
+        per_op = (time.perf_counter() - t0) / n
+        assert per_op < 25e-6, f"disabled span hook costs {per_op*1e6:.1f}µs"
+
+
+def _ps_obs_world(tmp_path, num_clients=1):
+    """Obs-wrapped Broker world: rank 0 = PServer, ranks 1.. = clients."""
+    cfg = ObsConfig(dir=str(tmp_path))
+    tps = wrap_obs_transports(Broker(1 + num_clients).transports(), cfg)
+    server = PServer(
+        tps[0], np.full(DIM, 2.0, np.float32), num_clients=num_clients
+    )
+    thread = spawn_server_thread(server)
+    return cfg, tps, server, thread
+
+
+class TestTraceAcrossRanks:
+    def test_fetch_and_reply_share_one_trace(self, tmp_path):
+        cfg, tps, server, thread = _ps_obs_world(tmp_path)
+        client = PClient(tps[1], [0], DIM, timeout=5.0)
+        with span(tps[1], "exchange", round=0):
+            out = client.fetch()
+        np.testing.assert_array_equal(out, np.full(DIM, 2.0, np.float32))
+        client.push_easgd(np.ones(DIM, np.float32))  # envelope transparency
+        client.stop()
+        thread.join(timeout=5)
+        assert server.error is None
+        assert server.counts["push_easgd"] == 1  # obs envelope was stripped
+        for t in tps:
+            t.obs_tracer.close()
+
+        by_rank = trace_ids_by_rank([str(tmp_path)])
+        assert set(by_rank) == {0, 1}
+        shared = by_rank[0] & by_rank[1]
+        assert shared, f"no cross-rank trace: {by_rank}"
+        # the client's FETCH send and the server's PARAM reply are the
+        # same trace, linked via the reply's remote parent
+        recs0 = read_journal(str(tmp_path / "obs_rank0.jsonl"))
+        reply = next(
+            r for r in recs0 if r["ev"] == "send" and r["mtag"] == TAG_PARAM
+        )
+        recs1 = read_journal(str(tmp_path / "obs_rank1.jsonl"))
+        fetch = next(
+            r for r in recs1 if r["ev"] == "send" and r["mtag"] == TAG_FETCH
+        )
+        assert reply["trace"] == fetch["trace"]
+        assert reply["parent"] == fetch["span"]
+
+    def test_spans_do_not_chain_across_rounds(self, tmp_path):
+        # two separate exchange spans must be two traces: the remote
+        # parent from round N's PARAM recv must not leak into round N+1
+        cfg, tps, server, thread = _ps_obs_world(tmp_path)
+        client = PClient(tps[1], [0], DIM, timeout=5.0)
+        for rnd in range(2):
+            with span(tps[1], "exchange", round=rnd):
+                client.fetch()
+        client.stop()
+        thread.join(timeout=5)
+        for t in tps:
+            t.obs_tracer.close()
+        recs1 = read_journal(str(tmp_path / "obs_rank1.jsonl"))
+        traces = {r["trace"] for r in recs1 if r.get("ev") == "span_b"}
+        assert len(traces) == 2, traces
+
+    def test_lamport_clock_orders_cause_before_effect(self, tmp_path):
+        cfg, tps, server, thread = _ps_obs_world(tmp_path)
+        client = PClient(tps[1], [0], DIM, timeout=5.0)
+        client.fetch()
+        client.stop()
+        thread.join(timeout=5)
+        for t in tps:
+            t.obs_tracer.close()
+        recs0 = read_journal(str(tmp_path / "obs_rank0.jsonl"))
+        recs1 = read_journal(str(tmp_path / "obs_rank1.jsonl"))
+        send = next(r for r in recs1 if r.get("mtag") == TAG_FETCH)
+        recv = next(
+            r for r in recs0
+            if r["ev"] == "recv" and r.get("mtag") == TAG_FETCH
+        )
+        assert recv["step"] > send["step"]  # "step" carries the clock
+
+
+class TestTelemetry:
+    def test_counters_and_sampling(self, tmp_path):
+        # sample=3 journals every 3rd event per stream; counters stay exact
+        cfg = ObsConfig(dir=str(tmp_path), sample=3)
+        tps = wrap_obs_transports(Broker(2).transports(), cfg)
+        payload = np.arange(16, dtype=np.float32)
+        for i in range(9):
+            tps[0].send(1, 7, payload)
+        for _ in range(9):
+            tps[1].recv(0, 7, timeout=1)
+        s = tps[0].summary()
+        assert s["send"]["1:7"]["msgs"] == 9
+        assert s["send"]["1:7"]["bytes"] == 9 * payload.nbytes
+        assert tps[1].summary()["recv"]["0:7"]["msgs"] == 9
+        for t in tps:
+            t.obs_tracer.close()
+        recs = read_journal(str(tmp_path / "obs_rank0.jsonl"))
+        assert sum(1 for r in recs if r.get("ev") == "send") == 3  # n=0,3,6
+
+    def test_recv_timeout_counted_not_journaled(self, tmp_path):
+        from mpit_tpu.transport import RecvTimeout
+
+        cfg = ObsConfig(dir=str(tmp_path))
+        tps = wrap_obs_transports(Broker(2).transports(), cfg)
+        with pytest.raises(RecvTimeout):
+            tps[0].recv(1, 7, timeout=0.01)
+        assert tps[0].summary()["recv"]["1:7"]["timeouts"] == 1
+        tps[0].obs_tracer.close()
+        recs = read_journal(str(tmp_path / "obs_rank0.jsonl"))
+        assert recs == []  # a watchdog's poll loop must not spam records
+
+    def test_journal_reserved_keys_sanitized(self, tmp_path):
+        j = Journal(str(tmp_path / "obs_rank0.jsonl"), rank=0)
+        j.event("custom", 1, step=9, tag="x", value=3)
+        j.close()
+        (rec,) = read_journal(str(tmp_path / "obs_rank0.jsonl"))
+        assert rec["step"] == 1 and rec["tag"] == "obs"  # owner's fields
+        assert rec["x_step"] == 9 and rec["x_tag"] == "x"
+        assert rec["value"] == 3
+
+
+class TestSocketPairTrace:
+    def test_socket_fetch_reply_one_trace_and_valid_merge(self, tmp_path):
+        base_port = 29_951
+        cfg = ObsConfig(dir=str(tmp_path))
+        srv = maybe_wrap(SocketTransport(0, 2, base_port=base_port), cfg)
+        cli = maybe_wrap(SocketTransport(1, 2, base_port=base_port), cfg)
+
+        def serve():
+            msg = srv.recv(tag=TAG_FETCH, timeout=10)
+            srv.send(msg.src, TAG_PARAM, np.full(DIM, 4.0, np.float32))
+
+        th = threading.Thread(target=serve, daemon=True)
+        th.start()
+        with span(cli, "exchange"):
+            cli.send(0, TAG_FETCH, None)
+            msg = cli.recv(0, TAG_PARAM, timeout=10)
+        np.testing.assert_array_equal(
+            msg.payload, np.full(DIM, 4.0, np.float32)
+        )
+        th.join(timeout=10)
+        cli.close()
+        srv.close()
+
+        by_rank = trace_ids_by_rank([str(tmp_path)])
+        assert by_rank[0] & by_rank[1], by_rank
+        trace = merge_to_chrome_trace([str(tmp_path)])
+        json.dumps(trace)  # Perfetto-loadable: plain JSON object format
+        evs = trace["traceEvents"]
+        assert {e["ph"] for e in evs} >= {"X", "s", "f", "B", "E"}
+        # per-rank monotonic timestamps (journal order == time order)
+        for path in sorted(os.listdir(tmp_path)):
+            ts = [
+                r["t"]
+                for r in read_journal(str(tmp_path / path))
+                if "t" in r
+            ]
+            assert ts == sorted(ts), path
+
+
+class TestMerge:
+    def _write_rank(self, tmp_path, rank, events):
+        j = Journal(str(tmp_path / f"obs_rank{rank}.jsonl"), rank)
+        for ev, clk, fields in events:
+            j.event(ev, clk, **fields)
+        j.close()
+
+    def test_fault_overlay_placed_and_unplaced(self, tmp_path):
+        self._write_rank(tmp_path, 1, [
+            ("send", 1, {"dst": 0, "mtag": 2, "n": 0, "bytes": 8,
+                         "dur": 0.001}),
+        ])
+        faults_path = str(tmp_path / "faults.jsonl")
+        n = write_fault_log(
+            [
+                FaultEvent("corrupt", 1, 0, 2, 0),  # joins the send above
+                FaultEvent("drop", 1, 0, 2, 99),    # no telemetry match
+            ],
+            faults_path,
+        )
+        assert n == 2
+        trace = merge_to_chrome_trace([str(tmp_path)], faults_path)
+        chaos = [e for e in trace["traceEvents"] if e.get("cat") == "chaos"]
+        assert len(chaos) == 2
+        placed = next(e for e in chaos if e["name"] == "fault corrupt")
+        send = next(
+            e for e in trace["traceEvents"]
+            if e["ph"] == "X" and e["name"].startswith("send")
+        )
+        assert placed["ph"] == "i" and placed["ts"] == send["ts"]
+        unplaced = next(e for e in chaos if e["name"] == "fault drop")
+        assert unplaced["args"]["unplaced"] and unplaced["ts"] == 0.0
+
+    def test_summarize_and_malformed_lines_skipped(self, tmp_path):
+        self._write_rank(tmp_path, 0, [
+            ("send", 1, {"dst": 1, "mtag": 1, "n": 0, "bytes": 10,
+                         "dur": 0.0, "trace": 7, "span": 8}),
+            ("recv", 2, {"src": 1, "mtag": 4, "n": 0, "bytes": 5,
+                         "wait": 0.0}),
+        ])
+        with open(tmp_path / "obs_rank0.jsonl", "a") as f:
+            f.write("{truncated by a killed rank\n")
+        s = summarize([str(tmp_path)])
+        assert s[0]["sends"] == 1 and s[0]["recvs"] == 1
+        assert s[0]["bytes"] == 10 and s[0]["traces"] == 1
+
+    def test_cli_merge_and_empty_dir(self, tmp_path, capsys):
+        empty = tmp_path / "empty"
+        empty.mkdir()
+        assert obs_main(["merge", str(empty)]) == 2
+        run = tmp_path / "run"
+        run.mkdir()
+        self._write_rank(run, 0, [
+            ("send", 1, {"dst": 1, "mtag": 1, "n": 0, "bytes": 4,
+                         "dur": 0.0}),
+        ])
+        assert obs_main(["merge", str(run)]) == 0
+        out = json.load(open(run / "trace.json"))
+        assert any(e["ph"] == "X" for e in out["traceEvents"])
+        assert obs_main(["summary", str(run)]) == 0
+
+
+def _obs_trainer(tmp_path, chaos=None, obs="explicit", **kw):
+    import jax.numpy as jnp
+    import optax
+
+    from mpit_tpu.models import MLP
+    from mpit_tpu.parallel import AsyncPSTrainer
+
+    return AsyncPSTrainer(
+        MLP(compute_dtype=jnp.float32),
+        optax.sgd(0.05, momentum=0.9),
+        num_clients=2,
+        num_servers=1,
+        algo="easgd",
+        tau=4,
+        transport="inproc",
+        chaos=chaos,
+        obs=ObsConfig(dir=str(tmp_path)) if obs == "explicit" else None,
+        max_exchange_failures=5,
+        fetch_timeout=1.0,
+        fetch_retries=3,
+        **kw,
+    )
+
+
+@pytest.fixture(scope="module")
+def mnist():
+    from mpit_tpu.data import load_mnist
+
+    return load_mnist(synthetic_train=2048, synthetic_test=512)
+
+
+class TestTrainerIntegration:
+    def test_chaos_run_merges_with_cross_rank_traces_and_faults(
+        self, tmp_path, mnist
+    ):
+        """The acceptance run: 2-client easgd under chaos, obs armed —
+        the merged timeline must be Perfetto-loadable JSON with >= 1
+        cross-rank trace and the injected faults as instant events."""
+        from mpit_tpu.transport import ChaosConfig
+
+        x_tr, y_tr, *_ = mnist
+        chaos = ChaosConfig(
+            seed=11, drop=0.05, corrupt=0.05, truncate=0.05,
+            tags=(1, 2, 4),
+        )
+        trainer = _obs_trainer(tmp_path, chaos=chaos)
+        _, stats = trainer.train(x_tr, y_tr, steps=24, batch_size=32)
+        assert all(np.isfinite(l).all() for l in stats["losses"] if l)
+
+        # telemetry folded into trainer stats, one summary per rank
+        tele = stats["telemetry"]
+        assert [t["rank"] for t in tele] == [0, 1, 2]
+        assert any(
+            v["msgs"] > 0 for t in tele for v in t["send"].values()
+        )
+        # chaos + obs together persist the fault log for the overlay
+        faults_path = tmp_path / "faults.jsonl"
+        assert faults_path.exists()
+
+        journals = [
+            str(tmp_path / f) for f in sorted(os.listdir(tmp_path))
+            if f.startswith("obs_rank")
+        ]
+        assert len(journals) == 3
+        trace = merge_to_chrome_trace(journals, str(faults_path))
+        json.dumps(trace)
+        evs = trace["traceEvents"]
+        by_rank = trace_ids_by_rank(journals)
+        cross = [
+            t for t in set().union(*by_rank.values())
+            if sum(1 for ids in by_rank.values() if t in ids) >= 2
+        ]
+        assert len(cross) >= 1, by_rank
+        markers = [e for e in evs if e.get("cat") == "chaos"]
+        assert len(markers) >= 1
+        assert all(e["ph"] == "i" for e in markers)
+        # exchange spans made it onto the timeline
+        assert any(
+            e["ph"] == "B" and e["name"] == "exchange" for e in evs
+        )
+        for j in journals:  # per-rank monotonic wall-clock
+            ts = [r["t"] for r in read_journal(j) if "t" in r]
+            assert ts == sorted(ts), j
+
+    def test_env_knobs_activate_obs(self, tmp_path, mnist, monkeypatch):
+        x_tr, y_tr, *_ = mnist
+        monkeypatch.setenv("MPIT_OBS_DIR", str(tmp_path))
+        trainer = _obs_trainer(tmp_path, obs=None)  # config from the env
+        _, stats = trainer.train(x_tr, y_tr, steps=8, batch_size=32)
+        assert "telemetry" in stats
+        assert any(
+            f.startswith("obs_rank") for f in os.listdir(tmp_path)
+        )
+
+    def test_obs_off_no_telemetry_key(self, tmp_path, mnist):
+        x_tr, y_tr, *_ = mnist
+        trainer = _obs_trainer(tmp_path, obs=None)
+        _, stats = trainer.train(x_tr, y_tr, steps=8, batch_size=32)
+        assert "telemetry" not in stats
+        assert os.listdir(tmp_path) == []  # nothing written when unarmed
+
+
+@pytest.mark.slow
+def test_two_process_socket_trace(tmp_path):
+    """The real thing: ptest_proc.py ranks as OS processes over TCP with
+    MPIT_OBS_DIR armed via the launcher env; the merged journals must
+    contain a cross-rank trace."""
+    import subprocess
+    import sys
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env.pop("MPIT_RANK", None)
+    env.pop("MPIT_WORLD_SIZE", None)
+    env["MPIT_OBS_DIR"] = str(tmp_path)
+    r = subprocess.run(
+        [sys.executable, "-m", "mpit_tpu.launch", "-n", "3",
+         os.path.join(repo, "examples", "ptest_proc.py"),
+         "--model", "mlp", "--steps", "8", "--train-size", "256",
+         "--algo", "ps-easgd"],
+        cwd=repo, env=env, capture_output=True, text=True, timeout=240,
+    )
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert "OBS tracing/telemetry active" in r.stderr
+    by_rank = trace_ids_by_rank([str(tmp_path)])
+    assert len(by_rank) == 3
+    cross = [
+        t for t in set().union(*by_rank.values())
+        if sum(1 for ids in by_rank.values() if t in ids) >= 2
+    ]
+    assert len(cross) >= 1, {r: len(v) for r, v in by_rank.items()}
+    trace = merge_to_chrome_trace([str(tmp_path)])
+    json.dumps(trace)
+    assert any(e["ph"] == "f" for e in trace["traceEvents"])
