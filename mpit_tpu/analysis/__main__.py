@@ -3,17 +3,29 @@
 ``python -m mpit_tpu.analysis [options] [path ...]``
 
 Scans the given files/directories (default: the installed ``mpit_tpu``
-package) with rules MPT001–MPT008 — including the cross-module passes
+package) with rules MPT001–MPT011 — including the cross-module passes
 (pickle wire-format drift, protocol-role pairing, wrapper-taint jit
-drift), which resolve imports and constants across the whole scan set
-without importing anything — subtracts the checked-in baseline, and exits
-0 when nothing new was found. ``--write-baseline`` refreshes the baseline
-from the current scan (review the diff — every line you accept is a
-violation you are signing off on). ``--fix`` first rewrites the
-mechanically-fixable MPT002 sites (known literal tag → ``TAG_*`` name +
-import) in place, then lints the result.
+drift) and the explicit-state model check of the extracted PS protocol
+(MPT009–011, :mod:`mpit_tpu.analysis.mcheck`), all without importing
+anything — subtracts the checked-in baseline, and exits 0 when nothing
+new was found. ``--write-baseline`` refreshes the baseline from the
+current scan (review the diff — every line you accept is a violation you
+are signing off on). ``--fix`` first rewrites the mechanically-fixable
+MPT002 sites (known literal tag → ``TAG_*`` name + import) in place,
+then lints the result.
 
-Exit codes: 0 clean (vs baseline), 1 new findings, 2 usage/baseline error.
+Subcommands:
+
+``python -m mpit_tpu.analysis mcheck [--package PATH]``
+    Run only the protocol model check and print per-configuration state
+    counts — the exhaustiveness receipt behind MPT009–011.
+
+``python -m mpit_tpu.analysis conform <obs-dir> [--package PATH]``
+    Replay an observability run (``obs_rank*.jsonl`` + ``faults*.jsonl``)
+    against the extracted protocol; report TC201–TC203 violations.
+
+Exit codes (every mode, regardless of output format): 0 clean (vs
+baseline), 1 new findings / violations, 2 usage or input error.
 """
 
 from __future__ import annotations
@@ -31,7 +43,147 @@ def _default_scan_path() -> str:
     return str(Path(__file__).resolve().parent.parent)
 
 
+def _load_project(package: str):
+    modules = []
+    for ap, rel in lint.collect_files([package]):
+        ctx = lint.load_module(ap, rel)
+        if ctx is not None:
+            modules.append(ctx)
+    return lint.Project(modules=modules, config=lint.Config())
+
+
+def _main_mcheck(argv) -> int:
+    from mpit_tpu.analysis import mcheck, protocol
+
+    parser = argparse.ArgumentParser(
+        prog="python -m mpit_tpu.analysis mcheck",
+        description="Exhaustively model-check the extracted PS protocol "
+        "under single-fault schedules (MPT009-MPT011).",
+    )
+    parser.add_argument(
+        "--package",
+        default=_default_scan_path(),
+        help="package to extract the protocol from (default: mpit_tpu)",
+    )
+    parser.add_argument(
+        "--json", action="store_true", help="machine-readable output"
+    )
+    args = parser.parse_args(argv)
+    if not Path(args.package).exists():
+        print(f"error: no such path: {args.package}", file=sys.stderr)
+        return 2
+    sem = protocol.extract_semantics(_load_project(args.package))
+    if sem is None or not sem.has_fault_machinery:
+        print(
+            "error: no fault-tolerant protocol pair extracted from "
+            f"{args.package} (need marked roles with attempt ids or a "
+            "dedup window)",
+            file=sys.stderr,
+        )
+        return 2
+    results = mcheck.check_all(mcheck.from_protocol(sem))
+    bad = False
+    if args.json:
+        print(json.dumps([
+            {
+                "config": r.config.label,
+                "states": r.states,
+                "fault_points": r.fault_points,
+                "violations": r.violations,
+                "truncated": r.truncated,
+            }
+            for r in results
+        ], indent=2))
+        bad = any(not r.ok for r in results)
+    else:
+        for r in results:
+            status = "ok" if r.ok else "FAIL"
+            print(
+                f"{status}: {r.config.label}: {r.states} states, "
+                f"{r.fault_points} single-fault schedules explored"
+            )
+            for rule in sorted(r.violations):
+                print(f"  {rule}: {r.violations[rule]}")
+            if r.truncated:
+                print("  truncated: state bound hit, result inconclusive")
+            bad = bad or not r.ok
+    return 1 if bad else 0
+
+
+def _main_conform(argv) -> int:
+    from mpit_tpu.analysis import conformance
+
+    parser = argparse.ArgumentParser(
+        prog="python -m mpit_tpu.analysis conform",
+        description="Replay obs journals against the extracted protocol "
+        "(TC201-TC203).",
+    )
+    parser.add_argument(
+        "obs_dir",
+        help="directory with obs_rank*.jsonl journals (and, for "
+        "chaos runs, faults*.jsonl), or a single journal file",
+    )
+    parser.add_argument(
+        "--faults",
+        metavar="PATH",
+        help="chaos fault log (default: faults*.jsonl inside obs_dir)",
+    )
+    parser.add_argument(
+        "--package",
+        default=_default_scan_path(),
+        help="package to extract the protocol from (default: mpit_tpu)",
+    )
+    parser.add_argument(
+        "--json", action="store_true", help="machine-readable output"
+    )
+    args = parser.parse_args(argv)
+    if not Path(args.obs_dir).exists():
+        print(f"error: no such path: {args.obs_dir}", file=sys.stderr)
+        return 2
+    if not Path(args.package).exists():
+        print(f"error: no such path: {args.package}", file=sys.stderr)
+        return 2
+    report = conformance.check_conformance(
+        args.obs_dir, _load_project(args.package), faults_path=args.faults
+    )
+    if not report.journals:
+        print(
+            f"error: no obs_rank*.jsonl journals under {args.obs_dir}",
+            file=sys.stderr,
+        )
+        return 2
+    if args.json:
+        print(json.dumps({
+            "journals": [str(p) for p in report.journals],
+            "events": report.events,
+            "sends": report.sends,
+            "recvs": report.recvs,
+            "faults": report.faults,
+            "violations": [
+                {"rule": v.rule, "detail": v.detail}
+                for v in report.violations
+            ],
+        }, indent=2))
+    else:
+        for v in report.violations:
+            print(v)
+        print(
+            f"{len(report.violations)} violation(s) in "
+            f"{len(report.journals)} journal(s): {report.sends} send(s), "
+            f"{report.recvs} recv(s), {report.faults} fault record(s)"
+        )
+    return 1 if report.violations else 0
+
+
 def main(argv=None) -> int:
+    if argv is None:
+        argv = sys.argv[1:]
+    # subcommands keep the plain lint invocation's flag surface intact
+    # (paths are positional, so a literal first arg dispatches cleanly)
+    if argv and argv[0] == "mcheck":
+        return _main_mcheck(argv[1:])
+    if argv and argv[0] == "conform":
+        return _main_conform(argv[1:])
     parser = argparse.ArgumentParser(
         prog="python -m mpit_tpu.analysis",
         description="Distributed-correctness linter (rules MPT001-MPT008).",
@@ -46,6 +198,14 @@ def main(argv=None) -> int:
         choices=("text", "json"),
         default="text",
         help="output format (default: text)",
+    )
+    parser.add_argument(
+        "--json",
+        dest="format",
+        action="store_const",
+        const="json",
+        help="shorthand for --format json (same 0/1/2 exit gate — the "
+        "baseline gate never depends on the output format)",
     )
     parser.add_argument(
         "--baseline",

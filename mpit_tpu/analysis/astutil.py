@@ -79,23 +79,100 @@ def string_constants(node: ast.AST) -> Iterator[str]:
             yield sub.value
 
 
-def int_constant(node: ast.AST) -> Optional[int]:
-    """The int value of a Constant node (bools excluded), else None."""
-    if (
-        isinstance(node, ast.Constant)
-        and isinstance(node.value, int)
-        and not isinstance(node.value, bool)
-    ):
-        return node.value
-    if (  # -1 parses as UnaryOp(USub, Constant(1))
-        isinstance(node, ast.UnaryOp)
-        and isinstance(node.op, ast.USub)
-        and isinstance(node.operand, ast.Constant)
-        and isinstance(node.operand.value, int)
-        and not isinstance(node.operand.value, bool)
-    ):
-        return -node.operand.value
+# arithmetic the folder evaluates; Pow is deliberately absent (a folded
+# ``2 ** 10**6`` would eat the scan's memory budget for no lint value)
+_BIN_FOLDS = {
+    ast.Add: lambda a, b: a + b,
+    ast.Sub: lambda a, b: a - b,
+    ast.Mult: lambda a, b: a * b,
+    ast.FloorDiv: lambda a, b: a // b,
+    ast.Mod: lambda a, b: a % b,
+    ast.LShift: lambda a, b: a << b,
+    ast.RShift: lambda a, b: a >> b,
+    ast.BitOr: lambda a, b: a | b,
+    ast.BitAnd: lambda a, b: a & b,
+    ast.BitXor: lambda a, b: a ^ b,
+}
+_UNARY_FOLDS = {
+    ast.USub: lambda a: -a,
+    ast.UAdd: lambda a: +a,
+    ast.Invert: lambda a: ~a,
+}
+#: folded results larger than this are abandoned (a registry tag or wire
+#: constant is small; anything bigger is data, not protocol)
+_FOLD_INT_BOUND = 1 << 63
+_FOLD_STR_BOUND = 4096
+
+
+def _fold_leaf(value) -> Optional[object]:
+    if isinstance(value, bool):
+        return None  # True == 1 but is not a tag
+    if isinstance(value, (int, str)):
+        return value
     return None
+
+
+def fold_binop(op: ast.operator, left, right) -> Optional[object]:
+    """``left <op> right`` for already-folded int/str operands, or None
+    when the combination doesn't fold (mixed types, div-by-zero, huge
+    results). Shared with the module graph so ``TAG_BASE + 1`` folds the
+    same whether the operands are literals or cross-module constants."""
+    if left is None or right is None:
+        return None
+    if isinstance(left, str) or isinstance(right, str):
+        # concatenation is the one string fold protocols use ("obs" + "1"
+        # wire-version strings); everything else stays unfolded
+        if (
+            isinstance(op, ast.Add)
+            and isinstance(left, str)
+            and isinstance(right, str)
+            and len(left) + len(right) <= _FOLD_STR_BOUND
+        ):
+            return left + right
+        return None
+    fold = _BIN_FOLDS.get(type(op))
+    if fold is None:
+        return None
+    try:
+        out = fold(left, right)
+    except (ZeroDivisionError, ValueError, OverflowError):
+        return None
+    if isinstance(out, int) and abs(out) > _FOLD_INT_BOUND:
+        return None
+    return out
+
+
+def fold_unaryop(op: ast.unaryop, operand) -> Optional[object]:
+    fold = _UNARY_FOLDS.get(type(op))
+    if fold is None or not isinstance(operand, int) or isinstance(
+        operand, bool
+    ):
+        return None
+    return fold(operand)
+
+
+def fold_constant(node: ast.AST) -> Optional[object]:
+    """Evaluate a pure-literal int/str expression: constants plus the
+    arithmetic/concatenation in ``_BIN_FOLDS``/``_UNARY_FOLDS`` —
+    ``(1 << 4) | 2`` folds to 18, ``"obs" + "1"`` to ``"obs1"``. Names
+    don't fold here (that's the module graph's job); None = no fold."""
+    if isinstance(node, ast.Constant):
+        return _fold_leaf(node.value)
+    if isinstance(node, ast.UnaryOp):
+        return fold_unaryop(node.op, fold_constant(node.operand))
+    if isinstance(node, ast.BinOp):
+        return fold_binop(
+            node.op, fold_constant(node.left), fold_constant(node.right)
+        )
+    return None
+
+
+def int_constant(node: ast.AST) -> Optional[int]:
+    """The int value of a pure-literal expression (bools excluded) —
+    a plain Constant, or folded arithmetic like ``-1`` or ``2 + 1``;
+    else None."""
+    val = fold_constant(node)
+    return val if isinstance(val, int) else None
 
 
 def get_arg(

@@ -291,6 +291,20 @@ class ModuleGraph:
                 node.value, _CONST_TYPES
             ):
                 return node.value
+            if isinstance(node, ast.BinOp):
+                # fold arithmetic/concatenation whose operands resolve —
+                # ``TAG_BASE + 1`` is a real registry idiom, and skipping
+                # it silently exempted such tags from MPT002/MPT008
+                return astutil.fold_binop(
+                    node.op,
+                    self.resolve_constant(info, node.left, depth + 1),
+                    self.resolve_constant(info, node.right, depth + 1),
+                )
+            if isinstance(node, ast.UnaryOp):
+                return astutil.fold_unaryop(
+                    node.op,
+                    self.resolve_constant(info, node.operand, depth + 1),
+                )
             dotted = astutil.dotted_name(node)
             if dotted is None:
                 return None

@@ -1,0 +1,501 @@
+"""Explicit-state model checker for the PS fetch/push protocol.
+
+The linter's MPT008 pairs tags; this module goes further and *verifies*
+the protocol semantics that :func:`mpit_tpu.analysis.protocol
+.extract_semantics` lifts out of the marked modules (attempt-id echo +
+check, reply-wait timeout, the dedup window's exact boundary) by
+exhaustively exploring every message interleaving of a small
+configuration under the chaos fault vocabulary:
+
+- ``drop``       — the message is never delivered;
+- ``dup``        — delivered twice, the second copy out of order;
+- ``reorder``    — delivered, but possibly out of stream order;
+- ``stale``      — a reply delayed past the requester's timeout (it can
+                   still arrive later, racing the retry's fresh reply).
+
+At most ONE fault is injected per run, but the *choice* of fault is part
+of the state space: at every send the checker branches into the clean
+send plus every applicable (kind, message) fault, so a single
+breadth-bounded exploration covers the fault-free baseline and every
+single-fault schedule at once, with all shared prefixes/suffixes
+deduplicated through the visited set. STOP messages are never faulted —
+teardown loss is the watchdog's jurisdiction (docs/ROBUSTNESS.md), not
+the exchange protocol's.
+
+Verified safety properties (reported as lint rules by
+``rules/model_check.py``):
+
+- **MPT009** exactly-once push application: no ``(client, seq)`` push is
+  ever applied twice by one server (the dedup window's contract);
+- **MPT010** deadlock freedom: no reachable state where nobody can move
+  yet the run isn't finished (every blocking recv has an escape);
+- **MPT011** stale-attempt isolation: a reply generated for attempt *i*
+  is never accepted by a client whose live attempt is *j* ≠ *i* (the
+  mis-assembled-fetch bug the attempt-id echo exists to prevent).
+
+The model is deliberately small and immutable: states are nested tuples,
+transitions are pure functions, and the whole exploration is a stack +
+visited-set loop. Client steps and the server's handle-and-reply are
+atomic (matching the implementation: both run under one dispatch
+iteration), messages are FIFO per ``(kind, src, dst)`` stream except
+where a fault marked them reorderable, and a client's timeout transition
+is enabled exactly when no in-flight message could still satisfy its
+wait (or the only candidate reply is stale-delayed) — the model's
+version of "the timer really would fire first".
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+# message kinds (single chars: states hash millions of times)
+K_REQ, K_REP, K_PUSH, K_STOP = "Q", "P", "U", "S"
+# message flag bits
+RE = 1  # reorderable: may be delivered ahead of/behind its stream
+STALE = 2  # a reply delayed past the requester's timeout
+
+FAULT_KINDS = ("drop", "dup", "reorder", "stale")
+
+_KIND_LABEL = {K_REQ: "REQ", K_REP: "REPLY", K_PUSH: "PUSH", K_STOP: "STOP"}
+
+
+@dataclasses.dataclass(frozen=True)
+class DedupModel:
+    """The admit predicate's modeled bits (window size comes from the
+    config — exploring a 1024-wide window would need 1025 rounds to
+    exercise the boundary, so the model shrinks it instead)."""
+
+    rejects_at_boundary: bool
+    checks_seen: bool
+    prunes_seen: bool
+
+
+@dataclasses.dataclass(frozen=True)
+class ModelSemantics:
+    """What the checked protocol does about faults (see
+    ``protocol.ProtocolSemantics``; this is its model-facing projection,
+    constructible directly in tests)."""
+
+    attempt_echoed: bool
+    attempt_checked: bool
+    reply_recv_timeout: bool
+    has_push: bool
+    dedup: Optional[DedupModel]
+    dedup_opaque: bool = False  # dedup exists but unmodelable: assume ok
+
+
+def from_protocol(sem) -> ModelSemantics:
+    """ModelSemantics from a ``protocol.ProtocolSemantics``."""
+    dedup = None
+    if sem.dedup is not None:
+        dedup = DedupModel(
+            rejects_at_boundary=sem.dedup.rejects_at_boundary,
+            checks_seen=sem.dedup.checks_seen,
+            prunes_seen=sem.dedup.prunes_seen,
+        )
+    return ModelSemantics(
+        attempt_echoed=sem.attempt_echoed,
+        attempt_checked=sem.attempt_checked,
+        reply_recv_timeout=sem.reply_recv_timeout,
+        has_push=bool(sem.push_tags),
+        dedup=dedup,
+        dedup_opaque=sem.dedup_opaque,
+    )
+
+
+@dataclasses.dataclass(frozen=True)
+class ModelConfig:
+    """One exploration's bounds. The defaults are the acceptance
+    configuration: 2 clients x 1 server, 2 rounds, dedup window 1 (the
+    smallest window with a boundary), 1 retry."""
+
+    algo: str = "easgd"
+    script: tuple = ("fetch", "push")  # one round's client steps
+    clients: int = 2
+    servers: int = 1
+    rounds: int = 2
+    window: int = 1
+    max_retries: int = 1
+    kinds: tuple = FAULT_KINDS
+    max_states: int = 500_000
+
+    @property
+    def label(self) -> str:
+        return (
+            f"{self.algo}, {self.clients} client(s) x "
+            f"{self.servers} server(s), {self.rounds} round(s)"
+        )
+
+
+def default_configs(has_push: bool) -> tuple:
+    """The two shipped-protocol configurations: EASGD (fetch -> push)
+    and Downpour (push -> fetch). A push-less protocol gets a single
+    fetch-only config (the scripts would coincide)."""
+    if not has_push:
+        return (ModelConfig(algo="fetch-only", script=("fetch",)),)
+    return (
+        ModelConfig(algo="easgd", script=("fetch", "push")),
+        ModelConfig(algo="downpour", script=("push", "fetch")),
+    )
+
+
+@dataclasses.dataclass
+class CheckResult:
+    config: ModelConfig
+    states: int  # distinct states explored
+    fault_points: int  # distinct (kind, message) single-fault schedules
+    violations: dict  # rule id -> witness message
+    truncated: bool  # hit max_states (result then inconclusive)
+
+    @property
+    def ok(self) -> bool:
+        return not self.violations and not self.truncated
+
+
+# -- transitions ------------------------------------------------------------
+#
+# state  = (clients, servers, net, fault_available)
+# client = (stage, waiting, attempt, retries, pending_servers)
+#          stage 0..n_stages-1 = script step; n_stages = send STOP;
+#          n_stages+1 = done
+# server = (stops, applied, dedup) with dedup = ((high, seen), ...) per
+#          client; applied = frozenset of (client, seq)
+# msg    = (kind, src, dst, a, b, flags)
+#          REQ: a=attempt          REP: a=true_attempt, b=echo (-1 none)
+#          PUSH: a=seq             STOP: —
+
+
+def _canon(net) -> tuple:
+    """Canonical network order. Delivery semantics only constrain the
+    relative order WITHIN a non-reorderable (kind, src, dst) stream;
+    interleavings across streams (and among reorderable messages) are
+    equivalent, so states are stored with streams sorted by key and the
+    reorderable pool sorted — collapsing k! permutations of k independent
+    sends into one state."""
+    if len(net) <= 1:
+        return net
+    streams: dict = {}
+    loose = []
+    for m in net:
+        if m[5] & RE:
+            loose.append(m)
+        else:
+            streams.setdefault((m[0], m[1], m[2]), []).append(m)
+    out = []
+    for key in sorted(streams):
+        out.extend(streams[key])
+    out.extend(sorted(loose))
+    return tuple(out)
+
+
+def _deliverable(net) -> list:
+    """Indices deliverable now: the head non-reorderable message of each
+    (kind, src, dst) stream, plus every reorderable message."""
+    out = []
+    seen_head = set()
+    for i, m in enumerate(net):
+        if m[5] & RE:
+            out.append(i)
+            continue
+        key = (m[0], m[1], m[2])
+        if key not in seen_head:
+            out.append(i)
+            seen_head.add(key)
+    return out
+
+
+def _variants(msgs, avail, kinds, points) -> list:
+    """Fault branching for one atomic multi-send: the clean send, plus —
+    when the single-fault budget is unspent — each applicable fault on
+    each message. Returns [(messages_to_enqueue, fault_still_available)].
+    """
+    base = tuple(msgs)
+    out = [(base, avail)]
+    if not avail:
+        return out
+    for i, m in enumerate(msgs):
+        if m[0] == K_STOP:
+            continue  # teardown is never faulted (see module docstring)
+        for kind in kinds:
+            if kind == "drop":
+                repl = ()
+            elif kind == "dup":
+                repl = (m, m[:5] + (m[5] | RE,))
+            elif kind == "reorder":
+                repl = (m[:5] + (m[5] | RE,),)
+            elif kind == "stale" and m[0] == K_REP:
+                repl = (m[:5] + (m[5] | RE | STALE,),)
+            else:
+                continue
+            points.add((kind, m[:5]))
+            out.append((base[:i] + repl + base[i + 1:], False))
+    return out
+
+
+def _set(tup, i, v):
+    return tup[:i] + (v,) + tup[i + 1:]
+
+
+def _apply_push(servers, s, c, seq, sem, cfg, viol):
+    """One server consuming one push: run the modeled admit predicate,
+    then the exactly-once assertion on the applied set."""
+    stops, applied, dedup = servers[s]
+    ds = dedup
+    if sem.dedup is not None:
+        high, seen = dedup[c]
+        bound = high - cfg.window
+        if sem.dedup.rejects_at_boundary:
+            reject = seq <= bound
+        else:
+            reject = seq < bound
+        if not reject and sem.dedup.checks_seen and seq in seen:
+            reject = True
+        admitted = not reject
+        if admitted:
+            seen2 = seen | {seq}
+            if seq > high:
+                if sem.dedup.prunes_seen and len(seen2) > cfg.window:
+                    floor = seq - cfg.window
+                    seen2 = frozenset(x for x in seen2 if x > floor)
+                ds = _set(dedup, c, (seq, frozenset(seen2)))
+            else:
+                ds = _set(dedup, c, (high, frozenset(seen2)))
+    elif sem.dedup_opaque:
+        # unmodelable dedup machinery: assume it deduplicates correctly
+        # (resolve-or-skip — never report what we couldn't model)
+        admitted = (c, seq) not in applied
+    else:
+        admitted = True  # no dedup at all: every delivery applies
+    if admitted:
+        if (c, seq) in applied:
+            viol.setdefault(
+                "MPT009",
+                f"[{cfg.label}] push (client {c}, seq {seq}) applied "
+                "TWICE by one server: a duplicated/reordered copy passed "
+                "the dedup admit after the window slid past it",
+            )
+        applied = applied | {(c, seq)}
+    return _set(servers, s, (stops, applied, ds))
+
+
+def _starved(net, c, att, pending, sem) -> bool:
+    """Would the client's reply wait really time out? True when some
+    pending server has neither a live same-attempt REQ in flight nor a
+    reply that this client would take; stale-delayed replies don't count
+    (being delayed past the timeout is their definition)."""
+    distinguishes = sem.attempt_echoed and sem.attempt_checked
+    satisfied = set()
+    for m in net:
+        if m[0] == K_REQ and m[1] == c and m[3] == att:
+            satisfied.add(m[2])
+        elif m[0] == K_REP and m[2] == c and not (m[5] & STALE):
+            if not distinguishes or m[4] == att:
+                satisfied.add(m[1])
+    return any(s not in satisfied for s in pending)
+
+
+def _successors(state, sem, cfg, viol, points) -> list:
+    clients, servers, net, avail = state
+    out = []
+    deliv = _deliverable(net)
+    steps = len(cfg.script)
+    n_stages = cfg.rounds * steps
+    all_clients = frozenset(range(cfg.clients))
+
+    # -- server deliveries (handle + reply are one atomic step)
+    for i in deliv:
+        m = net[i]
+        kind = m[0]
+        if kind == K_REP:
+            continue
+        s = m[2]
+        stops = servers[s][0]
+        if stops == all_clients:
+            continue  # server exited its loop; late messages park
+        rest = net[:i] + net[i + 1:]
+        if kind == K_REQ:
+            c, att = m[1], m[3]
+            echo = att if sem.attempt_echoed else -1
+            rep = (K_REP, s, c, att, echo, 0)
+            for added, av2 in _variants([rep], avail, cfg.kinds, points):
+                out.append((clients, servers, rest + added, av2))
+        elif kind == K_PUSH:
+            srv2 = _apply_push(servers, s, m[1], m[3], sem, cfg, viol)
+            out.append((clients, srv2, rest, avail))
+        else:  # STOP
+            srv2 = _set(
+                servers, s, (stops | {m[1]}, servers[s][1], servers[s][2])
+            )
+            out.append((clients, srv2, rest, avail))
+
+    # -- client moves
+    for c, cl in enumerate(clients):
+        stage, waiting, att, retries, pending = cl
+        if stage > n_stages:
+            continue  # done
+        if waiting:
+            for i in deliv:
+                m = net[i]
+                if m[0] != K_REP or m[2] != c:
+                    continue
+                rest = net[:i] + net[i + 1:]
+                true_att, s = m[3], m[1]
+                if true_att != att:
+                    if sem.attempt_echoed and sem.attempt_checked:
+                        # stale reply detected and dropped (consumed)
+                        out.append((clients, servers, rest, avail))
+                        continue
+                    viol.setdefault(
+                        "MPT011",
+                        f"[{cfg.label}] client {c} assembled a reply "
+                        f"generated for attempt {true_att} into its live "
+                        f"attempt {att} — "
+                        + (
+                            "the echoed attempt id is never compared "
+                            "to the live one"
+                            if sem.attempt_echoed
+                            else "replies carry no attempt id, so stale "
+                            "ones are indistinguishable from fresh"
+                        ),
+                    )
+                pend2 = pending - {s}
+                if pend2:
+                    cl2 = (stage, True, att, retries, pend2)
+                else:
+                    cl2 = (stage + 1, False, att, 0, frozenset())
+                out.append((_set(clients, c, cl2), servers, rest, avail))
+            if sem.reply_recv_timeout and _starved(
+                net, c, att, pending, sem
+            ):
+                if retries < cfg.max_retries:
+                    att2 = att + 1
+                    reqs = [
+                        (K_REQ, c, s, att2, 0, 0) for s in sorted(pending)
+                    ]
+                    cl2 = (stage, True, att2, retries + 1, pending)
+                    for added, av2 in _variants(
+                        reqs, avail, cfg.kinds, points
+                    ):
+                        out.append(
+                            (_set(clients, c, cl2), servers, net + added,
+                             av2)
+                        )
+                else:
+                    # retries exhausted: skip the round (the ps_roles
+                    # graceful-degradation path), resume next round
+                    stage2 = (stage // steps + 1) * steps
+                    cl2 = (stage2, False, att, 0, frozenset())
+                    out.append(
+                        (_set(clients, c, cl2), servers, net, avail)
+                    )
+            continue
+        if stage == n_stages:
+            msgs = tuple(
+                (K_STOP, c, s, 0, 0, 0) for s in range(cfg.servers)
+            )
+            cl2 = (stage + 1, False, att, 0, frozenset())
+            out.append((_set(clients, c, cl2), servers, net + msgs, avail))
+        elif cfg.script[stage % steps] == "fetch":
+            att2 = att + 1
+            reqs = [(K_REQ, c, s, att2, 0, 0) for s in range(cfg.servers)]
+            cl2 = (
+                stage, True, att2, 0, frozenset(range(cfg.servers))
+            )
+            for added, av2 in _variants(reqs, avail, cfg.kinds, points):
+                out.append((_set(clients, c, cl2), servers, net + added,
+                            av2))
+        else:  # push
+            seq = stage // steps + 1
+            msgs = [(K_PUSH, c, s, seq, 0, 0) for s in range(cfg.servers)]
+            cl2 = (stage + 1, False, att, 0, frozenset())
+            for added, av2 in _variants(msgs, avail, cfg.kinds, points):
+                out.append((_set(clients, c, cl2), servers, net + added,
+                            av2))
+    return out
+
+
+def _terminal(state, cfg) -> bool:
+    clients, servers, _net, _avail = state
+    n_stages = cfg.rounds * len(cfg.script)
+    all_clients = frozenset(range(cfg.clients))
+    return all(cl[0] > n_stages for cl in clients) and all(
+        sv[0] == all_clients for sv in servers
+    )
+
+
+def _describe_stuck(state, cfg) -> str:
+    clients, servers, net, _avail = state
+    blocked = [
+        f"client {c} waiting on server(s) {sorted(cl[4])} "
+        f"(attempt {cl[2]})"
+        for c, cl in enumerate(clients)
+        if cl[1]
+    ]
+    waiting_servers = [
+        f"server {s} missing STOP from {sorted(frozenset(range(cfg.clients)) - sv[0])}"
+        for s, sv in enumerate(servers)
+        if sv[0] != frozenset(range(cfg.clients))
+    ]
+    inflight = ", ".join(
+        f"{_KIND_LABEL[m[0]]} {m[1]}->{m[2]}" for m in net
+    ) or "none"
+    return (
+        f"[{cfg.label}] reachable state where nothing can move: "
+        + "; ".join(blocked + waiting_servers)
+        + f" (in flight: {inflight})"
+    )
+
+
+def check(sem: ModelSemantics, cfg: Optional[ModelConfig] = None
+          ) -> CheckResult:
+    """Exhaustively explore one configuration. Every violation dict entry
+    carries its first witness; ``states`` is the visited-set size (the
+    exhaustiveness receipt the CLI prints)."""
+    cfg = cfg or ModelConfig()
+    clients0 = tuple(
+        (0, False, 0, 0, frozenset()) for _ in range(cfg.clients)
+    )
+    servers0 = tuple(
+        (
+            frozenset(),
+            frozenset(),
+            tuple((0, frozenset()) for _ in range(cfg.clients)),
+        )
+        for _ in range(cfg.servers)
+    )
+    init = (clients0, servers0, (), True)
+    visited = {init}
+    stack = [init]
+    viol: dict = {}
+    points: set = set()
+    truncated = False
+    while stack:
+        st = stack.pop()
+        succ = _successors(st, sem, cfg, viol, points)
+        if not succ:
+            if not _terminal(st, cfg):
+                viol.setdefault("MPT010", _describe_stuck(st, cfg))
+            continue
+        for s2 in succ:
+            s2 = (s2[0], s2[1], _canon(s2[2]), s2[3])
+            if s2 in visited:
+                continue
+            if len(visited) >= cfg.max_states:
+                truncated = True
+                continue
+            visited.add(s2)
+            stack.append(s2)
+    return CheckResult(
+        config=cfg,
+        states=len(visited),
+        fault_points=len(points),
+        violations=viol,
+        truncated=truncated,
+    )
+
+
+def check_all(sem: ModelSemantics, configs=None) -> list:
+    """One CheckResult per configuration (default: the acceptance pair)."""
+    configs = configs or default_configs(sem.has_push)
+    return [check(sem, cfg) for cfg in configs]

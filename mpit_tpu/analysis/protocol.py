@@ -144,13 +144,15 @@ def _tag_value(graph, info, node) -> tuple:
     """(resolved | None, is_wildcard). Unresolvable -> (None, False)."""
     if node is None:
         return None, True  # recv() default tag is ANY_TAG
-    val = astutil.int_constant(node)
-    if val is None:
-        dotted = astutil.dotted_name(node)
-        if dotted is not None:
-            if dotted.split(".")[-1] in _WILDCARD_NAMES:
-                return None, True
-            val = graph.resolve_constant(info, dotted)
+    dotted = astutil.dotted_name(node)
+    if dotted is not None and dotted.split(".")[-1] in _WILDCARD_NAMES:
+        return None, True
+    # the graph folds literal arithmetic AND resolves names through the
+    # import graph, so ``TAG_BASE + 1`` and ``pserver.TAG_PARAM`` both
+    # land on integers here
+    val = graph.resolve_constant(info, node)
+    if not isinstance(val, int) or isinstance(val, bool):
+        return None, False
     if val == -1:
         return None, True
     return val, False
@@ -300,3 +302,359 @@ def extract_roles(project) -> dict:
         model.rels.append(mod.rel)
         model.ops.extend(extract_module_ops(mod, graph))
     return roles
+
+
+# ---------------------------------------------------------------------------
+# protocol *semantics* — the fault-tolerance machinery behind the tag model
+#
+# The role model above answers "which tags cross the wire"; the model
+# checker (analysis/mcheck.py) additionally needs "what the protocol DOES
+# about faults": whether FETCH attempt ids are echoed in the PARAM reply
+# and checked by the client, whether the reply wait has a timeout escape,
+# and the exact shape of the server's push dedup window. All of it is
+# extracted syntactically from the same marked modules — recognized
+# idioms, never imports — and anything that doesn't match a modeled idiom
+# degrades conservatively (``None`` / opaque, meaning "don't check what
+# you can't see").
+
+
+@dataclasses.dataclass(frozen=True)
+class DedupSemantics:
+    """The server-side sliding dedup window, as written.
+
+    Recognized shape (``_DedupWindow.admit`` in ``parallel/pserver.py``):
+    a method literally named ``admit`` whose last parameter is the
+    sequence number, rejecting on a boundary comparison against
+    ``high - size`` plus a membership test on the seen-set.
+    ``rejects_at_boundary`` is the off-by-one bit: ``seq <= high - size``
+    (True, correct — a seq AT the boundary is rejected) vs ``seq <
+    high - size`` (False — the boundary seq is re-admitted after the
+    seen-set pruned past it, the classic window off-by-one)."""
+
+    rel: str
+    line: int
+    col: int
+    symbol: str
+    rejects_at_boundary: bool
+    checks_seen: bool
+    prunes_seen: bool
+    window_default: Optional[int]
+
+
+@dataclasses.dataclass(frozen=True)
+class ProtocolSemantics:
+    """Everything the model checker needs about one client/server pair."""
+
+    client_role: str
+    server_role: str
+    request_tag: int  # dispatch branch that sends the reply (FETCH)
+    reply_tag: int  # server-sent, client-recv'd concretely (PARAM)
+    push_tags: tuple  # dispatch branches feeding the dedup admit
+    stop_tag: Optional[int]
+    attempt_echoed: bool  # reply tuple carries the request's payload back
+    attempt_checked: bool  # client compares the echoed id to the live one
+    reply_recv_timeout: bool  # the reply recv can time out (retry escape)
+    dedup: Optional[DedupSemantics]
+    dedup_opaque: bool  # an admit exists but matches no modeled idiom
+    reply_send: Optional[ProtoOp]  # anchors for findings
+    reply_recv: Optional[ProtoOp]
+
+    @property
+    def has_fault_machinery(self) -> bool:
+        """Does this protocol *claim* fault tolerance? Only then is there
+        anything for the model checker to verify — a bare request/reply
+        fixture without attempt ids or dedup has no failure semantics,
+        and flagging it for lacking them would drown MPT008's signal."""
+        return self.attempt_echoed or self.dedup is not None
+
+
+def _enclosing_function(node, parents):
+    cur = parents.get(node)
+    while cur is not None:
+        if isinstance(cur, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            return cur
+        cur = parents.get(cur)
+    return None
+
+
+def _is_transport_send(call: ast.Call) -> bool:
+    return (
+        astutil.call_last_name(call) in _SEND_NAMES
+        and len(call.args) + len(call.keywords) >= 3
+    )
+
+
+def _classify_dispatch(server, by_rel, graph, reply_tag):
+    """(request_tag, push_tags, stop_tag) from the server's dispatch Ifs:
+    the branch that sends the reply is the request; branches feeding an
+    ``admit``-named call are pushes; a branch recording the source in a
+    set (``.add``) is the stop."""
+    request_tag = None
+    push_tags: set = set()
+    stop_tag = None
+    for rel in server.rels:
+        mod = by_rel.get(rel)
+        if mod is None:
+            continue
+        info = graph.module_for_rel(rel)
+        for node in ast.walk(mod.tree):
+            if not isinstance(node, ast.If) or not isinstance(
+                node.test, ast.Compare
+            ):
+                continue
+            tags = []
+            for _cand, dotted in _dispatch_tag_nodes(node.test):
+                val = graph.resolve_constant(info, dotted)
+                if val is not None:
+                    tags.append(val)
+            if not tags:
+                continue
+            body_calls = [
+                sub
+                for stmt in node.body
+                for sub in ast.walk(stmt)
+                if isinstance(sub, ast.Call)
+            ]
+            sends_reply = any(
+                _is_transport_send(c)
+                and _tag_value(
+                    graph, info, astutil.get_arg(c, 1, "tag")
+                )[0] == reply_tag
+                for c in body_calls
+            )
+            calls_admit = any(
+                "admit" in (astutil.call_last_name(c) or "")
+                for c in body_calls
+            )
+            marks_stopped = any(
+                astutil.call_last_name(c) == "add" for c in body_calls
+            )
+            for t in tags:
+                if sends_reply:
+                    if request_tag is None:
+                        request_tag = t
+                elif calls_admit:
+                    push_tags.add(t)
+                elif marks_stopped and stop_tag is None:
+                    stop_tag = t
+    return request_tag, push_tags, stop_tag
+
+
+def _reply_is_echoed(server, by_rel, graph, reply_tag) -> bool:
+    """Does the function sending the reply build a tuple containing the
+    request's ``.payload`` (the attempt-id echo idiom)?"""
+    for rel in server.rels:
+        mod = by_rel.get(rel)
+        if mod is None:
+            continue
+        info = graph.module_for_rel(rel)
+        for node in ast.walk(mod.tree):
+            if not (
+                isinstance(node, ast.Call) and _is_transport_send(node)
+            ):
+                continue
+            val, _w = _tag_value(
+                graph, info, astutil.get_arg(node, 1, "tag")
+            )
+            if val != reply_tag:
+                continue
+            scope = _enclosing_function(node, mod.parents) or mod.tree
+            for sub in ast.walk(scope):
+                if isinstance(sub, ast.Tuple) and any(
+                    isinstance(e, ast.Attribute) and e.attr == "payload"
+                    for e in sub.elts
+                ):
+                    return True
+    return False
+
+
+def _client_reply_handling(client, by_rel, graph, reply_tag):
+    """(attempt_checked, reply_recv_timeout) from the client function(s)
+    blocking on the reply tag: a ``timeout=`` argument on the recv is the
+    deadlock escape; a Name-vs-Name ==/!= comparison in the same function
+    is the attempt-id check (``got_id != attempt_id``)."""
+    checked = False
+    has_timeout = False
+    for rel in client.rels:
+        mod = by_rel.get(rel)
+        if mod is None:
+            continue
+        info = graph.module_for_rel(rel)
+        for node in ast.walk(mod.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            if astutil.call_last_name(node) not in _RECV_NAMES:
+                continue
+            val, wild = _tag_value(
+                graph, info, astutil.get_arg(node, 1, "tag")
+            )
+            if wild or val != reply_tag:
+                continue
+            to = astutil.get_arg(node, 2, "timeout")
+            if to is not None and not (
+                isinstance(to, ast.Constant) and to.value is None
+            ):
+                has_timeout = True
+            scope = _enclosing_function(node, mod.parents) or mod.tree
+            for sub in ast.walk(scope):
+                if (
+                    isinstance(sub, ast.Compare)
+                    and len(sub.ops) == 1
+                    and isinstance(sub.ops[0], (ast.Eq, ast.NotEq))
+                    and isinstance(sub.left, ast.Name)
+                    and isinstance(sub.comparators[0], ast.Name)
+                ):
+                    checked = True
+    return checked, has_timeout
+
+
+def _admit_window_default(fn, mod) -> Optional[int]:
+    """The window-size default from the admit method's class ``__init__``
+    (first non-self parameter), when statically visible."""
+    cls = mod.parents.get(fn)
+    while cls is not None and not isinstance(cls, ast.ClassDef):
+        cls = mod.parents.get(cls)
+    if cls is None:
+        return None
+    for node in cls.body:
+        if (
+            isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef))
+            and node.name == "__init__"
+            and node.args.defaults
+        ):
+            return astutil.int_constant(node.args.defaults[-1])
+    return None
+
+
+def _extract_dedup(server, by_rel):
+    """(DedupSemantics | None, found_admit). ``found_admit`` True with a
+    None semantics means "there IS dedup machinery but it matches no
+    modeled idiom" — the checker then assumes it correct rather than
+    absent (resolve-or-skip, the graph's contract)."""
+    for rel in server.rels:
+        mod = by_rel.get(rel)
+        if mod is None:
+            continue
+        for node in ast.walk(mod.tree):
+            if (
+                not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef))
+                or node.name != "admit"
+            ):
+                continue
+            params = [
+                a.arg for a in node.args.posonlyargs + node.args.args
+            ]
+            if not params:
+                continue
+            seq = params[-1]
+            rejects_at_boundary = None
+            checks_seen = False
+            anchor = node
+            for sub in ast.walk(node):
+                if not isinstance(sub, ast.Compare) or len(sub.ops) != 1:
+                    continue
+                op = sub.ops[0]
+                left, right = sub.left, sub.comparators[0]
+                if (
+                    isinstance(op, ast.In)
+                    and isinstance(left, ast.Name)
+                    and left.id == seq
+                ):
+                    checks_seen = True
+                elif (
+                    isinstance(op, (ast.Lt, ast.LtE))
+                    and isinstance(left, ast.Name)
+                    and left.id == seq
+                    and isinstance(right, ast.BinOp)
+                    and isinstance(right.op, ast.Sub)
+                ):
+                    rejects_at_boundary = isinstance(op, ast.LtE)
+                    anchor = sub
+                elif (  # mirrored form: high - size >= seq
+                    isinstance(op, (ast.Gt, ast.GtE))
+                    and isinstance(right, ast.Name)
+                    and right.id == seq
+                    and isinstance(left, ast.BinOp)
+                    and isinstance(left.op, ast.Sub)
+                ):
+                    rejects_at_boundary = isinstance(op, ast.GtE)
+                    anchor = sub
+            if rejects_at_boundary is None:
+                return None, True
+            prunes = any(
+                isinstance(sub, (ast.SetComp, ast.ListComp))
+                for sub in ast.walk(node)
+            )
+            return (
+                DedupSemantics(
+                    rel=mod.rel,
+                    line=anchor.lineno,
+                    col=anchor.col_offset,
+                    symbol=astutil.enclosing_symbol(anchor, mod.parents),
+                    rejects_at_boundary=rejects_at_boundary,
+                    checks_seen=checks_seen,
+                    prunes_seen=prunes,
+                    window_default=_admit_window_default(node, mod),
+                ),
+                True,
+            )
+    return None, False
+
+
+def extract_semantics(project) -> Optional[ProtocolSemantics]:
+    """The modeled client/server pair's fault semantics, or None when the
+    scan set has no recognizable request/reply protocol (no role pair, no
+    unique reply tag, or no dispatch branch answering a request)."""
+    roles = extract_roles(project)
+    client = server = None
+    for name in sorted(roles):
+        cand = roles[name]
+        cp = roles.get(cand.counterpart)
+        if cp is None or not cand.has_wildcard_recv:
+            continue
+        client, server = cp, cand
+        break
+    if server is None:
+        return None
+    reply_tags = server.sent_tags & {
+        op.tag for op in client.concrete_recvs
+    }
+    if len(reply_tags) != 1:
+        return None
+    reply_tag = next(iter(reply_tags))
+
+    by_rel = {m.rel: m for m in project.modules}
+    graph = project.graph
+    request_tag, push_tags, stop_tag = _classify_dispatch(
+        server, by_rel, graph, reply_tag
+    )
+    if request_tag is None or request_tag not in client.sent_tags:
+        return None
+    attempt_echoed = _reply_is_echoed(server, by_rel, graph, reply_tag)
+    attempt_checked, reply_recv_timeout = _client_reply_handling(
+        client, by_rel, graph, reply_tag
+    )
+    dedup, found_admit = _extract_dedup(server, by_rel)
+
+    def _first(ops):
+        return min(ops, key=lambda op: (op.rel, op.line, op.col), default=None)
+
+    return ProtocolSemantics(
+        client_role=client.role,
+        server_role=server.role,
+        request_tag=request_tag,
+        reply_tag=reply_tag,
+        push_tags=tuple(sorted(push_tags)),
+        stop_tag=stop_tag,
+        attempt_echoed=attempt_echoed,
+        attempt_checked=attempt_checked,
+        reply_recv_timeout=reply_recv_timeout,
+        dedup=dedup,
+        dedup_opaque=found_admit and dedup is None,
+        reply_send=_first(
+            [op for op in server.sends if op.tag == reply_tag]
+        ),
+        reply_recv=_first(
+            [op for op in client.concrete_recvs if op.tag == reply_tag]
+        ),
+    )

@@ -13,6 +13,7 @@ from mpit_tpu.analysis.rules import (
     host_sync,
     jit_signature,
     locks,
+    model_check,
     protocol_roles,
     tags,
     wire_format,
@@ -26,6 +27,7 @@ RULE_MODULES = (
     locks,
     wire_format,
     protocol_roles,
+    model_check,
 )
 
 # rule id -> (title, one-line rationale); the CLI's --list-rules output and

@@ -23,6 +23,7 @@ from mpit_tpu.obs.core import (  # noqa: F401
     write_fault_log,
 )
 from mpit_tpu.obs.merge import (  # noqa: F401
+    diff_summaries,
     merge_to_chrome_trace,
     read_journal,
     summarize,

@@ -2,12 +2,16 @@
 
     python -m mpit_tpu.obs merge RUN_DIR [-o trace.json] [--faults f.jsonl]
     python -m mpit_tpu.obs summary RUN_DIR
+    python -m mpit_tpu.obs summary --diff RUN_A RUN_B
 
 ``RUN_DIR`` is the ``MPIT_OBS_DIR`` of the run (or explicit journal
 files). ``merge`` writes Chrome-trace JSON — open it at
 https://ui.perfetto.dev (or chrome://tracing). With ``--faults`` (or a
 ``faults.jsonl`` sitting in the run dir) chaos faults render as instant
-events on the rank that suffered them. Exit codes: 0 ok, 2 usage/empty.
+events on the rank that suffered them. ``summary --diff`` compares two
+runs stream by stream — per-(peer, tag) message/byte counters and the
+median log2-µs latency bucket — and prints only the streams that moved.
+Exit codes: 0 ok, 2 usage/empty.
 """
 
 from __future__ import annotations
@@ -19,11 +23,34 @@ import os
 import sys
 
 from mpit_tpu.obs.merge import (
+    diff_summaries,
     expand_journal_paths,
     merge_to_chrome_trace,
     summarize,
     trace_ids_by_rank,
 )
+
+
+def _print_diff(rows) -> None:
+    moved = [r for r in rows if not r["same"]]
+    for r in moved:
+        lat = ""
+        if r["delta_p50_bucket"] is not None:
+            lat = (
+                f", p50 bucket {r['p50_bucket_a']} -> "
+                f"{r['p50_bucket_b']}"
+            )
+        print(
+            f"rank {r['rank']} {r['dir']} "
+            f"{'->' if r['dir'] == 'send' else '<-'} peer {r['peer']} "
+            f"{r['tag_name']}: msgs {r['msgs_a']} -> {r['msgs_b']} "
+            f"({r['delta_msgs']:+d}), bytes {r['bytes_a']} -> "
+            f"{r['bytes_b']} ({r['delta_bytes']:+d}){lat}"
+        )
+    print(
+        f"{len(moved)} stream(s) changed, "
+        f"{len(rows) - len(moved)} unchanged"
+    )
 
 
 def main(argv=None) -> int:
@@ -45,8 +72,28 @@ def main(argv=None) -> int:
 
     sp = sub.add_parser("summary", help="per-rank event tallies")
     sp.add_argument("paths", nargs="+")
+    sp.add_argument(
+        "--diff",
+        action="store_true",
+        help="compare exactly two runs stream-by-stream (per-(peer, tag) "
+        "counters + median latency bucket)",
+    )
 
     ns = p.parse_args(argv)
+
+    if ns.cmd == "summary" and ns.diff:
+        if len(ns.paths) != 2:
+            print("summary --diff takes exactly two run dirs",
+                  file=sys.stderr)
+            return 2
+        a, b = ns.paths
+        if not expand_journal_paths([a]) or not expand_journal_paths([b]):
+            print(f"no obs_rank*.jsonl journals under {a} or {b}",
+                  file=sys.stderr)
+            return 2
+        _print_diff(diff_summaries([a], [b]))
+        return 0
+
     journals = expand_journal_paths(ns.paths)
     if not journals:
         print(f"no obs_rank*.jsonl journals under {ns.paths}",

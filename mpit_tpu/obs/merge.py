@@ -238,6 +238,90 @@ def trace_ids_by_rank(journal_paths: Iterable[str]) -> dict[int, set]:
     return out
 
 
+def _lat_bucket(seconds: float) -> int:
+    # ceil(log2(µs)); bucket b holds (2^(b-1), 2^b] µs — kept in lockstep
+    # with mpit_tpu.obs.telemetry._lat_bucket, replicated here so the
+    # merger stays importable without the transport stack
+    return max(0, int(seconds * 1e6)).bit_length()
+
+
+def _stream_stats(journal_paths: Iterable[str]) -> dict:
+    """(rank, dir, peer, tag) -> {msgs, bytes, hist} from the journals:
+    ``dir`` is "send"/"recv", ``peer`` the remote rank, ``hist`` the
+    log2-µs latency histogram (send duration / recv blocked wait)."""
+    out: dict[tuple, dict] = {}
+    for path in expand_journal_paths(journal_paths):
+        for rec in read_journal(path):
+            ev = rec.get("ev")
+            if ev in ("send", "isend"):
+                key = (_rec_rank(rec), "send", rec.get("dst"),
+                       rec.get("mtag"))
+                lat = rec.get("dur")
+            elif ev == "recv":
+                key = (_rec_rank(rec), "recv", rec.get("src"),
+                       rec.get("mtag"))
+                lat = rec.get("wait")
+            else:
+                continue
+            s = out.setdefault(key, {"msgs": 0, "bytes": 0, "hist": {}})
+            s["msgs"] += 1
+            s["bytes"] += rec.get("bytes", 0)
+            if lat is not None:
+                b = _lat_bucket(lat)
+                s["hist"][b] = s["hist"].get(b, 0) + 1
+    return out
+
+
+def _hist_p50(hist: dict) -> Optional[int]:
+    """Median latency bucket — the scalar each stream's histograms are
+    compared by (a whole-bucket shift = a 2x latency regression)."""
+    total = sum(hist.values())
+    if not total:
+        return None
+    seen = 0
+    for b in sorted(hist):
+        seen += hist[b]
+        if 2 * seen >= total:
+            return b
+    return max(hist)
+
+
+def diff_summaries(
+    run_a: Iterable[str], run_b: Iterable[str]
+) -> list[dict]:
+    """Per-(rank, dir, peer, tag) stream comparison of two runs — message
+    and byte counts plus the median latency bucket. One row per stream
+    present in either run, sorted; ``delta_*`` is b - a (missing stream =
+    zeros/None). Rows where nothing moved carry ``same: True`` so callers
+    can filter to the interesting ones."""
+    a, b = _stream_stats(run_a), _stream_stats(run_b)
+    rows = []
+    for key in sorted(set(a) | set(b), key=str):
+        rank, direction, peer, tag = key
+        sa = a.get(key, {"msgs": 0, "bytes": 0, "hist": {}})
+        sb = b.get(key, {"msgs": 0, "bytes": 0, "hist": {}})
+        pa, pb = _hist_p50(sa["hist"]), _hist_p50(sb["hist"])
+        rows.append({
+            "rank": rank,
+            "dir": direction,
+            "peer": peer,
+            "tag": tag,
+            "tag_name": _tag_name(tag),
+            "msgs_a": sa["msgs"], "msgs_b": sb["msgs"],
+            "delta_msgs": sb["msgs"] - sa["msgs"],
+            "bytes_a": sa["bytes"], "bytes_b": sb["bytes"],
+            "delta_bytes": sb["bytes"] - sa["bytes"],
+            "p50_bucket_a": pa, "p50_bucket_b": pb,
+            "delta_p50_bucket": (
+                pb - pa if pa is not None and pb is not None else None
+            ),
+            "same": sa["msgs"] == sb["msgs"]
+            and sa["bytes"] == sb["bytes"]
+            and pa == pb,
+        })
+    return rows
+
+
 def summarize(journal_paths: Iterable[str]) -> dict:
     """Per-rank event/byte tallies for the ``summary`` subcommand."""
     out: dict[int, dict] = {}

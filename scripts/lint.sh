@@ -6,6 +6,15 @@
 #   scripts/lint.sh --fix           # rewrite fixable MPT002 sites, then gate
 #   scripts/lint.sh path/to/file.py # lint specific paths (vs the baseline)
 #
+# The default run is three gates behind the one baseline:
+#   1. the static lint (MPT001-008) + protocol model check (MPT009-011);
+#   2. an explicit `mcheck` pass, so the exhaustive state counts land in
+#      the CI log even when everything is green;
+#   3. a smoke `conform` pass over the checked-in good-run journals —
+#      the trace-conformance path (TC201-203) exercised on every lint.
+# The whole default run is bounded to < 15 s wall-clock
+# (tests/test_lint_gate.py enforces it).
+#
 # Exit codes: 0 clean vs baseline, 1 new findings, 2 usage error.
 # The linter parses, never imports, the scanned code and initializes no
 # jax backend — safe for pre-commit hooks.
@@ -23,4 +32,10 @@ if [[ "${1:-}" == "--fix" ]]; then
     exec python -m mpit_tpu.analysis --fix "${@:-mpit_tpu/}"
 fi
 
-exec python -m mpit_tpu.analysis "${@:-mpit_tpu/}"
+python -m mpit_tpu.analysis "${@:-mpit_tpu/}"
+
+# explicit-path gates only make sense for the default whole-package run
+if [[ $# -eq 0 ]]; then
+    python -m mpit_tpu.analysis mcheck
+    python -m mpit_tpu.analysis conform tests/fixtures/conformance/good_run
+fi

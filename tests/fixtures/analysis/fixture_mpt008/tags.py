@@ -2,4 +2,4 @@
 
 TAG_REQ = 11
 TAG_REP = 12
-TAG_ORPHAN = 13
+TAG_ORPHAN = TAG_REP + 1  # derived tag: resolves to 13 only by folding

@@ -1,0 +1,10 @@
+"""Seeded MPT009 package: a dedup window with the classic off-by-one.
+
+A complete miniature PS protocol pair — attempt-id echo and check,
+reply-wait timeout, dispatch for REQ/PUSH/STOP — whose only defect is
+the admit boundary: ``seq < high - size`` where ``<=`` is required, so
+a duplicated push delivered after the window slid past it is admitted
+a second time. The model checker must find the violating fault
+schedule (MPT009) and nothing else. Parsed by the linter tests, never
+imported.
+"""

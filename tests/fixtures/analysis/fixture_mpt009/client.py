@@ -1,0 +1,22 @@
+"""Client role: attempt-id'd fetch (correct), push, stop."""
+
+from fixture_mpt009.tags import TAG_PUSH, TAG_REQ, TAG_REP, TAG_STOP
+
+# mpit-analysis: protocol-role[client->server]
+
+
+def fetch(transport, rank, attempt, deadline):
+    transport.send(rank, TAG_REQ, attempt)
+    while True:
+        got, payload = transport.recv(rank, TAG_REP, timeout=deadline)
+        if got != attempt:
+            continue  # stale reply from a timed-out earlier attempt
+        return payload
+
+
+def push(transport, rank, epoch, seq, delta):
+    transport.send(rank, TAG_PUSH, (epoch, seq, delta))
+
+
+def stop(transport, rank):
+    transport.send(rank, TAG_STOP, None)

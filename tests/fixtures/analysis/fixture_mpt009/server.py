@@ -1,0 +1,38 @@
+"""Server role: dispatch loop whose dedup boundary is off by one."""
+
+from fixture_mpt009.tags import TAG_PUSH, TAG_REQ, TAG_REP, TAG_STOP
+
+# mpit-analysis: protocol-role[server->client]
+
+
+class DedupWindow:
+    def __init__(self, size=4):
+        self.size = size
+        self.high = 0
+        self.seen = set()
+
+    def admit(self, seq):
+        # the seeded defect: strict < re-admits the boundary seq after
+        # the seen-set pruned past it (should be <=)
+        if seq < self.high - self.size:
+            return False
+        if seq in self.seen:
+            return False
+        self.seen.add(seq)
+        if seq > self.high:
+            self.high = seq
+            if len(self.seen) > self.size:
+                self.seen = {s for s in self.seen if s > seq - self.size}
+        return True
+
+
+def serve(transport, center, window, stopped, world):
+    while len(stopped) < world:
+        msg = transport.recv(-1, -1)
+        if msg.tag == TAG_REQ:
+            transport.send(msg.src, TAG_REP, (msg.payload, center))
+        elif msg.tag == TAG_PUSH:
+            if window.admit(msg.payload[1]):
+                center = center + msg.payload[2]
+        elif msg.tag == TAG_STOP:
+            stopped.add(msg.src)

@@ -1,0 +1,6 @@
+"""Tag registry for the seeded dedup-off-by-one protocol."""
+
+TAG_REQ = 21
+TAG_REP = 22
+TAG_PUSH = 23
+TAG_STOP = 24

@@ -1,0 +1,10 @@
+"""Seeded MPT011 package: attempt ids echoed but never checked.
+
+The mirror image of ``fixture_mpt009``: the dedup window is correct
+(``<=`` boundary), the server dutifully echoes the request's attempt id
+in its reply — but the client assembles whatever reply arrives first
+into its live fetch without comparing ids, so a reply delayed past a
+timeout lands in the NEXT attempt's slot. The model checker must find
+the stale-assembly schedule (MPT011) and nothing else. Parsed by the
+linter tests, never imported.
+"""

@@ -1,0 +1,22 @@
+"""Client role: fetch with timeout but NO attempt-id comparison."""
+
+from fixture_mpt011.tags import TAG_PUSH, TAG_REQ, TAG_REP, TAG_STOP
+
+# mpit-analysis: protocol-role[client->server]
+
+
+def fetch(transport, rank, attempt, deadline):
+    transport.send(rank, TAG_REQ, attempt)
+    # the seeded defect: the reply carries the echoed attempt id, but
+    # whatever arrives first is returned — a reply delayed past an
+    # earlier deadline is assembled into this newer fetch
+    got = transport.recv(rank, TAG_REP, timeout=deadline)
+    return got[1]
+
+
+def push(transport, rank, epoch, seq, delta):
+    transport.send(rank, TAG_PUSH, (epoch, seq, delta))
+
+
+def stop(transport, rank):
+    transport.send(rank, TAG_STOP, None)

@@ -1,0 +1,6 @@
+"""Tag registry for the seeded missing-attempt-check protocol."""
+
+TAG_REQ = 21
+TAG_REP = 22
+TAG_PUSH = 23
+TAG_STOP = 24

@@ -1,5 +1,8 @@
-"""Constant registry: literals, folded unary, an assign chain."""
+"""Constant registry: literals, folded unary/binary, an assign chain."""
 
 BASE = 7
 DERIVED = BASE  # assign chain, resolves to 7
 NEG = -1  # UnaryOp(USub) folding
+SHIFTED = BASE + 1  # BinOp over a cross-name operand, folds to 8
+MASK = (1 << 4) | 2  # pure-literal arithmetic, folds to 18
+WIRE = "obs" + "1"  # the one string fold: concatenation

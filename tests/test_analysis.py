@@ -94,6 +94,11 @@ def test_baseline_is_not_stale():
         ("fixture_mpt007.py", "MPT007"),
         ("fixture_mpt008", "MPT008"),
         ("fixture_mpt004_chain", "MPT004"),
+        # model-checked rules: the whole miniature protocol pair is
+        # correct except for the one seeded defect, and the checker has
+        # to find the violating fault schedule (and nothing else)
+        ("fixture_mpt009", "MPT009"),
+        ("fixture_mpt011", "MPT011"),
     ],
 )
 def test_fixture_triggers_exactly_its_rule(fixture, rule):

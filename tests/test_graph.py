@@ -74,6 +74,9 @@ def test_graph_indexes_all_modules(graph):
         ("graph_pkg.consts", "BASE", 7),
         ("graph_pkg.consts", "DERIVED", 7),  # assign chain
         ("graph_pkg.consts", "NEG", -1),  # folded UnaryOp
+        ("graph_pkg.consts", "SHIFTED", 8),  # folded BinOp over a name
+        ("graph_pkg.consts", "MASK", 18),  # pure-literal arithmetic
+        ("graph_pkg.consts", "WIRE", "obs1"),  # string concatenation
         ("graph_pkg.uses", "RENAMED", 7),  # from x import y as z
         ("graph_pkg.uses", "cc.BASE", 7),  # import x.y as z
         ("graph_pkg.uses", "consts.BASE", 7),  # from pkg import module

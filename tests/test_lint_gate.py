@@ -40,12 +40,21 @@ def test_gate_json_exits_clean_with_no_new_findings():
     assert doc["total_scanned"] == doc["baselined"]
 
 
-def test_gate_script_passes():
+def test_gate_script_passes_within_wall_clock_bound():
+    """The full default run — lint + explicit mcheck + smoke conform —
+    must stay green AND inside the 15 s budget the model checker was
+    sized for (its state space is a knob; this test is the governor)."""
+    start = time.monotonic()
     proc = subprocess.run(
         ["bash", str(REPO / "scripts" / "lint.sh")],
         capture_output=True, text=True, cwd=REPO, timeout=120,
     )
+    elapsed = time.monotonic() - start
     assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert elapsed < 15.0, f"lint gate took {elapsed:.1f}s (budget 15s)"
+    # all three gates actually ran: state counts + conformance tally
+    assert "states" in proc.stdout, proc.stdout
+    assert "violation(s)" in proc.stdout, proc.stdout
 
 
 def test_gate_fails_on_a_new_finding(tmp_path):

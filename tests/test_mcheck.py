@@ -1,0 +1,314 @@
+"""Model checker (MPT009-011) + trace conformance (TC201-203).
+
+Three layers, mirroring the subsystem:
+
+- semantics extraction: ``protocol.extract_semantics`` must read the
+  shipped pserver/pclient pair's fault machinery out of the source
+  exactly (attempt echo + check, reply timeout, dedup boundary);
+- the explicit-state checker itself: clean on the shipped semantics,
+  and each seeded single-bit mutation must produce exactly its
+  violation — the model-level counterpart of the fixture packages that
+  ``test_analysis.py`` lints end-to-end;
+- conformance: the checked-in journals of a real chaos run pass, the
+  synthetic violating journal fails with every TC rule represented, and
+  the CLI's exit gate is format-independent.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import json
+import subprocess
+import sys
+from pathlib import Path
+
+import pytest
+
+from mpit_tpu.analysis import astutil, conformance, lint, mcheck, protocol
+
+REPO = Path(__file__).resolve().parent.parent
+PKG = REPO / "mpit_tpu"
+CONF = REPO / "tests" / "fixtures" / "conformance"
+
+
+def _project(*paths):
+    modules = []
+    for ap, rel in lint.collect_files(paths or [PKG]):
+        ctx = lint.load_module(ap, rel)
+        if ctx is not None:
+            modules.append(ctx)
+    return lint.Project(modules=modules, config=lint.Config())
+
+
+@pytest.fixture(scope="module")
+def shipped_sem():
+    sem = protocol.extract_semantics(_project())
+    assert sem is not None
+    return sem
+
+
+def _cli(*args):
+    return subprocess.run(
+        [sys.executable, "-m", "mpit_tpu.analysis", *args],
+        capture_output=True, text=True, cwd=REPO, timeout=180,
+    )
+
+
+# ------------------------------------------------------------- folding
+
+
+@pytest.mark.parametrize(
+    "src, value",
+    [
+        ("2 + 1", 3),
+        ("-1", -1),
+        ("(1 << 4) | 2", 18),
+        ("40 + 2", 42),
+        ("'obs' + '1'", "obs1"),
+        ("7 // 2", 3),
+        ("True", None),  # bools are not tags
+        ("1 + True", None),
+        ("1 // 0", None),  # no fold, no crash
+        ("2 ** 10", None),  # Pow deliberately unfolded
+        ("x + 1", None),  # names are the graph's job
+        ("'a' * 3", None),  # only concatenation folds for strings
+    ],
+)
+def test_fold_constant(src, value):
+    node = ast.parse(src, mode="eval").body
+    assert astutil.fold_constant(node) == value
+
+
+def test_mpt002_fires_on_folded_tag_expression(tmp_path):
+    """The satellite regression: a literal tag written as arithmetic
+    (``40 + 2``) used to be skipped; folding makes it a literal site."""
+    mod = tmp_path / "folded.py"
+    mod.write_text(
+        "def push(transport, payload):\n"
+        "    transport.send(0, 40 + 2, payload)\n"
+    )
+    findings = lint.run_lint([mod], lint.Config(hot_all=True))
+    assert [f.rule for f in findings] == ["MPT002"], [
+        f.format() for f in findings
+    ]
+
+
+# -------------------------------------------------- semantics extraction
+
+
+def test_shipped_semantics_extracted_exactly(shipped_sem):
+    sem = shipped_sem
+    assert (sem.client_role, sem.server_role) == ("client", "server")
+    assert sem.request_tag == 1 and sem.reply_tag == 4
+    assert sem.push_tags == (2, 3) and sem.stop_tag == 5
+    assert sem.attempt_echoed and sem.attempt_checked
+    assert sem.reply_recv_timeout
+    assert sem.dedup is not None and not sem.dedup_opaque
+    assert sem.dedup.rejects_at_boundary  # the <= boundary, as written
+    assert sem.dedup.checks_seen and sem.dedup.prunes_seen
+    assert sem.dedup.window_default == 1024
+    assert sem.dedup.symbol == "_DedupWindow.admit"
+    assert sem.reply_send.rel.endswith("parallel/pserver.py")
+    assert sem.reply_recv.rel.endswith("parallel/pclient.py")
+
+
+# ----------------------------------------------------- the model checker
+
+
+def test_shipped_protocol_is_clean_and_exhaustive(shipped_sem):
+    """The acceptance bar: both default configurations explored to
+    fixpoint, no violations, a real state count reported, and every
+    fault kind contributing schedules."""
+    results = mcheck.check_all(mcheck.from_protocol(shipped_sem))
+    assert [r.config.algo for r in results] == ["easgd", "downpour"]
+    for r in results:
+        assert r.ok, (r.config.algo, r.violations)
+        assert not r.truncated
+        assert r.states > 10_000  # exhaustive, not a smoke walk
+        assert r.fault_points >= len(r.config.kinds)
+
+
+def _mutate(sem, **kw):
+    base = mcheck.from_protocol(sem)
+    dk = kw.pop("dedup_kw", None)
+    if dk:
+        kw["dedup"] = dataclasses.replace(base.dedup, **dk)
+    return dataclasses.replace(base, **kw)
+
+
+@pytest.mark.parametrize(
+    "mutation, rule",
+    [
+        # dedup boundary off-by-one: < where <= is needed
+        ({"dedup_kw": {"rejects_at_boundary": False}}, "MPT009"),
+        # seen-set membership test removed entirely
+        ({"dedup_kw": {"checks_seen": False}}, "MPT009"),
+        # reply wait can block forever: a dropped REQ deadlocks the run
+        ({"reply_recv_timeout": False}, "MPT010"),
+        # echoed attempt id never compared to the live one
+        ({"attempt_checked": False}, "MPT011"),
+        # no attempt id on the wire at all
+        ({"attempt_echoed": False, "attempt_checked": False}, "MPT011"),
+    ],
+)
+def test_single_bit_mutations_each_caught(shipped_sem, mutation, rule):
+    bad = _mutate(shipped_sem, **mutation)
+    results = mcheck.check_all(bad)
+    hit = {r_ for res in results for r_ in res.violations}
+    assert rule in hit, (mutation, [res.violations for res in results])
+
+
+def test_opaque_dedup_is_trusted_not_flagged(shipped_sem):
+    """Resolve-or-skip: an admit the extractor can't parse must be
+    assumed correct, not modeled as absent (which would always produce
+    a spurious MPT009)."""
+    opaque = dataclasses.replace(
+        mcheck.from_protocol(shipped_sem), dedup=None, dedup_opaque=True
+    )
+    for res in mcheck.check_all(opaque):
+        assert "MPT009" not in res.violations, res.violations
+
+
+def test_checker_counts_distinct_fault_schedules(shipped_sem):
+    r = mcheck.check(mcheck.from_protocol(shipped_sem))
+    # drop/dup/reorder on every REQ/PUSH send point + stale on replies:
+    # well above one per kind, and recorded per (kind, message)
+    assert r.fault_points > 10
+
+
+# ---------------------------------------------------------- conformance
+
+
+def test_good_run_conforms():
+    """Journals checked in from a real 3-rank socket run under
+    MPIT_CHAOS_DUP — duplicated deliveries must be explained by the
+    fault log, not flagged."""
+    report = conformance.check_conformance(
+        str(CONF / "good_run"), _project()
+    )
+    assert report.ok, [str(v) for v in report.violations]
+    assert report.sends > 0 and report.recvs > 0
+    assert report.faults > 0  # the chaos log was found and used
+
+
+def test_bad_run_rejected_on_every_axis():
+    report = conformance.check_conformance(
+        str(CONF / "bad_run"), _project()
+    )
+    rules = sorted({v.rule for v in report.violations})
+    assert rules == ["TC201", "TC202", "TC203"], [
+        str(v) for v in report.violations
+    ]
+
+
+def test_orphan_reply_licensed_by_dup_request_fault(tmp_path):
+    """A duplicated FETCH makes the server send an extra PARAM the
+    client may exit without draining — the deficit on the reply stream
+    must be licensed by the dup fault on the reverse request stream
+    (seen live on a MPIT_CHAOS_DUP seed), and must still be flagged
+    when no fault log explains it."""
+    (tmp_path / "obs_rank1.jsonl").write_text(
+        '{"ev": "send", "rank": 1, "t": 1.0, "step": 1, "dst": 0,'
+        ' "mtag": 1, "n": 0, "bytes": 8, "dur": 0.001}\n'
+        '{"ev": "recv", "rank": 1, "t": 1.3, "step": 4, "src": 0,'
+        ' "mtag": 4, "n": 0, "bytes": 64, "wait": 0.001}\n'
+    )
+    (tmp_path / "obs_rank0.jsonl").write_text(
+        '{"ev": "recv", "rank": 0, "t": 1.1, "step": 2, "src": 1,'
+        ' "mtag": 1, "n": 0, "bytes": 8, "wait": 0.001}\n'
+        '{"ev": "recv", "rank": 0, "t": 1.1, "step": 3, "src": 1,'
+        ' "mtag": 1, "n": 1, "bytes": 8, "wait": 0.001}\n'
+        '{"ev": "send", "rank": 0, "t": 1.2, "step": 4, "dst": 1,'
+        ' "mtag": 4, "n": 0, "bytes": 64, "dur": 0.001}\n'
+        '{"ev": "send", "rank": 0, "t": 1.2, "step": 5, "dst": 1,'
+        ' "mtag": 4, "n": 1, "bytes": 64, "dur": 0.001}\n'
+    )
+    proj = _project()
+    report = conformance.check_conformance(str(tmp_path), proj)
+    rules = [v.rule for v in report.violations]
+    assert rules == ["TC202", "TC202"], [str(v) for v in report.violations]
+
+    (tmp_path / "faults_rank1.jsonl").write_text(
+        '{"ev": "fault", "kind": "duplicate", "src": 1, "dst": 0,'
+        ' "tag": 1, "n": 0}\n'
+    )
+    report = conformance.check_conformance(str(tmp_path), proj)
+    assert report.ok, [str(v) for v in report.violations]
+
+
+def test_conform_cli_gate():
+    good = _cli("conform", str(CONF / "good_run"))
+    assert good.returncode == 0, good.stdout + good.stderr
+    bad = _cli("conform", str(CONF / "bad_run"), "--json")
+    assert bad.returncode == 1, bad.stdout + bad.stderr
+    doc = json.loads(bad.stdout)
+    assert {v["rule"] for v in doc["violations"]} == {
+        "TC201", "TC202", "TC203"
+    }
+    missing = _cli("conform", str(CONF / "nonexistent"))
+    assert missing.returncode == 2
+
+
+def test_mcheck_cli_reports_state_counts():
+    proc = _cli("mcheck", "--json")
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    doc = json.loads(proc.stdout)
+    assert len(doc) == 2
+    for entry in doc:
+        assert entry["violations"] == {}
+        assert entry["states"] > 10_000
+        assert not entry["truncated"]
+
+
+# ------------------------------------------------ exit-gate consistency
+
+
+def test_json_flag_gate_matches_text_mode(tmp_path):
+    """The satellite fix: ``--json`` used to exit 2 (unknown flag) while
+    text mode exited 1 on the same findings — the gate must not depend
+    on the output format."""
+    bad = tmp_path / "drifted.py"
+    bad.write_text(
+        "def push_update(transport, payload):\n"
+        "    transport.send(0, 42, payload)\n"
+    )
+    codes = {}
+    for label, args in {
+        "text": (),
+        "format_json": ("--format", "json"),
+        "json_flag": ("--json",),
+    }.items():
+        codes[label] = _cli("--no-baseline", *args, str(bad)).returncode
+    assert codes == {"text": 1, "format_json": 1, "json_flag": 1}, codes
+
+
+# ------------------------------------------- end-to-end (slow, 2 procs)
+
+
+@pytest.mark.slow
+def test_two_process_chaos_run_conforms(tmp_path):
+    """Full loop: launch the MNIST PS example as OS processes over TCP
+    with dup-only chaos and obs armed, then audit the fresh journals
+    with the conformance checker. Dup-only keeps the run fast (drops
+    would ride out the client's default reply timeout)."""
+    import os
+
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env.pop("MPIT_RANK", None)
+    env.pop("MPIT_WORLD_SIZE", None)
+    env["MPIT_OBS_DIR"] = str(tmp_path)
+    env["MPIT_CHAOS_DUP"] = "0.25"
+    env["MPIT_CHAOS_SEED"] = "7"
+    r = subprocess.run(
+        [sys.executable, "-m", "mpit_tpu.launch", "-n", "3",
+         str(REPO / "examples" / "ptest_proc.py"),
+         "--model", "mlp", "--steps", "8", "--train-size", "256",
+         "--algo", "ps-easgd"],
+        cwd=REPO, env=env, capture_output=True, text=True, timeout=240,
+    )
+    assert r.returncode == 0, r.stdout + r.stderr
+    report = conformance.check_conformance(str(tmp_path), _project())
+    assert report.ok, [str(v) for v in report.violations]
+    assert report.faults > 0, "chaos produced no faults — raise DUP rate"

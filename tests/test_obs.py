@@ -23,6 +23,7 @@ from mpit_tpu.obs import (
     Journal,
     ObsConfig,
     config_from_env,
+    diff_summaries,
     maybe_wrap,
     merge_to_chrome_trace,
     read_journal,
@@ -298,6 +299,67 @@ class TestMerge:
         s = summarize([str(tmp_path)])
         assert s[0]["sends"] == 1 and s[0]["recvs"] == 1
         assert s[0]["bytes"] == 10 and s[0]["traces"] == 1
+
+    def test_diff_summaries_streams_and_latency(self, tmp_path):
+        """Two synthetic runs: one stream doubles its message count, one
+        regresses its latency by 4x (two whole log2 buckets), one is
+        identical — the diff must report exactly the first two."""
+        run_a, run_b = tmp_path / "a", tmp_path / "b"
+        run_a.mkdir(), run_b.mkdir()
+        self._write_rank(run_a, 1, [
+            ("send", 1, {"dst": 0, "mtag": 2, "n": 0, "bytes": 10,
+                         "dur": 0.001}),
+            ("recv", 2, {"src": 0, "mtag": 4, "n": 0, "bytes": 5,
+                         "wait": 0.004}),
+            ("send", 3, {"dst": 0, "mtag": 5, "n": 0, "bytes": 1,
+                         "dur": 0.001}),
+        ])
+        self._write_rank(run_b, 1, [
+            ("send", 1, {"dst": 0, "mtag": 2, "n": 0, "bytes": 10,
+                         "dur": 0.001}),
+            ("send", 2, {"dst": 0, "mtag": 2, "n": 1, "bytes": 10,
+                         "dur": 0.001}),
+            ("recv", 3, {"src": 0, "mtag": 4, "n": 0, "bytes": 5,
+                         "wait": 0.016}),  # 4x slower: +2 buckets
+            ("send", 4, {"dst": 0, "mtag": 5, "n": 0, "bytes": 1,
+                         "dur": 0.001}),
+        ])
+        rows = diff_summaries([str(run_a)], [str(run_b)])
+        by_key = {(r["dir"], r["tag"]): r for r in rows}
+        grew = by_key[("send", 2)]
+        assert (grew["msgs_a"], grew["msgs_b"]) == (1, 2)
+        assert grew["delta_msgs"] == 1 and grew["delta_bytes"] == 10
+        assert not grew["same"]
+        slower = by_key[("recv", 4)]
+        assert slower["delta_msgs"] == 0
+        assert slower["delta_p50_bucket"] == 2
+        assert not slower["same"]
+        assert by_key[("send", 5)]["same"]
+
+    def test_cli_summary_diff(self, tmp_path, capsys):
+        run_a, run_b = tmp_path / "a", tmp_path / "b"
+        run_a.mkdir(), run_b.mkdir()
+        self._write_rank(run_a, 0, [
+            ("send", 1, {"dst": 1, "mtag": 1, "n": 0, "bytes": 4,
+                         "dur": 0.001}),
+        ])
+        self._write_rank(run_b, 0, [
+            ("send", 1, {"dst": 1, "mtag": 1, "n": 0, "bytes": 4,
+                         "dur": 0.001}),
+            ("send", 2, {"dst": 1, "mtag": 1, "n": 1, "bytes": 4,
+                         "dur": 0.001}),
+        ])
+        assert obs_main(["summary", "--diff", str(run_a), str(run_b)]) == 0
+        out = capsys.readouterr().out
+        assert "msgs 1 -> 2 (+1)" in out
+        assert "1 stream(s) changed" in out
+        # exactly two run dirs, both non-empty — anything else is usage
+        assert obs_main(["summary", "--diff", str(run_a)]) == 2
+        empty = tmp_path / "empty"
+        empty.mkdir()
+        assert obs_main(
+            ["summary", "--diff", str(run_a), str(empty)]
+        ) == 2
 
     def test_cli_merge_and_empty_dir(self, tmp_path, capsys):
         empty = tmp_path / "empty"
