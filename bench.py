@@ -38,7 +38,13 @@ def _honor_platform_env():
         repin_platform(os.environ["JAX_PLATFORMS"])
 
 
-def _backend_alive(timeout: float = 180.0, attempts: int = 2) -> bool:
+# one probe per process: the verdict cannot change mid-run, and a --all
+# sweep re-probing before every leg would burn 2x180 s per preset on a
+# dead tunnel. "seconds" is the wall-clock the probe cost this process.
+_PROBE_CACHE: dict = {}
+
+
+def _backend_alive(timeout: float = None, attempts: int = 2) -> bool:
     """Probe the default backend in a TIME-LIMITED subprocess (kill-safe
     pattern shared in ``mpit_tpu.utils.vmesh.run_bounded``).
 
@@ -46,14 +52,42 @@ def _backend_alive(timeout: float = 180.0, attempts: int = 2) -> bool:
     TPU tunnel is down (observed 2026-07-29); a benchmark that hangs
     produces no JSON line at all. A generous timeout plus one retry keeps a
     merely-slow cold tunnel (or one transient plugin error) from silently
-    downgrading a real benchmark run to CPU smoke numbers."""
+    downgrading a real benchmark run to CPU smoke numbers.
+
+    The verdict is cached per process, the per-attempt timeout honors
+    ``MPIT_BENCH_PROBE_TIMEOUT`` (seconds, default 180), and the probe's
+    wall-clock cost lands in the JSON line as ``probe_seconds`` so the
+    fallback's 2x-timeout burn is visible instead of silent."""
+    if "ok" in _PROBE_CACHE:
+        return _PROBE_CACHE["ok"]
+    if timeout is None:
+        timeout = float(os.environ.get("MPIT_BENCH_PROBE_TIMEOUT", "180"))
     from mpit_tpu.utils.vmesh import run_bounded
 
-    return any(
+    t0 = time.perf_counter()
+    ok = any(
         run_bounded("import jax; jax.devices()", timeout=timeout, quiet=True)
         == 0
         for _ in range(attempts)
     )
+    _PROBE_CACHE["ok"] = ok
+    _PROBE_CACHE["seconds"] = round(time.perf_counter() - t0, 3)
+    return ok
+
+
+def _probe_tag() -> dict:
+    """``{"probe_seconds": N}`` for the JSON line, when a probe ran — in
+    this process, or (after the cpu re-exec) in the parent, whose cost
+    rides in on MPIT_BENCH_PROBE_SECONDS."""
+    secs = _PROBE_CACHE.get("seconds")
+    if secs is None:
+        env = os.environ.get("MPIT_BENCH_PROBE_SECONDS")
+        if env:
+            try:
+                secs = float(env)
+            except ValueError:
+                secs = None
+    return {"probe_seconds": secs} if secs is not None else {}
 
 
 def _force_completion(state, m) -> float:
@@ -65,6 +99,25 @@ def _force_completion(state, m) -> float:
     from mpit_tpu.utils.profiling import force_completion
 
     return force_completion(state, m)
+
+
+def _leg_phases(raw_dt: float, dt: float) -> dict:
+    """Roofline phase fractions for a collective timed leg (the schema
+    docs/OBSERVABILITY.md §roofline defines; ``phase_source:
+    "timed-leg"``). The collective trainers run compute and collective
+    transfer fused inside one XLA program, so the leg cannot split wire
+    from compute — the honest attribution is: corrected time is compute
+    (which here INCLUDES in-program collectives), the subtracted fetch
+    RTT is harness overhead, wire/idle are unmeasured zeros. The
+    host-async PS bench reports the real four-way split from its obs
+    journals instead (``phase_source: "obs"``)."""
+    compute = min(dt / raw_dt, 1.0) if raw_dt > 0 else 0.0
+    return {
+        "compute": round(compute, 4),
+        "wire": 0.0,
+        "idle": 0.0,
+        "overhead": round(1.0 - compute, 4),
+    }
 
 
 _MEASUREMENTS = os.path.join(
@@ -342,6 +395,9 @@ def _stage_and_time(
         "timed_samples": samples,
         "timed_seconds": round(samples / rate, 3),
         "repeats": len(leg_rates),
+        # phase split of the last calibration leg (raw vs corrected time)
+        "phases": _leg_phases(raw_dt, dt),
+        "phase_source": "timed-leg",
         "spread": spread,
         # >10% leg-to-leg swing: host interference suspected — the row
         # needs a solo re-run before it is quoted (PERF.md variance note)
@@ -425,7 +481,17 @@ def bench_ps_literal(
     of the system). A short untimed run first warms the shared jitted local step
     (one compiled function for all clients), so the timed leg measures
     steady state like the other presets; smoke mode shrinks the per-client
-    batch too (XLA-CPU conv compile time explodes with batch size)."""
+    batch too (XLA-CPU conv compile time explodes with batch size).
+
+    The timed run is obs-armed: journals land in a throwaway dir and the
+    roofline join (``mpit_tpu.obs.roofline``) turns them into the
+    ``phases: {compute, wire, idle, overhead}`` split every bench JSON
+    line now carries — here measured for real (``phase_source: "obs"``),
+    compute spans proof-of-completion-closed by the training loop. The
+    warmup run stays un-instrumented: journals append, so a warmed
+    journal would pollute the timed window."""
+    import tempfile
+
     import optax
 
     from mpit_tpu.data import load_mnist
@@ -449,13 +515,24 @@ def bench_ps_literal(
         alpha=cfg.alpha if cfg.alpha is not None else 0.9 / cfg.clients,
         tau=cfg.tau,
     )
-    # warm the shared jitted local step outside the timed region
+    from mpit_tpu.obs import ObsConfig, roofline
+
+    # warm the shared jitted local step outside the timed region —
+    # deliberately WITHOUT obs (journals append; see docstring)
     trainer.train(x_tr, y_tr, steps=2 * cfg.tau, batch_size=per_client)
-    t0 = time.perf_counter()
-    center, stats = trainer.train(
-        x_tr, y_tr, steps=steps, batch_size=per_client, seed=1
-    )
-    wall = time.perf_counter() - t0
+    with tempfile.TemporaryDirectory(prefix="mpit_bench_obs_") as obs_dir:
+        # arm obs for the timed run only: train() reads self.obs per
+        # call, and the shared jitted step is already compiled, so the
+        # attribute swap changes instrumentation, not the compute
+        trainer.obs = ObsConfig(dir=obs_dir)
+        t0 = time.perf_counter()
+        center, stats = trainer.train(
+            x_tr, y_tr, steps=steps, batch_size=per_client, seed=1
+        )
+        wall = time.perf_counter() - t0
+        trainer.obs = None
+        report = roofline([obs_dir])
+    run = report["run"]
     samples = steps * per_client * cfg.clients
     return {
         "samples_per_sec": samples / wall,
@@ -469,6 +546,12 @@ def bench_ps_literal(
         "accuracy": trainer.evaluate(center, x_te, y_te),
         "timed_seconds": round(wall, 3),
         "per_client_batch": per_client,
+        **({
+            "phases": {
+                k: round(v, 4) for k, v in run["phases"].items()
+            },
+            "phase_source": "obs",
+        } if run is not None else {}),
     }
 
 
@@ -1044,6 +1127,11 @@ def main():
                     "hardware backend unreachable (probe timed out); "
                     "cpu smoke numbers, not a benchmark"
                 ),
+                # probe cost survives the re-exec: the fallback's JSON
+                # line must show what the dead-tunnel detour cost
+                MPIT_BENCH_PROBE_SECONDS=str(
+                    _PROBE_CACHE.get("seconds", "")
+                ),
             ),
         )
     _honor_platform_env()
@@ -1106,6 +1194,7 @@ def main():
             **{k: res[k] for k in opt_fields if res.get(k) is not None},
             **({"platform_note": platform_note} if platform_note else {}),
             **({"last_tpu_measurement": last} if last else {}),
+            **_probe_tag(),
             **profiled,
         }))
 
@@ -1179,9 +1268,14 @@ def main():
             "unit": "samples/sec/chip",
             "vs_baseline": None,  # only the headline config has a baseline
             **{k: res[k] for k in ("chips", "algo", "model")},
-            **{k: res[k] for k in ("mfu", "spread") if k in res},
+            **{
+                k: res[k]
+                for k in ("mfu", "spread", "phases", "phase_source")
+                if k in res
+            },
             **({"platform_note": platform_note} if platform_note else {}),
             **({"last_tpu_measurement": last} if last else {}),
+            **_probe_tag(),
             **profiled,
             **dtype_tag,
         }))
@@ -1247,12 +1341,13 @@ def main():
         **{
             k: jax_res[k]
             for k in ("mfu", "model_flops_per_sec_per_chip", "timed_seconds",
-                      "timed_rounds", "spread")
+                      "timed_rounds", "spread", "phases", "phase_source")
             if k in jax_res and jax_res[k] is not None
         },
         **scaling,
         **({"platform_note": platform_note} if platform_note else {}),
         **({"last_tpu_measurement": last} if last else {}),
+        **_probe_tag(),
         **profiled,
         **dtype_tag,
     }

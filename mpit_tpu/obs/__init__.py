@@ -26,6 +26,7 @@ from mpit_tpu.obs.merge import (  # noqa: F401
     diff_summaries,
     merge_to_chrome_trace,
     read_journal,
+    roofline,
     summarize,
     trace_ids_by_rank,
 )

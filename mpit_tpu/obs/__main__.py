@@ -3,6 +3,7 @@
     python -m mpit_tpu.obs merge RUN_DIR [-o trace.json] [--faults f.jsonl]
     python -m mpit_tpu.obs summary RUN_DIR
     python -m mpit_tpu.obs summary --diff RUN_A RUN_B
+    python -m mpit_tpu.obs roofline RUN_DIR [--json]
 
 ``RUN_DIR`` is the ``MPIT_OBS_DIR`` of the run (or explicit journal
 files). ``merge`` writes Chrome-trace JSON — open it at
@@ -11,7 +12,9 @@ https://ui.perfetto.dev (or chrome://tracing). With ``--faults`` (or a
 events on the rank that suffered them. ``summary --diff`` compares two
 runs stream by stream — per-(peer, tag) message/byte counters and the
 median log2-µs latency bucket — and prints only the streams that moved.
-Exit codes: 0 ok, 2 usage/empty.
+``roofline`` joins the journals into a per-rank and per-run
+compute/wire/idle/overhead breakdown (fractions sum to 1.0; the slowest
+client is flagged as straggler). Exit codes: 0 ok, 2 usage/empty.
 """
 
 from __future__ import annotations
@@ -26,9 +29,36 @@ from mpit_tpu.obs.merge import (
     diff_summaries,
     expand_journal_paths,
     merge_to_chrome_trace,
+    roofline,
     summarize,
     trace_ids_by_rank,
 )
+
+
+def _print_roofline(report: dict) -> None:
+    hdr = (
+        f"{'rank':>4} {'role':>6} {'window':>9} {'compute':>8} "
+        f"{'wire':>8} {'idle':>8} {'ovhd':>8} {'exch':>5} {'bytes':>10}"
+    )
+    print(hdr)
+    for rank, row in report["ranks"].items():
+        ph = row["phases"]
+        mark = " <- straggler" if rank == report["straggler"] else ""
+        print(
+            f"{rank:>4} {row['role']:>6} {row['window_s']:>8.3f}s "
+            f"{ph['compute']:>7.1%} {ph['wire']:>7.1%} "
+            f"{ph['idle']:>7.1%} {ph['overhead']:>7.1%} "
+            f"{row['exchanges']:>5} {row['bytes']:>10}{mark}"
+        )
+    run = report["run"]
+    ph = run["phases"]
+    print(
+        f" run: {run['clients']} client(s) / "
+        f"{run['ranks'] - run['clients']} server(s), "
+        f"window {run['window_s']:.3f}s — compute {ph['compute']:.1%}, "
+        f"wire {ph['wire']:.1%}, idle {ph['idle']:.1%}, "
+        f"overhead {ph['overhead']:.1%}"
+    )
 
 
 def _print_diff(rows) -> None:
@@ -79,6 +109,15 @@ def main(argv=None) -> int:
         "counters + median latency bucket)",
     )
 
+    rp = sub.add_parser(
+        "roofline",
+        help="per-rank compute/wire/idle/overhead attribution",
+    )
+    rp.add_argument("paths", nargs="+",
+                    help="run dir (MPIT_OBS_DIR) or journal files")
+    rp.add_argument("--json", action="store_true",
+                    help="emit the full report as JSON instead of a table")
+
     ns = p.parse_args(argv)
 
     if ns.cmd == "summary" and ns.diff:
@@ -99,6 +138,18 @@ def main(argv=None) -> int:
         print(f"no obs_rank*.jsonl journals under {ns.paths}",
               file=sys.stderr)
         return 2
+
+    if ns.cmd == "roofline":
+        report = roofline(journals)
+        if report["run"] is None:
+            print("journals carry no timed events", file=sys.stderr)
+            return 2
+        if ns.json:
+            json.dump(report, sys.stdout, indent=2, default=str)
+            print()
+        else:
+            _print_roofline(report)
+        return 0
 
     if ns.cmd == "summary":
         for rank, row in summarize(journals).items():

@@ -346,3 +346,158 @@ def summarize(journal_paths: Iterable[str]) -> dict:
         rank: {**v, "traces": len(v["traces"])}
         for rank, v in sorted(out.items())
     }
+
+
+def _merge_intervals(intervals: list) -> list:
+    """Sorted disjoint union of (start, end) intervals."""
+    merged: list = []
+    for b, e in sorted(i for i in intervals if i[1] > i[0]):
+        if merged and b <= merged[-1][1]:
+            if e > merged[-1][1]:
+                merged[-1] = (merged[-1][0], e)
+        else:
+            merged.append((b, e))
+    return merged
+
+
+def _overlap(start: float, end: float, merged: list) -> float:
+    """Length of [start, end] covered by the sorted disjoint intervals."""
+    total = 0.0
+    for b, e in merged:
+        if e <= start:
+            continue
+        if b >= end:
+            break
+        total += min(e, end) - max(b, start)
+    return total
+
+
+def roofline(journal_paths: Iterable[str]) -> dict:
+    """Per-rank and per-run compute/wire/idle/overhead attribution.
+
+    The roofline join (docs/OBSERVABILITY.md), in the spirit of the
+    MVAPICH DNN-training characterization (PAPERS.md, arXiv:1810.11112):
+
+    - **compute** — wall-clock inside ``"compute"`` spans, which the
+      training loop closes with proof-of-completion blocking so the
+      figure is real device time, not dispatch time;
+    - **wire** — every journaled send's in-transport duration, plus recv
+      waits that fall *inside* one of the rank's spans (a client blocked
+      in ``fetch()`` mid-exchange is waiting on the wire);
+    - **idle** — recv waits *outside* any span: a server parked in its
+      dispatch loop, or a client between protocol phases;
+    - **overhead** — the remainder of the rank's observation window
+      (Python, journaling, untraced host work).
+
+    Fractions are normalized by ``max(window, compute + wire + idle)`` so
+    they sum to exactly 1.0 even when sampled intervals overlap. Ranks
+    that never open a span are reported as role ``"server"`` (the PS
+    servers run no local step); span-opening ranks are ``"clients"`` and
+    the slowest of them (by compute seconds, when the spread is > 5%) is
+    flagged as the straggler.
+    """
+    per_rank: dict[int, list[dict]] = {}
+    for path in expand_journal_paths(journal_paths):
+        for rec in read_journal(path):
+            if "ev" not in rec or _rec_time(rec) is None:
+                continue
+            per_rank.setdefault(_rec_rank(rec), []).append(rec)
+
+    ranks: dict[int, dict] = {}
+    for rank, recs in sorted(per_rank.items()):
+        times = [_rec_time(r) for r in recs]
+        window = max(times) - min(times) if len(times) > 1 else 0.0
+        open_spans: dict = {}  # span id -> (name, t_begin)
+        spans: list = []  # (begin, end) of every closed span
+        compute_s = 0.0
+        exch: list = []
+        sends = recvs = nbytes = 0
+        wire_s = idle_s = 0.0
+        waits: list = []  # (begin, end) recv waits, classified below
+        for rec in recs:
+            ev, t = rec["ev"], _rec_time(rec)
+            if ev == "span_b":
+                open_spans[rec.get("span")] = (rec.get("name"), t)
+            elif ev == "span_e":
+                opened = open_spans.pop(rec.get("span"), None)
+                if opened is None:
+                    continue
+                name, t_b = opened
+                spans.append((t_b, t))
+                if name == "compute":
+                    compute_s += t - t_b
+                elif name == "exchange":
+                    exch.append(t - t_b)
+            elif ev in ("send", "isend"):
+                sends += 1
+                nbytes += rec.get("bytes", 0)
+                wire_s += rec.get("dur", 0.0)
+            elif ev == "recv":
+                recvs += 1
+                nbytes += rec.get("bytes", 0)
+                wait = rec.get("wait", 0.0)
+                if wait > 0:
+                    waits.append((t - wait, t))
+        merged = _merge_intervals(spans)
+        for b, e in waits:
+            in_span = _overlap(b, e, merged)
+            wire_s += in_span
+            idle_s += (e - b) - in_span
+        denom = max(window, compute_s + wire_s + idle_s)
+        overhead_s = denom - (compute_s + wire_s + idle_s)
+        ranks[rank] = {
+            "role": "client" if spans or open_spans else "server",
+            "window_s": window,
+            "compute_s": compute_s,
+            "wire_s": wire_s,
+            "idle_s": idle_s,
+            "overhead_s": overhead_s,
+            "phases": {
+                "compute": compute_s / denom if denom else 0.0,
+                "wire": wire_s / denom if denom else 0.0,
+                "idle": idle_s / denom if denom else 0.0,
+                "overhead": overhead_s / denom if denom else 1.0,
+            },
+            "sends": sends,
+            "recvs": recvs,
+            "bytes": nbytes,
+            "exchanges": len(exch),
+            "exchange_mean_s": sum(exch) / len(exch) if exch else None,
+        }
+
+    if not ranks:
+        return {"ranks": {}, "run": None, "straggler": None}
+
+    tot = {
+        k: sum(r[k] for r in ranks.values())
+        for k in ("compute_s", "wire_s", "idle_s", "overhead_s")
+    }
+    denom = sum(
+        max(r["window_s"], r["compute_s"] + r["wire_s"] + r["idle_s"])
+        for r in ranks.values()
+    )
+    run = {
+        **tot,
+        "window_s": max(r["window_s"] for r in ranks.values()),
+        "phases": {
+            "compute": tot["compute_s"] / denom if denom else 0.0,
+            "wire": tot["wire_s"] / denom if denom else 0.0,
+            "idle": tot["idle_s"] / denom if denom else 0.0,
+            "overhead": tot["overhead_s"] / denom if denom else 1.0,
+        },
+        "ranks": len(ranks),
+        "clients": sum(1 for r in ranks.values() if r["role"] == "client"),
+        "bytes": sum(r["bytes"] for r in ranks.values()),
+    }
+
+    straggler = None
+    clients = {
+        rk: r["compute_s"] for rk, r in ranks.items()
+        if r["role"] == "client" and r["compute_s"] > 0
+    }
+    if len(clients) >= 2:
+        lo, hi = min(clients.values()), max(clients.values())
+        if hi > 1.05 * lo:
+            straggler = max(clients, key=lambda rk: clients[rk])
+
+    return {"ranks": ranks, "run": run, "straggler": straggler}

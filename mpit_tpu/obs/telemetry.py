@@ -51,12 +51,23 @@ from mpit_tpu.transport.base import RecvTimeout, Transport
 def _approx_nbytes(obj: Any) -> int:
     """Cheap payload size estimate — NEVER serializes (a pickle.dumps per
     message would dwarf the send itself for inproc reference-passing).
-    Exact for arrays/bytes (the PS protocol's real traffic), flat guesses
-    for scalars and unknown objects."""
+    EXACT for ndarrays/bytes and for the PS chunked scatter envelopes
+    ``(epoch, seq, chunk)`` — tuple members sum, the chunk contributes its
+    true ``nbytes`` — so the per-(peer, tag) byte counters are a
+    trustworthy baseline for the quantized-wire work. Flat guesses remain
+    only for scalars and unknown objects."""
     if obj is None:
         return 0
     nb = getattr(obj, "nbytes", None)
     if nb is not None:
+        kind = getattr(getattr(obj, "dtype", None), "kind", "")
+        if kind == "O":
+            # object-dtype ndarray: nbytes counts POINTERS, not contents —
+            # recurse over the elements for the real payload size
+            try:
+                return sum(_approx_nbytes(o) for o in obj.flat)
+            except Exception:
+                return int(nb)
         return int(nb)
     if isinstance(obj, (bytes, bytearray, memoryview)):
         return len(obj)
@@ -83,7 +94,7 @@ class _PeerTagStats:
     """Counters for one (peer, tag) direction; mutated under the owning
     transport's stats lock."""
 
-    __slots__ = ("msgs", "bytes", "errs", "timeouts", "hist", "n")
+    __slots__ = ("msgs", "bytes", "errs", "timeouts", "hist", "n", "phases")
 
     def __init__(self):
         self.msgs = 0
@@ -92,6 +103,10 @@ class _PeerTagStats:
         self.timeouts = 0
         self.hist: dict[int, int] = {}
         self.n = 0  # next stream index (pre-incremented on use)
+        # wire-phase seconds (serialize / queue_wait / write) accumulated
+        # from phase-aware transports' SendHandles; empty when the inner
+        # stack measures no split (inproc, native, through chaos)
+        self.phases: dict[str, float] = {}
 
     def to_dict(self) -> dict:
         out = {"msgs": self.msgs, "bytes": self.bytes}
@@ -102,6 +117,10 @@ class _PeerTagStats:
         if self.hist:
             out["lat_hist_log2us"] = {
                 str(k): v for k, v in sorted(self.hist.items())
+            }
+        if self.phases:
+            out["phase_s"] = {
+                k: round(v, 6) for k, v in sorted(self.phases.items())
             }
         return out
 
@@ -172,17 +191,29 @@ class TelemetryTransport(Transport):
         nbytes = _approx_nbytes(payload)
         t0 = time.perf_counter()
         err: Optional[BaseException] = None
+        handle = None
         try:
-            if async_:
-                handle = self.inner.isend(dst, tag, wire)
-            else:
-                handle = None
-                self.inner.send(dst, tag, wire)
+            # the sync path ALSO goes through isend: for SocketTransport
+            # send() is literally isend().wait(), and the base Transport
+            # defines isend as send + set_done — identical semantics either
+            # way, but the returned handle carries the wire-phase split
+            # (serialize / queue_wait / write) when the stack measures one
+            handle = self.inner.isend(dst, tag, wire)
+            if not async_:
+                handle.wait()
         except BaseException as e:
             err = e
             raise
         finally:
             dt = time.perf_counter() - t0
+            # a completed handle's phases are stable; an in-flight async
+            # handle is left alone (its split lands in later sends' stats
+            # only if still unread — phases are best-effort for isend)
+            phases = (
+                getattr(handle, "phases", None)
+                if handle is not None and handle.done() and err is None
+                else None
+            )
             depth = None
             with self._stats_lock:
                 s = self._stat(self._send_stats, dst, tag)
@@ -194,6 +225,9 @@ class TelemetryTransport(Transport):
                     s.errs += 1
                 bucket = _lat_bucket(dt)
                 s.hist[bucket] = s.hist.get(bucket, 0) + 1
+                if phases:
+                    for k, v in phases.items():
+                        s.phases[k] = s.phases.get(k, 0.0) + v
                 sampled = n % cfg.sample == 0
             if sampled:
                 depth = self._queue_depth()
@@ -213,6 +247,15 @@ class TelemetryTransport(Transport):
                         fields["parent"] = parent_id
                 if depth is not None:
                     fields["qdepth"] = depth
+                if phases:
+                    # short keys, journal-budget style: serialize /
+                    # queue_wait / write wall-clock for THIS send
+                    if "serialize" in phases:
+                        fields["ser"] = phases["serialize"]
+                    if "queue_wait" in phases:
+                        fields["qw"] = phases["queue_wait"]
+                    if "write" in phases:
+                        fields["wr"] = phases["write"]
                 if err is not None:
                     fields["err"] = type(err).__name__
                 self.journal.event(
@@ -308,6 +351,20 @@ class TelemetryTransport(Transport):
             }
             if self._max_queue_depth:
                 out["max_queue_depth"] = self._max_queue_depth
+        # receive-side phase split (transfer / deserialize per src:tag)
+        # lives in the socket transport's read loop, not in this wrapper —
+        # walk the inner chain for it, same depth bound as _queue_depth
+        t: Any = self.inner
+        for _ in range(4):  # telemetry -> chaos -> ... -> socket
+            rx = getattr(t, "rx_phases", None)
+            if callable(rx):
+                snap = rx()
+                if snap:
+                    out["rx_phase_s"] = snap
+                break
+            t = getattr(t, "inner", None)
+            if t is None:
+                break
         return out
 
 

@@ -27,6 +27,7 @@ from mpit_tpu.parallel import common
 from mpit_tpu.parallel.pclient import PClient
 from mpit_tpu.transport import RecvTimeout
 from mpit_tpu.utils.params import FlatParamSpec, unflatten_params
+from mpit_tpu.utils.profiling import force_completion
 
 logger = logging.getLogger("mpit_tpu.parallel.ps_roles")
 
@@ -89,6 +90,13 @@ def client_train_loop(
     already forces completion) — a per-step ``float(loss)`` would stall
     the XLA dispatch pipeline every step and, measured over a remote
     device tunnel, time the round-trip rather than the training.
+
+    Roofline instrumentation (docs/OBSERVABILITY.md): each τ-block of
+    local steps runs inside a ``"compute"`` span that ends with
+    :func:`force_completion` — proof-of-completion blocking, so the span
+    records real device time rather than async dispatch time. The barrier
+    is conditional on the span being live (``ctx is not None``): with obs
+    off the loop keeps the free-running dispatch pipeline unchanged.
     """
     import jax.numpy as jnp
 
@@ -113,58 +121,73 @@ def client_train_loop(
             losses.extend(np.asarray(jnp.stack(pending)).tolist())
             pending.clear()
 
-    for step in range(steps):
-        idx = rng.integers(0, len(x), batch_size)
-        params, opt_state, loss = local_step(params, opt_state, x[idx], y[idx])
-        pending.append(loss)
-        if (step + 1) % tau == 0:
-            flush()
-            flat = np.asarray(flatten_params(params)[0])
-            with obs_span(
-                client.transport, "exchange",
-                round=(step + 1) // tau, algo=algo,
-            ):
-                try:
-                    if algo == "easgd":
-                        # fetch BEFORE push so the client's elastic move uses
-                        # the pre-push center — the paper's update order (both
-                        # moves on the old center), and the same order
-                        # goptim.easgd_round implements for the collective
-                        # path. Push-then-fetch would couple against a center
-                        # already moved by this client's own push (an
-                        # alpha*(1-alpha) effective move).
-                        center = client.fetch()
-                        client.push_easgd(flat)
-                        flat = flat - alpha * (flat - center)
-                    else:
-                        client.push_delta(flat - last_pull)
-                        # the pushed delta now belongs to the server: a fetch
-                        # failure below must not get it re-pushed next round
-                        last_pull = flat
-                        flat = client.fetch()
-                        last_pull = flat
-                except (RecvTimeout, ConnectionError, OSError) as e:
-                    total_failures += 1
-                    consecutive_failures += 1
-                    if max_exchange_failures is None:
-                        raise  # fail-fast semantics (degradation not enabled)
-                    if consecutive_failures >= max_exchange_failures:
-                        raise RuntimeError(
-                            f"PS exchange failed {consecutive_failures} "
-                            "rounds in a row — escalating instead of "
-                            "training further against an unreachable center"
-                        ) from e
-                    skipped_rounds += 1
-                    logger.warning(
-                        "PS exchange failed (%r); skipping round on the "
-                        "stale center (%d consecutive failure(s))",
-                        e,
-                        consecutive_failures,
-                    )
-                    continue  # params stay local this round
-                consecutive_failures = 0
-                params = unflatten_params(spec, jnp.asarray(flat))
-    flush()  # steps % tau remainder
+    done = 0
+    round_no = 0
+    while done < steps:
+        k = min(tau, steps - done)
+        with obs_span(
+            client.transport, "compute", round=round_no + 1, steps=k
+        ) as cspan:
+            for _ in range(k):
+                idx = rng.integers(0, len(x), batch_size)
+                params, opt_state, loss = local_step(
+                    params, opt_state, x[idx], y[idx]
+                )
+                pending.append(loss)
+            if cspan is not None:
+                # span live → pay the sync so compute time is real
+                force_completion(params, loss)
+        done += k
+        if k < tau:
+            break  # steps % tau remainder trains without an exchange
+        round_no += 1
+        flush()
+        flat = np.asarray(flatten_params(params)[0])
+        with obs_span(
+            client.transport, "exchange",
+            round=round_no, algo=algo,
+        ):
+            try:
+                if algo == "easgd":
+                    # fetch BEFORE push so the client's elastic move uses
+                    # the pre-push center — the paper's update order (both
+                    # moves on the old center), and the same order
+                    # goptim.easgd_round implements for the collective
+                    # path. Push-then-fetch would couple against a center
+                    # already moved by this client's own push (an
+                    # alpha*(1-alpha) effective move).
+                    center = client.fetch()
+                    client.push_easgd(flat)
+                    flat = flat - alpha * (flat - center)
+                else:
+                    client.push_delta(flat - last_pull)
+                    # the pushed delta now belongs to the server: a fetch
+                    # failure below must not get it re-pushed next round
+                    last_pull = flat
+                    flat = client.fetch()
+                    last_pull = flat
+            except (RecvTimeout, ConnectionError, OSError) as e:
+                total_failures += 1
+                consecutive_failures += 1
+                if max_exchange_failures is None:
+                    raise  # fail-fast semantics (degradation not enabled)
+                if consecutive_failures >= max_exchange_failures:
+                    raise RuntimeError(
+                        f"PS exchange failed {consecutive_failures} "
+                        "rounds in a row — escalating instead of "
+                        "training further against an unreachable center"
+                    ) from e
+                skipped_rounds += 1
+                logger.warning(
+                    "PS exchange failed (%r); skipping round on the "
+                    "stale center (%d consecutive failure(s))",
+                    e,
+                    consecutive_failures,
+                )
+                continue  # params stay local this round
+            consecutive_failures = 0
+            params = unflatten_params(spec, jnp.asarray(flat))
+    flush()  # flush any remainder losses
     if exchange_stats is not None:
         exchange_stats["skipped_rounds"] = skipped_rounds
         exchange_stats["exchange_failures"] = total_failures

@@ -39,6 +39,11 @@ class SendHandle:
     def __init__(self):
         self._done = threading.Event()
         self._error: Optional[BaseException] = None
+        # wire-phase wall-clock split (seconds), stamped by phase-aware
+        # transports (SocketTransport: serialize / queue_wait / write)
+        # BEFORE the handle completes; valid only once done() is true.
+        # Transports without a phase breakdown leave it None.
+        self.phases: Optional[dict] = None
 
     def set_done(self):
         self._done.set()

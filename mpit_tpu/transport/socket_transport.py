@@ -38,6 +38,7 @@ import pickle
 import socket
 import struct
 import threading
+import time
 from typing import Any, Optional, Sequence
 
 from mpit_tpu.analysis.runtime import make_lock
@@ -121,6 +122,11 @@ class SocketTransport(Transport):
         # threads: isend returns immediately, and because send() rides the
         # same queue, send/isend to one dst stay FIFO (the MPI order rule)
         self._send_queues: dict[int, "_SendQueue"] = {}
+        # inbound wire-phase accounting per (src, tag): body-transfer and
+        # deserialize seconds (the header wait is idle between messages and
+        # deliberately NOT counted). Harvested by obs telemetry summaries.
+        self._rx_phases: dict[tuple[int, int], dict] = {}
+        self._rx_lock = make_lock("SocketTransport._rx_lock")
         self._closing = threading.Event()
 
         self._listener = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
@@ -157,8 +163,24 @@ class SocketTransport(Transport):
     def _read_loop(self, conn: socket.socket, seq: int):
         try:
             while not self._closing.is_set():
+                # phase split: the header wait is inter-message idle (the
+                # reader blocks here between frames) and is NOT a phase;
+                # body streaming is payload-transfer, loads is deserialize
                 (length,) = _LEN.unpack(_recv_exact(conn, _LEN.size))
-                src, tag, payload = pickle.loads(_recv_exact(conn, length))
+                t_h = time.perf_counter()
+                body = _recv_exact(conn, length)
+                t_b = time.perf_counter()
+                src, tag, payload = pickle.loads(body)
+                t_d = time.perf_counter()
+                with self._rx_lock:
+                    d = self._rx_phases.get((src, tag))
+                    if d is None:
+                        d = self._rx_phases[(src, tag)] = {
+                            "transfer": 0.0, "deserialize": 0.0, "msgs": 0,
+                        }
+                    d["transfer"] += t_b - t_h
+                    d["deserialize"] += t_d - t_b
+                    d["msgs"] += 1
                 with self._src_seq_lock:
                     latest = self._src_seq.get(src, 0)
                     if seq < latest:
@@ -257,12 +279,24 @@ class SocketTransport(Transport):
     def isend(self, dst: int, tag: int, payload: Any) -> SendHandle:
         """Genuinely asynchronous: the frame (serialized NOW — the payload
         is captured at call time, per MPI buffer semantics) is handed to the
-        dst's sender thread; the handle completes when it is written."""
+        dst's sender thread; the handle completes when it is written, with
+        its ``phases`` split (serialize / queue_wait / write) stamped."""
+        t0 = time.perf_counter()
         blob = pickle.dumps(
             (self.rank, tag, payload), protocol=WIRE_PICKLE_PROTOCOL
         )
+        serialize_s = time.perf_counter() - t0
         frame = _LEN.pack(len(blob)) + blob
-        return self._send_queue(dst).enqueue(frame)
+        return self._send_queue(dst).enqueue(frame, serialize_s=serialize_s)
+
+    def rx_phases(self) -> dict:
+        """Snapshot of inbound phase seconds per ``"src:tag"`` stream
+        (obs telemetry folds this into its summary)."""
+        with self._rx_lock:
+            return {
+                f"{src}:{tag}": dict(v)
+                for (src, tag), v in sorted(self._rx_phases.items())
+            }
 
     def recv(
         self,
@@ -317,7 +351,9 @@ class _SendQueue:
         self._cond = threading.Condition()
         # deque: the drainer pops from the front on every frame — a list's
         # pop(0) is O(n) and melts under backlog (a slow peer + isend burst)
-        self._items: collections.deque[tuple[bytes, SendHandle]] = (
+        # items are (frame, handle, enqueue perf_counter) — the timestamp
+        # is what turns into the handle's queue_wait phase on dequeue
+        self._items: collections.deque[tuple[bytes, SendHandle, float]] = (
             collections.deque()
         )
         self._stopped = False
@@ -328,13 +364,14 @@ class _SendQueue:
         )
         self._thread.start()
 
-    def enqueue(self, frame: bytes) -> SendHandle:
+    def enqueue(self, frame: bytes, serialize_s: float = 0.0) -> SendHandle:
         h = SendHandle()
+        h.phases = {"serialize": serialize_s}
         with self._cond:
             if self._stopped:
                 h.set_error(ConnectionError("transport closed"))
                 return h
-            self._items.append((frame, h))
+            self._items.append((frame, h, time.perf_counter()))
             self._cond.notify()
         return h
 
@@ -344,7 +381,7 @@ class _SendQueue:
             pending = self._items
             self._items = collections.deque()
             self._cond.notify()
-        for _frame, h in pending:
+        for _frame, h, _enq_t in pending:
             h.set_error(ConnectionError("transport closed with send pending"))
 
     def _drain(self) -> None:
@@ -354,10 +391,17 @@ class _SendQueue:
                     self._cond.wait()
                 if self._stopped and not self._items:
                     return
-                frame, h = self._items.popleft()
+                frame, h, enq_t = self._items.popleft()
+            # queue_wait is the socket-wait phase a sync send() spends
+            # behind earlier frames to the same dst; write is the payload
+            # transfer into the kernel. Stamped BEFORE set_done so a
+            # waiter observing done() always sees the full split.
+            t_w = time.perf_counter()
             try:
                 self._transport._write_frame(self._dst, frame)
             except BaseException as e:
                 h.set_error(e)
             else:
+                h.phases["queue_wait"] = t_w - enq_t
+                h.phases["write"] = time.perf_counter() - t_w
                 h.set_done()

@@ -1,0 +1,34 @@
+#!/usr/bin/env bash
+# One-command roofline demo (docs/OBSERVABILITY.md, *Roofline*):
+#
+#   scripts/roofline_demo.sh [OUT_DIR] [MAX_SECONDS]
+#
+# Runs a small multi-process PS training (1 server, 2 clients over real
+# SocketTransport) with obs armed, then joins the per-rank journals into
+# the compute/wire/idle/overhead attribution:
+#
+#   OUT_DIR/obs_rank{0,1,2}.jsonl   per-rank event journals
+#   stdout                          per-rank roofline table + run line
+#
+# Wall-clock is bounded: the training run is killed at MAX_SECONDS
+# (default 120) rather than hanging the shell.
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+OUT_DIR="${1:-/tmp/mpit_roofline_demo}"
+MAX_SECONDS="${2:-120}"
+
+rm -rf "$OUT_DIR"
+mkdir -p "$OUT_DIR"
+
+echo "=== roofline_demo: 3-rank easgd run, journals -> $OUT_DIR ==="
+env JAX_PLATFORMS=cpu \
+    MPIT_OBS_DIR="$OUT_DIR" \
+    timeout -k 10 "$MAX_SECONDS" \
+    python -m mpit_tpu.launch -n 3 examples/ptest_proc.py \
+    --model mlp --steps 16 --train-size 256 --algo ps-easgd
+
+echo "=== roofline_demo: per-rank attribution ==="
+python -m mpit_tpu.obs roofline "$OUT_DIR"
+
+echo "roofline_demo: OK — full report: python -m mpit_tpu.obs roofline $OUT_DIR --json"
