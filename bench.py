@@ -923,6 +923,88 @@ def bench_serve(
     }
 
 
+def bench_load(cpu_smoke: bool = False, seed: int = 0) -> dict:
+    """Serving under traffic: the open-loop load harness
+    (``mpit_tpu.loadgen``) drives a Server with Poisson arrivals and
+    mixed length buckets while the server journals every request
+    lifecycle; the reported numbers are the journal's reduction (the
+    same one ``python -m mpit_tpu.obs slo`` computes) — tokens/sec AND
+    the latency scorecard (TTFT/TPOT/e2e percentiles, goodput) that a
+    drain-style bench cannot see. Seeded end to end: the schedule is a
+    pure function of ``seed``, so a regression replays.
+    """
+    import glob
+    import tempfile
+
+    import jax
+    import jax.numpy as jnp
+
+    from mpit_tpu.loadgen import (
+        LoadHarness, LoadSpec, aggregate_paths, make_workload,
+    )
+    from mpit_tpu.models import Server
+    from mpit_tpu.models.transformer import TransformerLM
+    from mpit_tpu.obs.core import ObsConfig
+
+    if cpu_smoke:
+        dims = dict(vocab_size=101, num_layers=2, d_model=32,
+                    num_heads=4, max_len=64)
+        spec = LoadSpec(requests=12, rate=500.0, seed=seed)
+        max_batch, segment = 2, 8
+    else:
+        dims = dict(vocab_size=10_000, num_layers=6, d_model=768,
+                    num_heads=12, max_len=512)
+        spec = LoadSpec(
+            requests=48, rate=50.0, seed=seed,
+            prompt_buckets=((8, 48, 0.6), (48, 128, 0.4)),
+            output_buckets=((16, 64, 0.6), (64, 160, 0.4)),
+        )
+        max_batch, segment = 8, 32
+    model = TransformerLM(**dims)
+    params = model.init(
+        jax.random.key(0), jnp.zeros((1, 8), jnp.int32)
+    )["params"]
+    work = make_workload(spec, dims["vocab_size"],
+                         max_len=dims["max_len"])
+
+    # warmup drain without obs: compile every bucket shape the measured
+    # run will hit, so TTFT measures scheduling rather than XLA
+    warm = Server(model, params, max_batch=max_batch, segment=segment)
+    for r in work:
+        warm.submit(list(r.prompt), r.max_new)
+    warm.drain()
+
+    with tempfile.TemporaryDirectory() as obs_dir:
+        srv = Server(
+            model, params, max_batch=max_batch, segment=segment,
+            obs=ObsConfig(dir=obs_dir),
+        )
+        rep = LoadHarness(srv, work).run()
+        report = aggregate_paths(
+            sorted(glob.glob(os.path.join(obs_dir, "obs_rank*.jsonl")))
+        )
+    tps = report["tokens_per_sec"]
+    return {
+        "tokens_per_sec": (
+            float(tps) if tps is not None
+            else report["tokens"] / max(rep.wall_s, 1e-9)
+        ),
+        "requests": spec.requests,
+        "rate": spec.rate,
+        "seed": seed,
+        "max_batch": max_batch,
+        "segment": segment,
+        "ttft_p50_ms": report["ttft"].get("p50_ms"),
+        "ttft_p99_ms": report["ttft"].get("p99_ms"),
+        "tpot_p50_ms": report["tpot"].get("p50_ms"),
+        "e2e_p99_ms": report["e2e"].get("p99_ms"),
+        "goodput": report["goodput"],
+        "finished": report["requests"]["finished"],
+        "unfinished": report["requests"]["unfinished"],
+        "model": "transformer-large" if not cpu_smoke else "tiny",
+    }
+
+
 def bench_spec(cpu_smoke: bool = False, k: int = 4) -> dict:
     """Speculative-decoding throughput: greedy tokens/sec of
     ``generate_speculative`` vs the plain cached decode on the SAME
@@ -1220,6 +1302,19 @@ def main():
             ("requests", "max_batch", "segment", "segments_per_drain",
              "model"),
             ("weights_dtype", "spread", "admission", "prefix_len"),
+        )
+        return
+
+    if "--load" in sys.argv:
+        seed = int(flag_arg("--seed") or 0)
+        with trace(profile_dir):
+            res = bench_load(cpu_smoke=cpu, seed=seed)
+        emit_tokens_metric(
+            "serve_load_tokens_per_sec", "serve-load", res,
+            ("requests", "rate", "seed", "max_batch", "segment",
+             "finished", "unfinished", "model"),
+            ("ttft_p50_ms", "ttft_p99_ms", "tpot_p50_ms", "e2e_p99_ms",
+             "goodput"),
         )
         return
 

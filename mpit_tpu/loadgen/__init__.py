@@ -1,0 +1,38 @@
+"""Open-loop load generation and SLO accounting for the serving stack.
+
+The measurement half of "serving under traffic": :mod:`workload` draws
+a seeded request schedule (Poisson arrivals, mixed length buckets,
+cancellations), :mod:`harness` replays it against a live
+``Server``/``RNNServer`` (optionally under :mod:`chaos` faults), the
+server's obs journal records every request's lifecycle, and :mod:`slo`
+reduces journals to TTFT/TPOT/e2e percentiles + goodput — gated via
+``python -m mpit_tpu.obs slo <dir> --gate slo.json``. docs/SERVING.md
+has the walkthrough.
+"""
+
+from mpit_tpu.loadgen.chaos import ServeChaos
+from mpit_tpu.loadgen.harness import LoadHarness, LoadReport
+from mpit_tpu.loadgen.slo import (
+    SLOAggregator,
+    aggregate_paths,
+    evaluate_gate,
+    format_report,
+    load_gate,
+    validate_gate,
+)
+from mpit_tpu.loadgen.workload import LoadSpec, Request, make_workload
+
+__all__ = [
+    "LoadSpec",
+    "Request",
+    "make_workload",
+    "ServeChaos",
+    "LoadHarness",
+    "LoadReport",
+    "SLOAggregator",
+    "aggregate_paths",
+    "evaluate_gate",
+    "format_report",
+    "load_gate",
+    "validate_gate",
+]

@@ -33,11 +33,23 @@ in :class:`~mpit_tpu.models.transformer.Block`):
 Row independence (each row's outputs depend only on its own tokens and
 clock — the property the batch==solo tests pin) is what makes
 retirement and admission invisible to the surviving rows.
+
+Observability (``Server(obs=ObsConfig(dir=...))``): every request's
+lifecycle — ``req_enqueue`` → ``req_admit`` → ``req_first_token`` →
+segment ticks → ``req_finish``/``req_cancel`` — plus per-boundary
+``prefill``/``segment`` records (duration, batch occupancy, queue
+depth) journals through the :mod:`mpit_tpu.obs` Journal; ``python -m
+mpit_tpu.obs slo`` aggregates the journals into TTFT/TPOT/e2e
+percentiles and goodput (docs/SERVING.md). With obs off every hook is
+one ``is None`` check — the load harness pins the null path under 2%
+of drain wall-clock (tests/test_loadgen.py).
 """
 
 from __future__ import annotations
 
 import functools
+import os
+import time
 from collections import deque
 from typing import Optional
 
@@ -46,6 +58,38 @@ import jax.numpy as jnp
 import numpy as np
 
 from mpit_tpu.models import sampling
+
+
+class _ServeObs:
+    """Per-server request-lifecycle recorder: one rank-0 obs Journal
+    (serving is single-process) in the standard ``obs_rank*.jsonl``
+    layout, so merge/summary/slo all read a load run unchanged. Built
+    only when obs is armed — the disabled Server carries ``None`` and
+    every instrumentation site stays a bare identity check."""
+
+    __slots__ = ("journal", "clock")
+
+    def __init__(self, config):
+        from mpit_tpu.obs.core import Journal, LogicalClock
+
+        if not getattr(config, "dir", None):
+            raise ValueError(
+                "serving obs needs a journal directory: pass "
+                "ObsConfig(dir=...) (counters-only mode has nothing to "
+                "record request lifecycles into)"
+            )
+        os.makedirs(config.dir, exist_ok=True)
+        self.journal = Journal(
+            os.path.join(config.dir, "obs_rank0.jsonl"), 0,
+            max_records=getattr(config, "max_records", None),
+        )
+        self.clock = LogicalClock()
+
+    def event(self, ev: str, **fields) -> None:
+        self.journal.event(ev, self.clock.tick(), **fields)
+
+    def close(self) -> None:
+        self.journal.close()
 
 
 @functools.partial(jax.jit, static_argnums=(0, 1, 2, 3, 4))
@@ -223,6 +267,12 @@ class Server:
         per-row acceptance — `speculative._spec_round`), and every
         result stays bit-equal to its solo greedy call. Requests need
         ``prompt + max_new + spec_k <= max_len`` (chunk headroom).
+      obs: optional :class:`~mpit_tpu.obs.ObsConfig` with ``dir`` set —
+        journals every request's lifecycle (enqueue/admit/first-token/
+        finish/cancel) plus per-boundary prefill/segment records into
+        ``<dir>/obs_rank0.jsonl`` for ``python -m mpit_tpu.obs slo``.
+        ``None`` (the default) keeps serving uninstrumented: every hook
+        site is one ``is None`` check, nothing else.
     """
 
     def __init__(
@@ -242,6 +292,7 @@ class Server:
         draft_params=None,
         spec_k: int = 4,
         spec_rounds: int = 4,
+        obs=None,
     ):
         if max_batch < 1:
             raise ValueError("max_batch must be >= 1")
@@ -333,6 +384,7 @@ class Server:
             else draft_params
         )
         self._d_cache = None
+        self._obs = _ServeObs(obs) if obs is not None else None
 
     # ---- model-family hooks (the RNN server overrides these three) ----
 
@@ -368,7 +420,7 @@ class Server:
 
     def submit(
         self, prompt, max_new_tokens: int, rng=None, seed=None,
-        temperature=None, top_p=None,
+        temperature=None, top_p=None, slo_ms=None,
     ) -> int:
         """Queue a request; returns its id. The request's sampling stream
         is fixed HERE (``rng``, or ``fold_in(server_rng, id)`` — matching
@@ -381,7 +433,12 @@ class Server:
         its own rule). The server's MODE is fixed at construction —
         greedy vs sampling, top-k on/off, nucleus on/off are compiled
         in — so a greedy server rejects temperature overrides and
-        ``top_p`` needs nucleus enabled at construction."""
+        ``top_p`` needs nucleus enabled at construction.
+
+        ``slo_ms``: THIS request's end-to-end deadline, journaled at
+        enqueue when obs is armed — ``obs slo``'s goodput counts the
+        requests that finished within their own deadline. Purely
+        declarative: the scheduler never reads it."""
         if temperature is not None:
             if self._greedy:
                 raise ValueError(
@@ -409,6 +466,8 @@ class Server:
         )
         if max_new_tokens < 1:
             raise ValueError("max_new_tokens must be >= 1")
+        if slo_ms is not None and slo_ms <= 0:
+            raise ValueError(f"slo_ms={slo_ms} must be > 0")
         pfx = len(self.prefix) if self.prefix else 0
         if (
             self._max_len is not None
@@ -453,6 +512,12 @@ class Server:
             # draws key j — solo-call parity under any scheduling
             "stream": jax.random.split(rng, max_new_tokens),
         })
+        if self._obs is not None:
+            self._obs.event(
+                "req_enqueue", rid=rid, p_len=len(prompt) + pfx,
+                max_new=int(max_new_tokens),
+                **({} if slo_ms is None else {"slo_ms": float(slo_ms)}),
+            )
         return rid
 
     def cancel(self, request_id: int) -> bool:
@@ -464,10 +529,19 @@ class Server:
         for i, r in enumerate(self._waiting):
             if r["id"] == request_id:
                 del self._waiting[i]
+                if self._obs is not None:
+                    self._obs.event(
+                        "req_cancel", rid=request_id, where="queued"
+                    )
                 return True
         for slot, r in enumerate(self._slots):
             if r is not None and r["id"] == request_id:
                 self._slots[slot] = None
+                if self._obs is not None:
+                    self._obs.event(
+                        "req_cancel", rid=request_id, where="slot",
+                        gen=r["gen"],
+                    )
                 return True
         return False
 
@@ -520,6 +594,7 @@ class Server:
         cache is kb copies of the prefix template (built once, lazily)
         and the chunk appends at the prefix clock — admission pays
         suffix FLOPs, not prefix+suffix."""
+        t_pre = time.perf_counter() if self._obs is not None else 0.0
         if self._cache is None:
             self._cache = sampling._zero_cache(self._dec, self._nb)
             self._prev = jnp.zeros((self._nb,), jnp.int32)
@@ -591,15 +666,29 @@ class Server:
             tok0[:k].astype(jnp.int32)
         )
         host0 = jax.device_get(tok0[:k])
+        o = self._obs
+        if o is not None:
+            # the device_get above is proof of completion: the prefill
+            # duration is real kernel+fetch time, not dispatch time
+            o.event(
+                "prefill", k=k, bucket=pre_bucket,
+                dur=time.perf_counter() - t_pre,
+            )
         for i, (r, slot) in enumerate(grp):
             t0 = int(host0[i])
             r["known"].append(t0)
             r["gen"] = 1
-            if (
-                (self.eos_id is not None and t0 == self.eos_id)
-                or r["gen"] >= r["max_new"]
-            ):
+            done_eos = self.eos_id is not None and t0 == self.eos_id
+            if o is not None:
+                o.event("req_admit", rid=r["id"], slot=slot)
+                o.event("req_first_token", rid=r["id"])
+            if done_eos or r["gen"] >= r["max_new"]:
                 self._results[r["id"]] = r["known"]  # done at admission
+                if o is not None:
+                    o.event(
+                        "req_finish", rid=r["id"], gen=r["gen"],
+                        reason="eos" if done_eos else "budget",
+                    )
             else:
                 self._slots[slot] = r
 
@@ -676,6 +765,7 @@ class Server:
             [1.0 if r is None else r["tp"] for r in self._slots],
             np.float32,
         )
+        t_seg = time.perf_counter() if self._obs is not None else 0.0
         self._cache, self._prev, toks = _serve_segment(
             self._dec, seg, self._greedy, self.top_k,
             self.top_p is not None,
@@ -684,6 +774,8 @@ class Server:
         )
         self.segments_run += 1
         self._harvest(jax.device_get(toks), [seg] * self._nb)
+        if self._obs is not None:
+            self._segment_event(t_seg, seg, len(occ))
 
     def _harvest(self, host, avail) -> None:
         """The ONE retirement convention both segment flavors share:
@@ -704,6 +796,11 @@ class Server:
             if done or r["gen"] >= r["max_new"]:
                 self._results[r["id"]] = r["known"]
                 self._slots[slot] = None
+                if self._obs is not None:
+                    self._obs.event(
+                        "req_finish", rid=r["id"], gen=r["gen"],
+                        reason="eos" if done else "budget",
+                    )
 
     def _spec_step(self, occ) -> None:
         """One speculative scheduling round: ``rounds`` batched
@@ -725,6 +822,7 @@ class Server:
         for slot, r in enumerate(self._slots):
             if r is not None:
                 pos0[slot] = len(r["known"]) - 1
+        t_seg = time.perf_counter() if self._obs is not None else 0.0
         self._cache, self._d_cache, self._prev, out, n = (
             _serve_spec_segment(
                 self._dec, self._dft, k, self.spec_rounds,
@@ -735,6 +833,37 @@ class Server:
         )
         self.segments_run += 1
         self._harvest(jax.device_get(out), jax.device_get(n))
+        if self._obs is not None:
+            self._segment_event(t_seg, rounds, len(occ), spec=True)
+
+    def _segment_event(self, t_begin, seg, occupied, spec=False) -> None:
+        """One ``segment`` record per scheduling boundary: duration
+        (kernel + harvest fetch — proof of completion), batch occupancy
+        entering the segment, and the queue depth left waiting — the
+        inputs ``obs slo`` integrates into queue-depth-over-time and
+        batch-occupancy. Only called when obs is armed."""
+        self._obs.event(
+            "segment", seg=int(seg), occupied=occupied,
+            nslots=min(self._nb, self.max_batch),
+            waiting=len(self._waiting),
+            dur=time.perf_counter() - t_begin,
+            **({"spec": True} if spec else {}),
+        )
+
+    def obs_event(self, ev: str, **fields) -> None:
+        """Journal a caller-side event into this server's obs journal —
+        a no-op when obs is off. The load harness uses it to place its
+        chaos faults (``serve_fault``) on the same timeline as the
+        request lifecycles."""
+        if self._obs is not None:
+            self._obs.event(ev, **fields)
+
+    def close(self) -> None:
+        """Flush and close the obs journal (idempotent; a no-op when obs
+        is off). The journal flushes per record, so an unclosed server
+        loses nothing but the ``journal_cap`` footer."""
+        if self._obs is not None:
+            self._obs.close()
 
     def _stream_slice(self, r: dict, steps: int):
         """keys [gen, gen+steps) of the request's stream, padded by

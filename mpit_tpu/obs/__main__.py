@@ -4,6 +4,7 @@
     python -m mpit_tpu.obs summary RUN_DIR
     python -m mpit_tpu.obs summary --diff RUN_A RUN_B
     python -m mpit_tpu.obs roofline RUN_DIR [--json]
+    python -m mpit_tpu.obs slo RUN_DIR [--gate slo.json] [--json]
 
 ``RUN_DIR`` is the ``MPIT_OBS_DIR`` of the run (or explicit journal
 files). ``merge`` writes Chrome-trace JSON — open it at
@@ -14,7 +15,11 @@ runs stream by stream — per-(peer, tag) message/byte counters and the
 median log2-µs latency bucket — and prints only the streams that moved.
 ``roofline`` joins the journals into a per-rank and per-run
 compute/wire/idle/overhead breakdown (fractions sum to 1.0; the slowest
-client is flagged as straggler). Exit codes: 0 ok, 2 usage/empty.
+client is flagged as straggler). ``slo`` reduces the serving lifecycle
+events (``models/serving.py`` under the loadgen harness — see
+docs/SERVING.md) to TTFT/TPOT/e2e percentiles, goodput, queue depth and
+occupancy; ``--gate slo.json`` checks them against ceilings/floors.
+Exit codes: 0 ok, 1 gate violation, 2 usage/empty.
 """
 
 from __future__ import annotations
@@ -118,6 +123,23 @@ def main(argv=None) -> int:
     rp.add_argument("--json", action="store_true",
                     help="emit the full report as JSON instead of a table")
 
+    lp = sub.add_parser(
+        "slo",
+        help="serving scorecard: TTFT/TPOT/e2e percentiles, goodput",
+    )
+    lp.add_argument("paths", nargs="+",
+                    help="run dir (the server's ObsConfig.dir) or "
+                         "journal files")
+    lp.add_argument("--gate", default=None,
+                    help="JSON gate file of ceilings/floors (e.g. "
+                         '{"ttft_p99_ms": 250, "goodput_min": 0.95}); '
+                         "violations exit 1")
+    lp.add_argument("--json", action="store_true",
+                    help="emit the report (plus any violations) as JSON")
+    lp.add_argument("--default-slo-ms", type=float, default=None,
+                    help="e2e SLO applied to requests submitted without "
+                         "one (default: such requests meet vacuously)")
+
     ns = p.parse_args(argv)
 
     if ns.cmd == "summary" and ns.diff:
@@ -138,6 +160,37 @@ def main(argv=None) -> int:
         print(f"no obs_rank*.jsonl journals under {ns.paths}",
               file=sys.stderr)
         return 2
+
+    if ns.cmd == "slo":
+        from mpit_tpu.loadgen.slo import (
+            aggregate_paths, evaluate_gate, format_report, load_gate,
+        )
+
+        report = aggregate_paths(
+            journals, default_slo_ms=ns.default_slo_ms
+        )
+        if report["requests"]["submitted"] == 0:
+            print("journals carry no request lifecycle events "
+                  "(serve with obs=ObsConfig(dir=...))", file=sys.stderr)
+            return 2
+        violations = []
+        if ns.gate is not None:
+            try:
+                gate = load_gate(ns.gate)
+            except (OSError, ValueError) as e:
+                print(f"bad gate file {ns.gate}: {e}", file=sys.stderr)
+                return 2
+            violations = evaluate_gate(report, gate)
+        if ns.json:
+            json.dump({**report, "violations": violations}, sys.stdout)
+            print()
+        else:
+            print(format_report(report))
+            for v in violations:
+                print(f"SLO VIOLATION: {v}")
+        if violations:
+            return 1
+        return 0
 
     if ns.cmd == "roofline":
         report = roofline(journals)

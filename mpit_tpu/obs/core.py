@@ -30,12 +30,16 @@ and is what the merger validates cross-rank causality against.
 Activation mirrors chaos (:func:`mpit_tpu.transport.chaos.config_from_env`):
 obs must never arm implicitly — only recognized ``MPIT_OBS_*`` knobs count.
 
-  MPIT_OBS_DIR        path   journal directory (arms obs; one
+  MPIT_OBS_DIR          path journal directory (arms obs; one
                              obs_rank<r>.jsonl per transport rank)
-  MPIT_OBS_TRACE      0|1    wire trace envelopes + flow linking (default 1)
-  MPIT_OBS_TELEMETRY  0|1    per-(peer, tag) counters/histograms (default 1)
-  MPIT_OBS_SAMPLE     int    journal every Nth wire event per stream
+  MPIT_OBS_TRACE        0|1  wire trace envelopes + flow linking (default 1)
+  MPIT_OBS_TELEMETRY    0|1  per-(peer, tag) counters/histograms (default 1)
+  MPIT_OBS_SAMPLE       int  journal every Nth wire event per stream
                              (default 1 = all; counters always see all)
+  MPIT_OBS_MAX_RECORDS  int  per-journal record cap: writes past it are
+                             dropped and counted, and close() appends a
+                             ``journal_cap`` footer carrying
+                             ``dropped_records`` (default: unbounded)
 """
 
 from __future__ import annotations
@@ -103,13 +107,27 @@ class Journal:
     thread and its heartbeat timer share one rank's journal) and ``t`` is
     stamped inside it, so per-rank journal timestamps are monotonically
     non-decreasing by construction — the property the merged timeline (and
-    its test) relies on."""
+    its test) relies on.
 
-    def __init__(self, path: str, rank: int):
+    ``max_records`` caps journal growth (a million-request load run must
+    not fill the disk silently): writes past the cap are dropped and
+    counted, and :meth:`close` appends one ``journal_cap`` footer record
+    carrying the ``dropped_records`` total — readers see the loss
+    explicitly instead of inferring it from absence."""
+
+    def __init__(
+        self, path: str, rank: int, max_records: Optional[int] = None
+    ):
         from mpit_tpu.utils.metrics import MetricsLogger
 
+        if max_records is not None and max_records < 1:
+            raise ValueError("max_records must be >= 1")
         self.path = path
         self.rank = rank
+        self.max_records = max_records
+        self.dropped_records = 0
+        self._written = 0
+        self._closed = False
         self._lock = make_lock("obs.Journal._lock")
         self._m = MetricsLogger(
             path, tag="obs", echo=False, all_processes=True
@@ -124,10 +142,31 @@ class Journal:
             if k in fields:
                 fields[f"x_{k}"] = fields.pop(k)
         with self._lock:
+            if self._closed:
+                return
+            if (
+                self.max_records is not None
+                and self._written >= self.max_records
+            ):
+                self.dropped_records += 1
+                return
+            self._written += 1
             self._m.log(clk, rank=self.rank, ev=ev, t=time.time(), **fields)
 
     def close(self) -> None:
         with self._lock:
+            if self._closed:
+                return
+            self._closed = True
+            if self.max_records is not None:
+                # the footer rides OUTSIDE the cap (one fixed record),
+                # and is written even at zero drops — "0 dropped" is an
+                # assertion, absence is just a journal without a cap
+                self._m.log(
+                    self._written, rank=self.rank, ev="journal_cap",
+                    t=time.time(), cap=self.max_records,
+                    dropped_records=self.dropped_records,
+                )
             self._m.close()
 
 
@@ -140,20 +179,26 @@ class ObsConfig:
     in-memory telemetry); ``trace=False`` drops the wire envelope (no
     cross-rank linking, zero payload growth); ``sample`` journals only
     every Nth send/recv per (peer, tag) stream — counters still see every
-    message, so summaries stay exact while journal volume shrinks."""
+    message, so summaries stay exact while journal volume shrinks;
+    ``max_records`` caps each journal's record count (drops are counted
+    into the ``journal_cap`` footer — see :class:`Journal`)."""
 
     dir: Optional[str] = None
     trace: bool = True
     telemetry: bool = True
     sample: int = 1
+    max_records: Optional[int] = None
 
     def __post_init__(self):
         if self.sample < 1:
             raise ValueError("sample must be >= 1")
+        if self.max_records is not None and self.max_records < 1:
+            raise ValueError("max_records must be >= 1")
 
 
 _ENV_KNOBS = frozenset(
-    "MPIT_OBS_" + k for k in ("DIR", "TRACE", "TELEMETRY", "SAMPLE")
+    "MPIT_OBS_" + k
+    for k in ("DIR", "TRACE", "TELEMETRY", "SAMPLE", "MAX_RECORDS")
 )
 
 
@@ -164,11 +209,13 @@ def config_from_env(
     never arms implicitly — same contract as chaos's env activation)."""
     if not any(k in _ENV_KNOBS for k in env):
         return None
+    max_records = env.get("MPIT_OBS_MAX_RECORDS")
     return ObsConfig(
         dir=env.get("MPIT_OBS_DIR") or None,
         trace=env.get("MPIT_OBS_TRACE", "1") != "0",
         telemetry=env.get("MPIT_OBS_TELEMETRY", "1") != "0",
         sample=int(env.get("MPIT_OBS_SAMPLE", 1)),
+        max_records=int(max_records) if max_records else None,
     )
 
 

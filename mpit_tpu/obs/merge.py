@@ -23,7 +23,13 @@ Rendering:
   sender-side, docs/ROBUSTNESS.md). FaultEvents deliberately carry no
   timestamp (replay-comparability), so placement joins the fault's
   ``(src, dst, tag, n)`` stream coordinates against the telemetry send
-  events, whose stream index is in lockstep with the chaos schedule's.
+  events, whose stream index is in lockstep with the chaos schedule's;
+- serving journals (``models/serving.py`` under load, docs/SERVING.md)
+  add two thread tracks: ``tid 1`` holds the scheduler's ``prefill``/
+  ``segment`` work slices (events carry end time + ``dur``, like recv)
+  and ``serve_fault`` instants, ``tid 2`` holds one async span per
+  request (``ph: "b"/"n"/"e"``, id = rid) from enqueue through admit /
+  first token to finish or cancel — queueing time visible per request.
 
 This module reads only files — it must import neither jax nor the
 transport stack, so the CLI stays fast and safe to run anywhere.
@@ -201,6 +207,69 @@ def merge_to_chrome_trace(
                 events.append({
                     "ph": "E", "name": str(rec.get("name", "span")),
                     "cat": "span", "pid": rank, "tid": 0, "ts": us(t),
+                })
+            elif ev in ("prefill", "segment"):
+                # serving work slices: t is stamped at END of the
+                # operation, dur carries its extent (the recv idiom)
+                dur = max(rec.get("dur", 0.0) * 1e6, 1.0)
+                ts = max(us(t) - dur, 0.0)
+                if ev == "prefill":
+                    name = f"prefill x{rec.get('k', '?')}"
+                    keys = ("k", "bucket")
+                else:
+                    name = (
+                        "spec segment" if rec.get("spec") else "segment"
+                    )
+                    keys = ("seg", "occupied", "nslots", "waiting")
+                events.append({
+                    "ph": "X", "name": name, "cat": "serve",
+                    "pid": rank, "tid": 1, "ts": ts, "dur": dur,
+                    "args": {k: rec[k] for k in keys if k in rec},
+                })
+            elif ev == "req_enqueue":
+                # request lifecycles as async spans keyed by rid: one
+                # lane per in-flight request in Perfetto, enqueue ->
+                # admit -> first token -> finish/cancel
+                rid = rec.get("rid")
+                events.append({
+                    "ph": "b", "name": f"req {rid}", "cat": "request",
+                    "id": str(rid), "pid": rank, "tid": 2, "ts": us(t),
+                    "args": {
+                        k: rec[k]
+                        for k in ("p_len", "max_new", "slo_ms")
+                        if k in rec
+                    },
+                })
+            elif ev in ("req_admit", "req_first_token"):
+                rid = rec.get("rid")
+                events.append({
+                    "ph": "n", "name": ev[4:], "cat": "request",
+                    "id": str(rid), "pid": rank, "tid": 2, "ts": us(t),
+                    "args": (
+                        {"slot": rec["slot"]} if "slot" in rec else {}
+                    ),
+                })
+            elif ev in ("req_finish", "req_cancel"):
+                rid = rec.get("rid")
+                events.append({
+                    "ph": "e", "name": f"req {rid}", "cat": "request",
+                    "id": str(rid), "pid": rank, "tid": 2, "ts": us(t),
+                    "args": {
+                        k: rec[k]
+                        for k in ("reason", "gen", "where")
+                        if k in rec
+                    },
+                })
+            elif ev == "serve_fault":
+                events.append({
+                    "ph": "i", "s": "p",
+                    "name": f"fault {rec.get('kind', '?')}",
+                    "cat": "chaos", "pid": rank, "tid": 1, "ts": us(t),
+                    "args": {
+                        k: rec[k]
+                        for k in ("boundary", "delay")
+                        if k in rec
+                    },
                 })
 
     if faults_path is not None:

@@ -374,7 +374,8 @@ def _journal_for(config: ObsConfig, rank: int) -> Optional[Journal]:
     import os
 
     return Journal(
-        os.path.join(config.dir, f"obs_rank{rank}.jsonl"), rank
+        os.path.join(config.dir, f"obs_rank{rank}.jsonl"), rank,
+        max_records=config.max_records,
     )
 
 

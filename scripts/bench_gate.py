@@ -1,0 +1,151 @@
+#!/usr/bin/env python
+"""Compare the newest two BENCH_*.json snapshots; flag regressions.
+
+    python scripts/bench_gate.py [--strict] [--threshold 0.10] [DIR]
+
+The driver writes one ``BENCH_r<NN>.json`` per round (``n``, ``cmd``,
+``rc``, ``tail``, ``parsed`` = the bench's JSON line). This gate reads
+the two newest, matches them by metric, and flags movement beyond the
+threshold in the direction that hurts:
+
+- throughput (``value``) dropping;
+- latency fields (``*_ms``) rising;
+- ``goodput`` dropping.
+
+Rounds measured on different platforms (a TPU round vs a dead-tunnel
+CPU-smoke fallback, visible via ``platform``/``platform_note``) are
+reported but never flagged — a 1000x "regression" between a TPU number
+and a CPU number is a platform change, not a code change.
+
+Warn-only by default (exit 0 with warnings printed) because bench noise
+must not block commits — scripts/lint.sh runs it that way. ``--strict``
+exits 1 on flags for CI lanes that do gate on trajectory. Exit 2 on
+usage errors only; fewer than two comparable snapshots is a clean pass
+(nothing to compare is not a regression).
+
+Stdlib-only and import-free of the package: safe in pre-commit hooks.
+"""
+
+from __future__ import annotations
+
+import glob
+import json
+import os
+import re
+import sys
+
+
+def _load_rounds(bench_dir: str) -> list:
+    """BENCH_*.json files with a parsed metric, oldest -> newest (by the
+    round counter ``n``, falling back to filename order)."""
+    rounds = []
+    for path in sorted(glob.glob(os.path.join(bench_dir, "BENCH_*.json"))):
+        try:
+            with open(path) as f:
+                data = json.load(f)
+        except (OSError, json.JSONDecodeError):
+            continue
+        parsed = data.get("parsed")
+        if not isinstance(parsed, dict) or "metric" not in parsed:
+            continue
+        rounds.append((data.get("n", 0), path, parsed))
+    rounds.sort(key=lambda r: r[0])
+    return rounds
+
+
+def _platform_mode(parsed: dict) -> str:
+    """Comparable-measurement key: CPU-smoke fallbacks must not be
+    scored against real-hardware rounds."""
+    if parsed.get("platform_note"):
+        return "cpu-smoke"
+    return str(parsed.get("platform", "unknown"))
+
+
+_MS_KEY = re.compile(r"_ms$")
+
+
+def compare(old: dict, new: dict, threshold: float) -> list:
+    """Regression strings for one metric's old -> new movement."""
+    flags = []
+
+    def _num(d, k):
+        v = d.get(k)
+        return v if isinstance(v, (int, float)) and not isinstance(
+            v, bool
+        ) else None
+
+    ov, nv = _num(old, "value"), _num(new, "value")
+    if ov is not None and nv is not None and ov > 0:
+        drop = (ov - nv) / ov
+        if drop > threshold:
+            flags.append(
+                f"value {ov} -> {nv} ({drop:.1%} drop, "
+                f"unit {new.get('unit', '?')})"
+            )
+    for k in sorted(set(old) & set(new)):
+        if not _MS_KEY.search(k):
+            continue
+        ov, nv = _num(old, k), _num(new, k)
+        if ov is None or nv is None or ov <= 0:
+            continue
+        rise = (nv - ov) / ov
+        if rise > threshold:
+            flags.append(f"{k} {ov} -> {nv} ({rise:.1%} rise)")
+    ov, nv = _num(old, "goodput"), _num(new, "goodput")
+    if ov is not None and nv is not None and ov > 0:
+        drop = (ov - nv) / ov
+        if drop > threshold:
+            flags.append(f"goodput {ov} -> {nv} ({drop:.1%} drop)")
+    return flags
+
+
+def main(argv=None) -> int:
+    argv = list(sys.argv[1:] if argv is None else argv)
+    strict = "--strict" in argv
+    if strict:
+        argv.remove("--strict")
+    threshold = 0.10
+    if "--threshold" in argv:
+        i = argv.index("--threshold")
+        try:
+            threshold = float(argv[i + 1])
+            del argv[i:i + 2]
+        except (IndexError, ValueError):
+            print("--threshold needs a number", file=sys.stderr)
+            return 2
+    if len(argv) > 1:
+        print(__doc__.strip().splitlines()[2].strip(), file=sys.stderr)
+        return 2
+    bench_dir = argv[0] if argv else "."
+
+    rounds = _load_rounds(bench_dir)
+    if len(rounds) < 2:
+        print(f"bench_gate: {len(rounds)} snapshot(s) under "
+              f"{bench_dir} — nothing to compare")
+        return 0
+    (_, old_path, old), (_, new_path, new) = rounds[-2], rounds[-1]
+
+    if old.get("metric") != new.get("metric"):
+        print(f"bench_gate: metric changed "
+              f"{old.get('metric')} -> {new.get('metric')} — skipping")
+        return 0
+    om, nm = _platform_mode(old), _platform_mode(new)
+    if om != nm:
+        print(f"bench_gate: platform changed {om} -> {nm} "
+              f"({os.path.basename(old_path)} -> "
+              f"{os.path.basename(new_path)}) — not comparable")
+        return 0
+
+    flags = compare(old, new, threshold)
+    label = (f"{os.path.basename(old_path)} -> "
+             f"{os.path.basename(new_path)} ({new.get('metric')}, {nm})")
+    if not flags:
+        print(f"bench_gate: OK {label}")
+        return 0
+    for f in flags:
+        print(f"bench_gate: WARNING {label}: {f}")
+    return 1 if strict else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -38,4 +38,7 @@ python -m mpit_tpu.analysis "${@:-mpit_tpu/}"
 if [[ $# -eq 0 ]]; then
     python -m mpit_tpu.analysis mcheck
     python -m mpit_tpu.analysis conform tests/fixtures/conformance/good_run
+    # warn-only: bench trajectory drift should be SEEN at lint time, but
+    # bench noise must never block a commit (--strict exists for CI)
+    python scripts/bench_gate.py || true
 fi

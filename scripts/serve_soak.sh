@@ -1,0 +1,50 @@
+#!/usr/bin/env bash
+# Multi-seed serving soak: seeded open-loop load runs gated on SLOs.
+#
+#   scripts/serve_soak.sh [N_SEEDS] [MAX_SECONDS]
+#
+# Each round drives `python -m mpit_tpu.loadgen` with a fresh seed
+# (workload AND chaos schedule derive from it) into a throwaway journal
+# dir, then gates the journals through
+# `python -m mpit_tpu.obs slo --gate scripts/slo_smoke.json`. Wall-clock
+# is bounded like chaos_soak.sh: no new round starts once MAX_SECONDS
+# (default 600) is spent. A failing seed prints its exact replay line —
+# the run is a pure function of the seed, so the failure reproduces.
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+N_SEEDS="${1:-5}"
+MAX_SECONDS="${2:-600}"
+START=$SECONDS
+FAILED=0
+
+for ((i = 0; i < N_SEEDS; i++)); do
+  if ((SECONDS - START >= MAX_SECONDS)); then
+    echo "serve_soak: budget of ${MAX_SECONDS}s spent after ${i} round(s); stopping" >&2
+    break
+  fi
+  echo "=== serve soak round $((i + 1))/${N_SEEDS} (seed ${i}) ==="
+  OUT="$(mktemp -d)"
+  trap 'rm -rf "$OUT"' EXIT
+  if ! env JAX_PLATFORMS=cpu python -m mpit_tpu.loadgen \
+      --out "$OUT" --seed "$i" --requests 16 --rate 500 \
+      --cancel-prob 0.1 --chaos-delay-p 0.05; then
+    FAILED=1
+  elif ! env JAX_PLATFORMS=cpu python -m mpit_tpu.obs slo "$OUT" \
+      --gate scripts/slo_smoke.json; then
+    FAILED=1
+  fi
+  rm -rf "$OUT"
+  trap - EXIT
+  if ((FAILED)); then
+    break
+  fi
+done
+
+if ((FAILED)); then
+  echo "serve_soak: FAILED at seed ${i} — replay with:" >&2
+  echo "  python -m mpit_tpu.loadgen --out /tmp/serve_soak_${i} --seed ${i} --requests 16 --rate 500 --cancel-prob 0.1 --chaos-delay-p 0.05" >&2
+  echo "  python -m mpit_tpu.obs slo /tmp/serve_soak_${i} --gate scripts/slo_smoke.json" >&2
+  exit 1
+fi
+echo "serve_soak: OK"

@@ -28,6 +28,7 @@ run python bench.py --serve --burst > /tmp/v_serve_burst.log 2>&1
 run python bench.py --serve --weights-dtype bf16 > /tmp/v_serve_bf16.log 2>&1
 run python bench.py --spec > /tmp/v_spec.log 2>&1
 run python bench.py --serve --prefix-len 64 > /tmp/v_serve_prefix.log 2>&1
+run python bench.py --load > /tmp/v_serve_load.log 2>&1
 # -- variant axes --
 run python scripts/measure_presets.py --remat --presets resnet50-sync,ptb-transformer-seq > /tmp/v_remat.log 2>&1
 run python scripts/measure_presets.py --set algo=zero-sync --presets mnist-easgd,cifar-vgg-sync > /tmp/v_zero.log 2>&1

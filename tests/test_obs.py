@@ -66,6 +66,59 @@ class TestConfig:
         with pytest.raises(ValueError, match="sample"):
             ObsConfig(sample=0)
 
+    def test_max_records_knob(self):
+        with pytest.raises(ValueError, match="max_records"):
+            ObsConfig(max_records=0)
+        assert config_from_env(
+            {"MPIT_OBS_MAX_RECORDS": "100"}
+        ).max_records == 100
+        # the knob alone arms obs (recognized-knob contract)
+        assert config_from_env({"MPIT_OBS_MAX_RECORDS": "5"}) is not None
+
+
+class TestJournalCap:
+    """MPIT_OBS_MAX_RECORDS: bounded journals that SAY they dropped."""
+
+    def test_cap_drops_counted_in_footer(self, tmp_path):
+        path = str(tmp_path / "obs_rank0.jsonl")
+        j = Journal(path, 0, max_records=3)
+        for i in range(10):
+            j.event("send", i, n=i)
+        assert j.dropped_records == 7
+        j.close()
+        recs = list(read_journal(path))
+        assert len(recs) == 4  # 3 events + the footer
+        footer = recs[-1]
+        assert footer["ev"] == "journal_cap"
+        assert footer["cap"] == 3
+        assert footer["dropped_records"] == 7
+        # the kept records are the FIRST three (head, not reservoir)
+        assert [r["n"] for r in recs[:3]] == [0, 1, 2]
+
+    def test_footer_written_even_at_zero_drops(self, tmp_path):
+        path = str(tmp_path / "obs_rank0.jsonl")
+        j = Journal(path, 0, max_records=100)
+        j.event("send", 1, n=0)
+        j.close()
+        j.close()  # idempotent: one footer, not two
+        recs = list(read_journal(path))
+        footers = [r for r in recs if r.get("ev") == "journal_cap"]
+        assert len(footers) == 1
+        assert footers[0]["dropped_records"] == 0
+
+    def test_uncapped_journal_has_no_footer(self, tmp_path):
+        path = str(tmp_path / "obs_rank0.jsonl")
+        j = Journal(path, 0)
+        j.event("send", 1, n=0)
+        j.close()
+        assert all(
+            r.get("ev") != "journal_cap" for r in read_journal(path)
+        )
+
+    def test_journal_validates_cap(self, tmp_path):
+        with pytest.raises(ValueError, match="max_records"):
+            Journal(str(tmp_path / "j.jsonl"), 0, max_records=0)
+
 
 class TestDisabledFastPath:
     """The overhead contract: MPIT_OBS_* unset means no wrapper exists and
