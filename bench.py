@@ -516,6 +516,7 @@ def bench_ps_literal(
         tau=cfg.tau,
     )
     from mpit_tpu.obs import ObsConfig, roofline
+    from mpit_tpu.obs.live import aggregate, read_snapshots, validate_snapshot
 
     # warm the shared jitted local step outside the timed region —
     # deliberately WITHOUT obs (journals append; see docstring)
@@ -523,8 +524,10 @@ def bench_ps_literal(
     with tempfile.TemporaryDirectory(prefix="mpit_bench_obs_") as obs_dir:
         # arm obs for the timed run only: train() reads self.obs per
         # call, and the shared jitted step is already compiled, so the
-        # attribute swap changes instrumentation, not the compute
-        trainer.obs = ObsConfig(dir=obs_dir)
+        # attribute swap changes instrumentation, not the compute. live
+        # rides along — the exporter is one 1 Hz daemon thread per rank,
+        # and every bench run then doubles as a live-plane schema check
+        trainer.obs = ObsConfig(dir=obs_dir, live=True)
         t0 = time.perf_counter()
         center, stats = trainer.train(
             x_tr, y_tr, steps=steps, batch_size=per_client, seed=1
@@ -532,6 +535,11 @@ def bench_ps_literal(
         wall = time.perf_counter() - t0
         trainer.obs = None
         report = roofline([obs_dir])
+        snaps = read_snapshots(os.path.join(obs_dir, "live"))
+        live_rep = aggregate(snaps) if snaps else None
+        live_invalid = sum(
+            1 for s in snaps.values() if validate_snapshot(s)
+        )
     run = report["run"]
     samples = steps * per_client * cfg.clients
     return {
@@ -552,6 +560,16 @@ def bench_ps_literal(
             },
             "phase_source": "obs",
         } if run is not None else {}),
+        **({
+            # live-plane cross-check: rank count and final rolling
+            # throughput from the in-run snapshots (the wall-clock
+            # metric above remains the headline number)
+            "live": {
+                "ranks": live_rep["run"]["ranks"],
+                "throughput": live_rep["run"]["throughput"],
+                "invalid_snapshots": live_invalid,
+            },
+        } if live_rep is not None else {}),
     }
 
 

@@ -13,6 +13,7 @@ from mpit_tpu.analysis.rules import (
     host_sync,
     jit_signature,
     locks,
+    metric_names,
     model_check,
     protocol_roles,
     tags,
@@ -28,6 +29,7 @@ RULE_MODULES = (
     wire_format,
     protocol_roles,
     model_check,
+    metric_names,
 )
 
 # rule id -> (title, one-line rationale); the CLI's --list-rules output and

@@ -96,6 +96,19 @@ def main(argv=None) -> int:
             + " ".join(f"{k}={os.environ[k]}" for k in obs_env),
             file=sys.stderr,
         )
+    # live telemetry gets one more line: unlike journals, it is useful
+    # WHILE the run is alive, so print the watch command
+    if (
+        os.environ.get("MPIT_OBS_LIVE", "0") not in ("", "0")
+        and os.environ.get("MPIT_OBS_DIR")
+    ):
+        print(
+            "[launch] LIVE telemetry: snapshots in "
+            f"{os.path.join(os.environ['MPIT_OBS_DIR'], 'live')} — watch "
+            f"with `python -m mpit_tpu.obs live "
+            f"{os.environ['MPIT_OBS_DIR']}`",
+            file=sys.stderr,
+        )
 
     # one extra port for the jax.distributed coordinator (rank 0 binds it)
     reserving, ports = _reserve_ports(ns.n + (1 if ns.jax_distributed else 0))

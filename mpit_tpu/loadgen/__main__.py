@@ -53,6 +53,13 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--no-warmup", action="store_true",
                    help="skip the unjournaled warmup drain; first-run "
                    "XLA compiles then land in the measured TTFTs")
+    p.add_argument("--live", action="store_true",
+                   help="arm the live telemetry plane: snapshots in "
+                   "<out>/live, watch with `python -m mpit_tpu.obs "
+                   "live <out>`")
+    p.add_argument("--live-interval", type=float, default=0.25,
+                   help="live snapshot export interval, seconds "
+                   "(default 0.25 — smoke runs are short)")
     return p
 
 
@@ -109,7 +116,10 @@ def main(argv=None) -> int:
 
     srv = server_cls(
         model, params, max_batch=ns.max_batch, segment=ns.segment,
-        obs=ObsConfig(dir=ns.out, max_records=ns.max_records),
+        obs=ObsConfig(
+            dir=ns.out, max_records=ns.max_records,
+            live=ns.live, live_interval=ns.live_interval,
+        ),
     )
     chaos = None
     if ns.chaos_delay_p > 0.0 or ns.kill_after is not None:

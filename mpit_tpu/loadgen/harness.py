@@ -26,6 +26,11 @@ from typing import Optional
 
 from mpit_tpu.loadgen.chaos import ServeChaos
 from mpit_tpu.loadgen.workload import Request
+from mpit_tpu.obs.live import (
+    M_LOAD_LATENESS_S,
+    M_LOAD_PENDING,
+    live_registry,
+)
 
 
 @dataclasses.dataclass
@@ -66,6 +71,11 @@ class LoadHarness:
     def run(self) -> LoadReport:
         srv = self.server
         reqs = self.requests
+        # harness-side live gauges through the server's registry (the
+        # no-op NULL_REGISTRY unless ObsConfig.live armed the server):
+        # the client's view — pending load and submit lateness — rides
+        # the same snapshots the server's lifecycle counters do
+        reg = live_registry(srv)
         t0 = time.perf_counter()
         i = 0
         cancels: list = []  # (due_s, rid) min-heap
@@ -117,6 +127,8 @@ class LoadHarness:
                     time.sleep(delay)
             srv.step()
             boundary += 1
+            reg.set_gauge(M_LOAD_PENDING, srv.pending)
+            reg.set_gauge(M_LOAD_LATENESS_S, max_late)
         results.update(srv.results())
         srv.close()
         return LoadReport(

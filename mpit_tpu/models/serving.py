@@ -58,6 +58,19 @@ import jax.numpy as jnp
 import numpy as np
 
 from mpit_tpu.models import sampling
+from mpit_tpu.obs.live import (
+    M_E2E,
+    M_OCCUPIED,
+    M_REQ_CANCELLED,
+    M_REQ_FINISHED,
+    M_REQ_SUBMITTED,
+    M_SEGMENTS,
+    M_SERVE_FAULTS,
+    M_SLO_MISSES,
+    M_TOKENS,
+    M_TTFT,
+    M_WAITING,
+)
 
 
 class _ServeObs:
@@ -65,9 +78,17 @@ class _ServeObs:
     (serving is single-process) in the standard ``obs_rank*.jsonl``
     layout, so merge/summary/slo all read a load run unchanged. Built
     only when obs is armed — the disabled Server carries ``None`` and
-    every instrumentation site stays a bare identity check."""
+    every instrumentation site stays a bare identity check.
 
-    __slots__ = ("journal", "clock")
+    With ``ObsConfig.live`` armed, the same lifecycle events also feed a
+    live :class:`mpit_tpu.obs.live.MetricsRegistry` (role ``"serve"``)
+    snapshotted to ``<dir>/live/rank_0.json`` — submitted/finished/
+    cancelled counters, TTFT/e2e rolling histograms, SLO-miss counts
+    (against each request's own ``slo_ms``), and waiting/occupied gauges
+    per segment. That is the SLO-burn signal the online alert engine and
+    a future replica router read while traffic is flowing."""
+
+    __slots__ = ("journal", "clock", "registry", "_live", "_open_reqs")
 
     def __init__(self, config):
         from mpit_tpu.obs.core import Journal, LogicalClock
@@ -84,12 +105,64 @@ class _ServeObs:
             max_records=getattr(config, "max_records", None),
         )
         self.clock = LogicalClock()
+        self.registry = None
+        self._live = None
+        self._open_reqs: dict = {}  # rid -> (t_enqueue, slo_ms)
+        if getattr(config, "live", False):
+            from mpit_tpu.obs.live import LiveExporter, MetricsRegistry
+
+            self.registry = MetricsRegistry(0, role="serve")
+            self._live = LiveExporter(
+                self.registry,
+                os.path.join(config.dir, "live"),
+                interval_s=getattr(config, "live_interval", 1.0),
+            )
 
     def event(self, ev: str, **fields) -> None:
         self.journal.event(ev, self.clock.tick(), **fields)
+        if self.registry is not None:
+            self._publish(ev, fields)
+
+    def _publish(self, ev: str, fields: dict) -> None:
+        """Fold one journal event into the live registry. Latencies are
+        measured here (monotonic, enqueue → first token / finish) rather
+        than re-deriving them from journal timestamps — the live plane
+        must not depend on the journal surviving or being re-read."""
+        reg = self.registry
+        now = time.monotonic()
+        if ev == "req_enqueue":
+            reg.inc(M_REQ_SUBMITTED)
+            self._open_reqs[fields.get("rid")] = (now, fields.get("slo_ms"))
+        elif ev == "req_first_token":
+            open_rec = self._open_reqs.get(fields.get("rid"))
+            if open_rec is not None:
+                reg.observe(M_TTFT, now - open_rec[0])
+        elif ev == "req_finish":
+            open_rec = self._open_reqs.pop(fields.get("rid"), None)
+            reg.inc(M_REQ_FINISHED)
+            reg.inc(M_TOKENS, float(fields.get("gen", 0)))
+            if open_rec is not None:
+                e2e = now - open_rec[0]
+                reg.observe(M_E2E, e2e)
+                slo_ms = open_rec[1]
+                if slo_ms is not None and e2e * 1e3 > slo_ms:
+                    reg.inc(M_SLO_MISSES)
+        elif ev == "req_cancel":
+            self._open_reqs.pop(fields.get("rid"), None)
+            reg.inc(M_REQ_CANCELLED)
+        elif ev == "segment":
+            reg.inc(M_SEGMENTS)
+            if "waiting" in fields:
+                reg.set_gauge(M_WAITING, fields["waiting"])
+            if "occupied" in fields:
+                reg.set_gauge(M_OCCUPIED, fields["occupied"])
+        elif ev == "serve_fault":
+            reg.inc(M_SERVE_FAULTS)
 
     def close(self) -> None:
         self.journal.close()
+        if self._live is not None:
+            self._live.close()
 
 
 @functools.partial(jax.jit, static_argnums=(0, 1, 2, 3, 4))
@@ -857,6 +930,14 @@ class Server:
         request lifecycles."""
         if self._obs is not None:
             self._obs.event(ev, **fields)
+
+    @property
+    def obs_registry(self):
+        """The live metrics registry when ``ObsConfig.live`` is armed,
+        else None — the :func:`mpit_tpu.obs.live.live_registry` hook's
+        contract, so harness-side code publishes through the server the
+        same way protocol code publishes through a transport."""
+        return self._obs.registry if self._obs is not None else None
 
     def close(self) -> None:
         """Flush and close the obs journal (idempotent; a no-op when obs

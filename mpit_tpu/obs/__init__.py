@@ -9,8 +9,19 @@ chaos FaultLog — into one Perfetto timeline.
 
 Activation: ``AsyncPSTrainer(obs=ObsConfig(...))`` in code, or any
 ``MPIT_OBS_*`` env knob for launcher-driven runs (no code changes).
+
+The live plane (``MPIT_OBS_LIVE=1`` / ``ObsConfig(live=True)``) adds
+in-run snapshots: a per-rank :class:`~mpit_tpu.obs.live.MetricsRegistry`
+exported atomically to ``<dir>/live/rank_<r>.json``, aggregated by
+``python -m mpit_tpu.obs live <dir>`` into a dashboard with online
+health alerts (:mod:`mpit_tpu.obs.alerts`).
 """
 
+from mpit_tpu.obs.alerts import (  # noqa: F401
+    AlertConfig,
+    AlertEngine,
+    read_alerts,
+)
 from mpit_tpu.obs.core import (  # noqa: F401
     Journal,
     LogicalClock,
@@ -21,6 +32,15 @@ from mpit_tpu.obs.core import (  # noqa: F401
     config_from_env,
     span,
     write_fault_log,
+)
+from mpit_tpu.obs.live import (  # noqa: F401
+    LiveExporter,
+    MetricsRegistry,
+    NULL_REGISTRY,
+    aggregate,
+    live_registry,
+    read_snapshots,
+    validate_snapshot,
 )
 from mpit_tpu.obs.merge import (  # noqa: F401
     diff_summaries,

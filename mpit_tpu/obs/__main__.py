@@ -5,21 +5,28 @@
     python -m mpit_tpu.obs summary --diff RUN_A RUN_B
     python -m mpit_tpu.obs roofline RUN_DIR [--json]
     python -m mpit_tpu.obs slo RUN_DIR [--gate slo.json] [--json]
+    python -m mpit_tpu.obs live RUN_DIR [--once] [--json] [--validate]
 
 ``RUN_DIR`` is the ``MPIT_OBS_DIR`` of the run (or explicit journal
 files). ``merge`` writes Chrome-trace JSON — open it at
 https://ui.perfetto.dev (or chrome://tracing). With ``--faults`` (or a
 ``faults.jsonl`` sitting in the run dir) chaos faults render as instant
-events on the rank that suffered them. ``summary --diff`` compares two
-runs stream by stream — per-(peer, tag) message/byte counters and the
-median log2-µs latency bucket — and prints only the streams that moved.
-``roofline`` joins the journals into a per-rank and per-run
+events on the rank that suffered them; live-plane alerts
+(``live/alerts.jsonl``) render the same way. ``summary --diff`` compares
+two runs stream by stream — per-(peer, tag) message/byte counters and
+the median log2-µs latency bucket — and prints only the streams that
+moved. ``roofline`` joins the journals into a per-rank and per-run
 compute/wire/idle/overhead breakdown (fractions sum to 1.0; the slowest
 client is flagged as straggler). ``slo`` reduces the serving lifecycle
 events (``models/serving.py`` under the loadgen harness — see
 docs/SERVING.md) to TTFT/TPOT/e2e percentiles, goodput, queue depth and
 occupancy; ``--gate slo.json`` checks them against ceilings/floors.
-Exit codes: 0 ok, 1 gate violation, 2 usage/empty.
+``live`` reads the in-run snapshots a ``MPIT_OBS_LIVE=1`` run exports
+(``live/rank_<r>.json``), renders a refreshing cross-rank dashboard
+(``--once --json`` for scripting), and runs the online alert engine
+(dead-rank, straggler, SLO burn) appending ``live/alerts.jsonl``.
+Exit codes: 0 ok, 1 gate violation / new alerts / invalid snapshot,
+2 usage/empty.
 """
 
 from __future__ import annotations
@@ -88,6 +95,144 @@ def _print_diff(rows) -> None:
     )
 
 
+def _fmt_ms(v) -> str:
+    return "-" if v is None else f"{v:.1f}"
+
+
+def _print_live(report: dict, live_dir: str, fired: list) -> None:
+    run = report["run"]
+    print(
+        f"live: {run['ranks']} rank(s) under {live_dir} — "
+        f"throughput {run['throughput']:.1f} samples/s, "
+        f"max heartbeat age {run['max_age_s']:.1f}s"
+    )
+    hdr = (
+        f"{'rank':>4} {'role':>6} {'age':>6} {'seq':>5} {'thr/s':>8} "
+        f"{'queue':>5} {'compute':>8} {'wire':>6} {'other':>6} "
+        f"{'exch p50/p90/p99 ms':>20}  faults"
+    )
+    print(hdr)
+    for rank, row in report["ranks"].items():
+        ph = row.get("phases")
+        exch = row.get("exchange_ms")
+        q = row.get("queue_depth")
+        faults = ",".join(
+            f"{k}:{v}" for k, v in sorted(row.get("faults", {}).items())
+        ) or "-"
+        print(
+            f"{rank:>4} {row['role']:>6} {row['age_s']:>5.1f}s "
+            f"{row['seq']:>5} {row['throughput']:>8.1f} "
+            f"{'-' if q is None else q:>5} "
+            + (
+                f"{ph['compute']:>7.1%} {ph['wire']:>5.1%} "
+                f"{ph['other']:>5.1%} "
+                if ph is not None else f"{'-':>7} {'-':>5} {'-':>5} "
+            )
+            + (
+                f"{_fmt_ms(exch['p50']):>6}/{_fmt_ms(exch['p90'])}"
+                f"/{_fmt_ms(exch['p99']):<7}"
+                if exch is not None else f"{'-':>20}"
+            )
+            + f"  {faults}"
+        )
+        srow = row.get("serve")
+        if srow is not None:
+            print(
+                f"     serve: waiting {srow['waiting']} "
+                f"occupied {srow['occupied']} rps {srow['rps']:.1f} "
+                f"tokens/s {srow['tokens_per_s']:.1f} "
+                f"slo-miss {srow['slo_miss_fraction']:.1%} "
+                f"ttft p50 {_fmt_ms(srow.get('ttft_p50_ms'))}ms "
+                f"p99 {_fmt_ms(srow.get('ttft_p99_ms'))}ms"
+            )
+    for rec in fired:
+        print(
+            f"ALERT {rec['kind']} rank {rec['rank']}: "
+            f"{json.dumps(rec['detail'])}"
+        )
+
+
+def _cmd_live(ns) -> int:
+    import time as _time
+
+    from mpit_tpu.obs import alerts as alerts_mod
+    from mpit_tpu.obs import live as live_mod
+
+    live_dir = live_mod.find_live_dir(ns.path)
+
+    if ns.validate:
+        paths = sorted(glob.glob(os.path.join(live_dir, "rank_*.json")))
+        if not paths:
+            print(f"no rank_*.json snapshots under {live_dir}",
+                  file=sys.stderr)
+            return 2
+        bad = 0
+        for path in paths:
+            try:
+                with open(path) as f:
+                    snap = json.load(f)
+            except (OSError, json.JSONDecodeError) as e:
+                print(f"{path}: unreadable: {e}", file=sys.stderr)
+                bad += 1
+                continue
+            problems = live_mod.validate_snapshot(snap)
+            for prob in problems:
+                print(f"{path}: {prob}", file=sys.stderr)
+            bad += bool(problems)
+        print(f"validated {len(paths)} snapshot(s), {bad} invalid")
+        return 1 if bad else 0
+
+    engine = None
+    if not ns.no_alerts:
+        kwargs = {
+            k: v for k, v in (
+                ("staleness_factor", ns.staleness_factor),
+                ("straggler_spread", ns.straggler_spread),
+                ("burn_threshold", ns.burn_threshold),
+                ("slo_target", ns.slo_target),
+            ) if v is not None
+        }
+        engine = alerts_mod.AlertEngine(
+            os.path.join(live_dir, "alerts.jsonl"),
+            alerts_mod.AlertConfig(**kwargs),
+        )
+
+    deadline = (
+        _time.monotonic() + ns.max_seconds
+        if ns.max_seconds is not None else None
+    )
+    try:
+        while True:
+            snaps = live_mod.read_snapshots(live_dir)
+            if not snaps:
+                if ns.once:
+                    print(f"no rank_*.json snapshots under {live_dir} "
+                          "(is MPIT_OBS_LIVE armed?)", file=sys.stderr)
+                    return 2
+                print(f"waiting for snapshots under {live_dir} ...",
+                      file=sys.stderr)
+            else:
+                fired = engine.evaluate(snaps) if engine is not None else []
+                report = live_mod.aggregate(snaps)
+                report["alerts_fired"] = fired
+                if ns.json:
+                    json.dump(report, sys.stdout)
+                    print()
+                else:
+                    if not ns.once:
+                        # clear + home, full-refresh dashboard
+                        sys.stdout.write("\x1b[2J\x1b[H")
+                    _print_live(report, live_dir, fired)
+                    sys.stdout.flush()
+                if ns.once:
+                    return 1 if fired else 0
+            if deadline is not None and _time.monotonic() >= deadline:
+                return 0
+            _time.sleep(ns.refresh)
+    except KeyboardInterrupt:
+        return 0
+
+
 def main(argv=None) -> int:
     p = argparse.ArgumentParser(
         prog="python -m mpit_tpu.obs", description=__doc__,
@@ -104,6 +249,10 @@ def main(argv=None) -> int:
                     help="chaos fault log JSONL (or a directory of "
                          "faults*.jsonl, process mode) to overlay "
                          "(default: <run dir>/faults*.jsonl when present)")
+    mp.add_argument("--alerts", default=None,
+                    help="live-plane alerts.jsonl to overlay as instant "
+                         "markers (default: <run dir>/live/alerts.jsonl "
+                         "or <run dir>/alerts.jsonl when present)")
 
     sp = sub.add_parser("summary", help="per-rank event tallies")
     sp.add_argument("paths", nargs="+")
@@ -140,7 +289,48 @@ def main(argv=None) -> int:
                     help="e2e SLO applied to requests submitted without "
                          "one (default: such requests meet vacuously)")
 
+    vp = sub.add_parser(
+        "live",
+        help="live dashboard + alerts over live/rank_*.json snapshots",
+    )
+    vp.add_argument("path",
+                    help="run dir (MPIT_OBS_DIR) or its live/ subdir")
+    vp.add_argument("--once", action="store_true",
+                    help="one pass instead of a refreshing dashboard "
+                         "(exit 1 if new alerts fired)")
+    vp.add_argument("--json", action="store_true",
+                    help="emit the aggregate report as JSON (implies "
+                         "machine-readable; pairs with --once)")
+    vp.add_argument("--refresh", type=float, default=2.0,
+                    help="dashboard refresh interval, seconds (default 2)")
+    vp.add_argument("--max-seconds", type=float, default=None,
+                    help="stop the refreshing dashboard after this long")
+    vp.add_argument("--no-alerts", action="store_true",
+                    help="display only: skip the alert engine (nothing "
+                         "appended to alerts.jsonl)")
+    vp.add_argument("--staleness-factor", type=float, default=None,
+                    help="dead-rank threshold as a multiple of each "
+                         "rank's export interval (default 3)")
+    vp.add_argument("--straggler-spread", type=float, default=None,
+                    help="compute-fraction min-max spread that flags a "
+                         "straggler (default 0.25)")
+    vp.add_argument("--burn-threshold", type=float, default=None,
+                    help="SLO burn rate that alerts (default 1.0 = "
+                         "error budget consumed as fast as it accrues)")
+    vp.add_argument("--slo-target", type=float, default=None,
+                    help="SLO attainment target the burn rate is "
+                         "normalized against (default 0.95)")
+    vp.add_argument("--validate", action="store_true",
+                    help="strict-validate every snapshot against the "
+                         "versioned schema and exit (the lint.sh golden "
+                         "gate)")
+
     ns = p.parse_args(argv)
+
+    # live reads rank_*.json snapshots, not obs_rank*.jsonl journals —
+    # dispatch it before the journal-expansion gate below
+    if ns.cmd == "live":
+        return _cmd_live(ns)
 
     if ns.cmd == "summary" and ns.diff:
         if len(ns.paths) != 2:
@@ -223,9 +413,20 @@ def main(argv=None) -> int:
             # process-mode runs write one fault log per rank; the dir
             # form hands all of them to read_fault_log
             faults = first_dir
+    alerts = ns.alerts
+    if alerts is None and first_dir is not None:
+        for candidate in (
+            os.path.join(first_dir, "live", "alerts.jsonl"),
+            os.path.join(first_dir, "alerts.jsonl"),
+        ):
+            if os.path.exists(candidate):
+                alerts = candidate
+                break
     out_path = ns.out or os.path.join(first_dir or ".", "trace.json")
 
-    trace = merge_to_chrome_trace(journals, faults_path=faults)
+    trace = merge_to_chrome_trace(
+        journals, faults_path=faults, alerts_path=alerts
+    )
     with open(out_path, "w") as f:
         json.dump(trace, f)
 
@@ -236,11 +437,12 @@ def main(argv=None) -> int:
         if sum(1 for ids in by_rank.values() if t in ids) >= 2
     )
     n_faults = sum(1 for e in trace["traceEvents"] if e.get("cat") == "chaos")
+    n_alerts = sum(1 for e in trace["traceEvents"] if e.get("cat") == "alert")
     print(
         f"wrote {out_path}: {len(trace['traceEvents'])} events from "
         f"{len(by_rank) or len(journals)} rank(s), {len(all_traces)} "
-        f"trace(s) ({cross} cross-rank), {n_faults} fault marker(s) — "
-        "open in https://ui.perfetto.dev"
+        f"trace(s) ({cross} cross-rank), {n_faults} fault marker(s), "
+        f"{n_alerts} alert marker(s) — open in https://ui.perfetto.dev"
     )
     return 0
 

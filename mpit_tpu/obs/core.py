@@ -40,6 +40,12 @@ obs must never arm implicitly — only recognized ``MPIT_OBS_*`` knobs count.
                              dropped and counted, and close() appends a
                              ``journal_cap`` footer carrying
                              ``dropped_records`` (default: unbounded)
+  MPIT_OBS_LIVE         0|1  live telemetry plane: per-rank metrics
+                             registry + background snapshot exporter
+                             writing ``<dir>/live/rank_<r>.json``
+                             (:mod:`mpit_tpu.obs.live`; default 0)
+  MPIT_OBS_LIVE_INTERVAL
+                        sec  live snapshot export interval (default 1.0)
 """
 
 from __future__ import annotations
@@ -181,24 +187,35 @@ class ObsConfig:
     every Nth send/recv per (peer, tag) stream — counters still see every
     message, so summaries stay exact while journal volume shrinks;
     ``max_records`` caps each journal's record count (drops are counted
-    into the ``journal_cap`` footer — see :class:`Journal`)."""
+    into the ``journal_cap`` footer — see :class:`Journal`);
+    ``live=True`` arms the live telemetry plane — a per-rank
+    :class:`mpit_tpu.obs.live.MetricsRegistry` plus a background
+    exporter snapshotting ``<dir>/live/rank_<r>.json`` every
+    ``live_interval`` seconds (registry only when ``dir`` is None)."""
 
     dir: Optional[str] = None
     trace: bool = True
     telemetry: bool = True
     sample: int = 1
     max_records: Optional[int] = None
+    live: bool = False
+    live_interval: float = 1.0
 
     def __post_init__(self):
         if self.sample < 1:
             raise ValueError("sample must be >= 1")
         if self.max_records is not None and self.max_records < 1:
             raise ValueError("max_records must be >= 1")
+        if self.live_interval <= 0:
+            raise ValueError("live_interval must be > 0")
 
 
 _ENV_KNOBS = frozenset(
     "MPIT_OBS_" + k
-    for k in ("DIR", "TRACE", "TELEMETRY", "SAMPLE", "MAX_RECORDS")
+    for k in (
+        "DIR", "TRACE", "TELEMETRY", "SAMPLE", "MAX_RECORDS",
+        "LIVE", "LIVE_INTERVAL",
+    )
 )
 
 
@@ -216,6 +233,8 @@ def config_from_env(
         telemetry=env.get("MPIT_OBS_TELEMETRY", "1") != "0",
         sample=int(env.get("MPIT_OBS_SAMPLE", 1)),
         max_records=int(max_records) if max_records else None,
+        live=env.get("MPIT_OBS_LIVE", "0") not in ("", "0"),
+        live_interval=float(env.get("MPIT_OBS_LIVE_INTERVAL", 1.0)),
     )
 
 

@@ -114,11 +114,12 @@ def _rec_time(rec: dict) -> Optional[float]:
 def merge_to_chrome_trace(
     journal_paths: Iterable[str],
     faults_path: Optional[str] = None,
+    alerts_path: Optional[str] = None,
 ) -> dict:
     """Chrome-trace JSON object from per-rank journals (+ optional chaos
-    fault log). Wall-clock timestamps are rebased to the earliest event;
-    events within a rank keep journal order (monotonic per rank by the
-    Journal's construction)."""
+    fault log and live-plane ``alerts.jsonl``). Wall-clock timestamps
+    are rebased to the earliest event; events within a rank keep journal
+    order (monotonic per rank by the Journal's construction)."""
     journal_paths = expand_journal_paths(journal_paths)
     per_rank: dict[int, list[dict]] = {}
     for path in journal_paths:
@@ -290,6 +291,25 @@ def merge_to_chrome_trace(
                 "ph": "i", "s": "p", "name": f"fault {fault['kind']}",
                 "cat": "chaos", "pid": fault["src"], "tid": 0, "ts": ts,
                 "args": args,
+            })
+
+    if alerts_path is not None:
+        # live-plane alerts join by (rank, wall-clock): unlike chaos
+        # faults (no timestamp — joined through the send stream index)
+        # an alert record carries the aggregator's wall-clock `t`, which
+        # shares the journals' timebase, so it places directly. Alerts
+        # raised after the last journal event (a dead rank is noticed
+        # only once its journal went quiet) land past the timeline end —
+        # that is the honest position, not an artifact.
+        for rec in read_journal(alerts_path):
+            if rec.get("ev") != "alert" or rec.get("t") is None:
+                continue
+            events.append({
+                "ph": "i", "s": "p",
+                "name": f"alert {rec.get('kind', '?')}",
+                "cat": "alert", "pid": rec.get("rank", 0), "tid": 0,
+                "ts": max(us(rec["t"]), 0.0),
+                "args": rec.get("detail", {}),
             })
 
     events.sort(key=lambda e: (e.get("ts", 0.0), e["pid"]))

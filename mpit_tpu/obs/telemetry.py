@@ -31,10 +31,12 @@ by the micro-benchmark in tests/test_obs.py.
 
 from __future__ import annotations
 
+import os
 import time
 from typing import Any, Optional, Sequence
 
 from mpit_tpu.analysis.runtime import make_lock
+from mpit_tpu.obs.live import LiveExporter, MetricsRegistry
 from mpit_tpu.obs.core import (
     _ENVELOPE_MARK,
     Journal,
@@ -150,6 +152,21 @@ class TelemetryTransport(Transport):
         self._send_stats: dict[tuple[int, int], _PeerTagStats] = {}
         self._recv_stats: dict[tuple[int, int], _PeerTagStats] = {}
         self._max_queue_depth = 0
+        # live telemetry plane (MPIT_OBS_LIVE): a registry protocol code
+        # publishes into via live_registry(transport), fed the aggregated
+        # wire counters via a pull collector, exported by a background
+        # thread when a run dir exists (registry only otherwise)
+        self.obs_registry: Optional[MetricsRegistry] = None
+        self._live_exporter: Optional[LiveExporter] = None
+        if config.live:
+            self.obs_registry = MetricsRegistry(inner.rank)
+            self.obs_registry.add_collector("wire", self._live_wire_fragment)
+            if config.dir is not None:
+                self._live_exporter = LiveExporter(
+                    self.obs_registry,
+                    os.path.join(config.dir, "live"),
+                    interval_s=config.live_interval,
+                )
 
     # -- accounting -------------------------------------------------------
 
@@ -331,7 +348,48 @@ class TelemetryTransport(Transport):
         try:
             self.inner.close()
         finally:
-            self.obs_tracer.close()
+            try:
+                self.obs_tracer.close()
+            finally:
+                self.close_live()
+
+    def close_live(self) -> None:
+        """Stop the live exporter (final snapshot lands on disk);
+        idempotent, a no-op when live telemetry is not armed. Called from
+        :meth:`close` and from the trainer teardown, which closes tracers
+        explicitly rather than closing wrappers."""
+        if self._live_exporter is not None:
+            self._live_exporter.close()
+            self._live_exporter = None
+
+    def _live_wire_fragment(self) -> dict:
+        """Live-snapshot collector: the per-(peer, tag) tables aggregated
+        to rank totals (the dashboard wants a health line per rank, not
+        the full matrix — ``summary()`` still has the split), plus the
+        queue-depth gauge. Pulled at export time so the send/recv hot
+        path pays nothing for the live plane."""
+        tx = {"msgs": 0, "bytes": 0, "errs": 0}
+        rx = {"msgs": 0, "bytes": 0, "errs": 0, "timeouts": 0}
+        lat: dict[str, int] = {}
+        with self._stats_lock:
+            for s in self._send_stats.values():
+                tx["msgs"] += s.msgs
+                tx["bytes"] += s.bytes
+                tx["errs"] += s.errs
+                for b, c in s.hist.items():
+                    lat[str(b)] = lat.get(str(b), 0) + c
+            for s in self._recv_stats.values():
+                rx["msgs"] += s.msgs
+                rx["bytes"] += s.bytes
+                rx["errs"] += s.errs
+                rx["timeouts"] += s.timeouts
+        out: dict[str, Any] = {"tx": tx, "rx": rx}
+        if lat:
+            out["send_lat_hist_log2us"] = lat
+        depth = self._queue_depth()
+        if depth is not None:
+            out["queue_depth"] = depth
+        return out
 
     # -- reporting --------------------------------------------------------
 

@@ -16,6 +16,7 @@ stay protocol-identical.
 from __future__ import annotations
 
 import logging
+import time
 from typing import Callable, Optional
 
 import jax
@@ -23,6 +24,19 @@ import numpy as np
 import optax
 
 from mpit_tpu.obs.core import span as obs_span
+from mpit_tpu.obs.live import (
+    M_COMPUTE_S,
+    M_EXCHANGE_FAILURES,
+    M_EXCHANGE_LAT,
+    M_EXCHANGE_S,
+    M_PUSHES,
+    M_ROUNDS,
+    M_SAMPLES,
+    M_SKIPPED_ROUNDS,
+    M_STALE_PARAMS,
+    M_STEPS,
+    live_registry,
+)
 from mpit_tpu.parallel import common
 from mpit_tpu.parallel.pclient import PClient
 from mpit_tpu.transport import RecvTimeout
@@ -103,6 +117,10 @@ def client_train_loop(
     from mpit_tpu.utils.params import flatten_params
 
     rng = np.random.default_rng(seed)
+    # live-metrics hook: NULL_REGISTRY unless MPIT_OBS_LIVE armed the
+    # transport (docs/OBSERVABILITY.md "live") — publishes below are
+    # unconditional, the disabled path is a no-op method call per round
+    reg = live_registry(client.transport)
     # obs_span is the no-op NULL_SPAN unless the transport is obs-wrapped
     # (docs/OBSERVABILITY.md) — each span groups one exchange's wire
     # traffic under a single trace on the merged timeline
@@ -125,6 +143,7 @@ def client_train_loop(
     round_no = 0
     while done < steps:
         k = min(tau, steps - done)
+        t_c = time.perf_counter()
         with obs_span(
             client.transport, "compute", round=round_no + 1, steps=k
         ) as cspan:
@@ -137,12 +156,16 @@ def client_train_loop(
             if cspan is not None:
                 # span live → pay the sync so compute time is real
                 force_completion(params, loss)
+        reg.inc(M_STEPS, k)
+        reg.inc(M_SAMPLES, k * batch_size)
+        reg.inc(M_COMPUTE_S, time.perf_counter() - t_c)
         done += k
         if k < tau:
             break  # steps % tau remainder trains without an exchange
         round_no += 1
         flush()
         flat = np.asarray(flatten_params(params)[0])
+        t_x = time.perf_counter()
         with obs_span(
             client.transport, "exchange",
             round=round_no, algo=algo,
@@ -169,6 +192,7 @@ def client_train_loop(
             except (RecvTimeout, ConnectionError, OSError) as e:
                 total_failures += 1
                 consecutive_failures += 1
+                reg.inc(M_EXCHANGE_FAILURES)
                 if max_exchange_failures is None:
                     raise  # fail-fast semantics (degradation not enabled)
                 if consecutive_failures >= max_exchange_failures:
@@ -178,6 +202,8 @@ def client_train_loop(
                         "training further against an unreachable center"
                     ) from e
                 skipped_rounds += 1
+                reg.inc(M_SKIPPED_ROUNDS)
+                reg.inc(M_EXCHANGE_S, time.perf_counter() - t_x)
                 logger.warning(
                     "PS exchange failed (%r); skipping round on the "
                     "stale center (%d consecutive failure(s))",
@@ -186,6 +212,12 @@ def client_train_loop(
                 )
                 continue  # params stay local this round
             consecutive_failures = 0
+            dt_x = time.perf_counter() - t_x
+            reg.inc(M_ROUNDS)
+            reg.inc(M_EXCHANGE_S, dt_x)
+            reg.observe(M_EXCHANGE_LAT, dt_x)
+            reg.set_gauge(M_PUSHES, sum(client.push_sent.values()))
+            reg.set_gauge(M_STALE_PARAMS, client.stale_params_dropped)
             params = unflatten_params(spec, jnp.asarray(flat))
     flush()  # flush any remainder losses
     if exchange_stats is not None:

@@ -38,6 +38,20 @@ from mpit_tpu.transport.chaos import (
 from mpit_tpu.utils.params import flatten_params, unflatten_params
 
 
+def _chaos_counts(fault_log: FaultLog, rank: int) -> Callable[[], dict]:
+    """Live-snapshot collector: this rank's injected-fault counts by kind
+    (faults are attributed to the rank whose send the injector hit)."""
+
+    def counts() -> dict:
+        out: dict = {}
+        for e in fault_log.events():
+            if e.src == rank:
+                out[e.kind] = out.get(e.kind, 0) + 1
+        return out
+
+    return counts
+
+
 class AsyncPSTrainer:
     """2-pclient+1-pserver-style async training (counts configurable).
 
@@ -216,6 +230,14 @@ class AsyncPSTrainer:
         if obs_cfg is not None:
             transports = wrap_obs_transports(transports, obs_cfg)
             obs_transports = transports
+            if obs_cfg.live and self.fault_log is not None:
+                # per-rank chaos fault counts ride the live snapshots: a
+                # pull collector sampled at export time (the FaultLog is
+                # already thread-safe; no hot-path cost)
+                for t in obs_transports:
+                    t.obs_registry.add_collector(
+                        "chaos", _chaos_counts(self.fault_log, t.rank)
+                    )
         server_ranks = list(range(self.num_servers))
         client_ranks = list(
             range(self.num_servers, self.num_servers + self.num_clients)
@@ -363,6 +385,8 @@ class AsyncPSTrainer:
                 # flush/close journals now — the broker dies with this
                 # call, and a merge may run immediately after train()
                 t.obs_tracer.close()
+                # stop live exporters too (final snapshot hits disk)
+                t.close_live()
         return center_params, stats
 
     def evaluate(self, params, x, y, batch: int = 512) -> float:

@@ -1,7 +1,7 @@
 #!/usr/bin/env python
-"""Compare the newest two BENCH_*.json snapshots; flag regressions.
+"""Compare BENCH_*.json snapshots; flag regressions.
 
-    python scripts/bench_gate.py [--strict] [--threshold 0.10] [DIR]
+    python scripts/bench_gate.py [--strict] [--trend] [--threshold 0.10] [DIR]
 
 The driver writes one ``BENCH_r<NN>.json`` per round (``n``, ``cmd``,
 ``rc``, ``tail``, ``parsed`` = the bench's JSON line). This gate reads
@@ -12,16 +12,23 @@ threshold in the direction that hurts:
 - latency fields (``*_ms``) rising;
 - ``goodput`` dropping.
 
+``--trend`` additionally scores the newest round against the BEST round
+in the longest comparable history suffix (same metric, same platform
+mode): five rounds each 3% slower never trip the pairwise 10% gate, but
+the newest-vs-peak comparison catches the accumulated drift. The trend
+pass uses the same ``--threshold`` and prints the series it scored.
+
 Rounds measured on different platforms (a TPU round vs a dead-tunnel
 CPU-smoke fallback, visible via ``platform``/``platform_note``) are
 reported but never flagged — a 1000x "regression" between a TPU number
 and a CPU number is a platform change, not a code change.
 
 Warn-only by default (exit 0 with warnings printed) because bench noise
-must not block commits — scripts/lint.sh runs it that way. ``--strict``
-exits 1 on flags for CI lanes that do gate on trajectory. Exit 2 on
-usage errors only; fewer than two comparable snapshots is a clean pass
-(nothing to compare is not a regression).
+must not block commits — scripts/lint.sh runs it that way (with
+``--trend``). ``--strict`` exits 1 on flags (pairwise or trend) for CI
+lanes that do gate on trajectory. Exit 2 on usage errors only; fewer
+than two comparable snapshots is a clean pass (nothing to compare is
+not a regression).
 
 Stdlib-only and import-free of the package: safe in pre-commit hooks.
 """
@@ -99,11 +106,96 @@ def compare(old: dict, new: dict, threshold: float) -> list:
     return flags
 
 
+def comparable_series(rounds: list) -> list:
+    """The longest suffix of ``rounds`` sharing the newest round's
+    metric and platform mode — the history the trend pass scores."""
+    if not rounds:
+        return []
+    newest = rounds[-1][2]
+    key = (newest.get("metric"), _platform_mode(newest))
+    series: list = []
+    for item in reversed(rounds):
+        parsed = item[2]
+        if (parsed.get("metric"), _platform_mode(parsed)) != key:
+            break
+        series.append(item)
+    series.reverse()
+    return series
+
+
+def trend(rounds: list, threshold: float) -> tuple[list, str]:
+    """(flag strings, series label) for newest-vs-best-of-history drift.
+
+    Best means per-key best: max for ``value``/``goodput``, min for each
+    ``*_ms`` — a single strong round anywhere in the comparable history
+    is the standard the newest must stay within ``threshold`` of."""
+    series = comparable_series(rounds)
+    if len(series) < 3:
+        # pairwise already covers 2; a 2-round "trend" would double-warn
+        return [], ""
+    newest_n, newest_path, newest = series[-1]
+    history = [p for _, _, p in series[:-1]]
+    label = (
+        f"{os.path.basename(series[0][1])}.."
+        f"{os.path.basename(newest_path)} "
+        f"({len(series)} rounds, {newest.get('metric')}, "
+        f"{_platform_mode(newest)})"
+    )
+
+    def _num(d, k):
+        v = d.get(k)
+        return v if isinstance(v, (int, float)) and not isinstance(
+            v, bool
+        ) else None
+
+    flags = []
+    for key, best_of in (("value", max), ("goodput", max)):
+        vals = [
+            (v, i) for i, p in enumerate(history)
+            if (v := _num(p, key)) is not None and v > 0
+        ]
+        nv = _num(newest, key)
+        if not vals or nv is None:
+            continue
+        best, at = best_of(vals)
+        drop = (best - nv) / best
+        if drop > threshold:
+            flags.append(
+                f"{key} peaked at {best} in "
+                f"{os.path.basename(series[at][1])}, now {nv} "
+                f"({drop:.1%} below peak)"
+            )
+    ms_keys = sorted(
+        k for k in newest if _MS_KEY.search(k)
+        if isinstance(newest.get(k), (int, float))
+    )
+    for k in ms_keys:
+        vals = [
+            (v, i) for i, p in enumerate(history)
+            if (v := _num(p, k)) is not None and v > 0
+        ]
+        nv = _num(newest, k)
+        if not vals or nv is None or nv <= 0:
+            continue
+        best, at = min(vals)
+        rise = (nv - best) / best
+        if rise > threshold:
+            flags.append(
+                f"{k} best was {best} in "
+                f"{os.path.basename(series[at][1])}, now {nv} "
+                f"({rise:.1%} above best)"
+            )
+    return flags, label
+
+
 def main(argv=None) -> int:
     argv = list(sys.argv[1:] if argv is None else argv)
     strict = "--strict" in argv
     if strict:
         argv.remove("--strict")
+    trend_mode = "--trend" in argv
+    if trend_mode:
+        argv.remove("--trend")
     threshold = 0.10
     if "--threshold" in argv:
         i = argv.index("--threshold")
@@ -125,26 +217,34 @@ def main(argv=None) -> int:
         return 0
     (_, old_path, old), (_, new_path, new) = rounds[-2], rounds[-1]
 
+    any_flags = False
     if old.get("metric") != new.get("metric"):
         print(f"bench_gate: metric changed "
               f"{old.get('metric')} -> {new.get('metric')} — skipping")
-        return 0
-    om, nm = _platform_mode(old), _platform_mode(new)
-    if om != nm:
+    elif (om := _platform_mode(old)) != (nm := _platform_mode(new)):
         print(f"bench_gate: platform changed {om} -> {nm} "
               f"({os.path.basename(old_path)} -> "
               f"{os.path.basename(new_path)}) — not comparable")
-        return 0
+    else:
+        flags = compare(old, new, threshold)
+        label = (f"{os.path.basename(old_path)} -> "
+                 f"{os.path.basename(new_path)} "
+                 f"({new.get('metric')}, {nm})")
+        if not flags:
+            print(f"bench_gate: OK {label}")
+        for f in flags:
+            print(f"bench_gate: WARNING {label}: {f}")
+            any_flags = True
 
-    flags = compare(old, new, threshold)
-    label = (f"{os.path.basename(old_path)} -> "
-             f"{os.path.basename(new_path)} ({new.get('metric')}, {nm})")
-    if not flags:
-        print(f"bench_gate: OK {label}")
-        return 0
-    for f in flags:
-        print(f"bench_gate: WARNING {label}: {f}")
-    return 1 if strict else 0
+    if trend_mode:
+        tflags, tlabel = trend(rounds, threshold)
+        if tlabel and not tflags:
+            print(f"bench_gate: trend OK {tlabel}")
+        for f in tflags:
+            print(f"bench_gate: TREND WARNING {tlabel}: {f}")
+            any_flags = True
+
+    return 1 if (strict and any_flags) else 0
 
 
 if __name__ == "__main__":

@@ -8,6 +8,8 @@
 # tier-1 contract; the offset just widens the swept space here). Wall-clock
 # is bounded: the loop stops starting new rounds once MAX_SECONDS (default
 # 600) is spent, so CI can pin a budget without killing a round midway.
+# After the sweep, one live-armed 3-rank process round runs and gates on
+# the alert engine (`obs live --once`): unexpected alerts exit nonzero.
 set -euo pipefail
 cd "$(dirname "$0")/.."
 
@@ -34,5 +36,26 @@ if ((FAILED)); then
   echo "chaos_soak: FAILED at seed offset ${i} — replay with:" >&2
   echo "  MPIT_CHAOS_SOAK_OFFSET=${i} python -m pytest tests/test_chaos.py -m slow" >&2
   exit 1
+fi
+
+# One live-armed process-mode round on top of the seed sweep: a healthy
+# 3-rank run must come out alert-free — any dead-rank/straggler firing
+# here is a regression in either the trainer or the alert thresholds.
+# (--straggler-spread is loosened: two client threads sharing CPU cores
+# legitimately skew more than two real chips would.)
+if ((SECONDS - START < MAX_SECONDS)); then
+  echo "=== chaos soak: live-armed 3-rank round ===" >&2
+  OUT="$(mktemp -d)"
+  trap 'rm -rf "$OUT"' EXIT
+  env JAX_PLATFORMS=cpu \
+      MPIT_OBS_DIR="$OUT" MPIT_OBS_LIVE=1 MPIT_OBS_LIVE_INTERVAL=0.25 \
+      timeout -k 10 120 \
+      python -m mpit_tpu.launch -n 3 examples/ptest_proc.py \
+      --model mlp --steps 16 --train-size 256 --algo ps-easgd
+  python -m mpit_tpu.obs live "$OUT" --once --json --straggler-spread 0.6
+  rm -rf "$OUT"
+  trap - EXIT
+else
+  echo "chaos_soak: budget spent; skipping live-armed round" >&2
 fi
 echo "chaos_soak: OK"

@@ -6,12 +6,15 @@
 #   scripts/lint.sh --fix           # rewrite fixable MPT002 sites, then gate
 #   scripts/lint.sh path/to/file.py # lint specific paths (vs the baseline)
 #
-# The default run is three gates behind the one baseline:
-#   1. the static lint (MPT001-008) + protocol model check (MPT009-011);
+# The default run is four gates behind the one baseline:
+#   1. the static lint (MPT001-008, MPT012) + protocol model check
+#      (MPT009-011);
 #   2. an explicit `mcheck` pass, so the exhaustive state counts land in
 #      the CI log even when everything is green;
 #   3. a smoke `conform` pass over the checked-in good-run journals —
-#      the trace-conformance path (TC201-203) exercised on every lint.
+#      the trace-conformance path (TC201-203) exercised on every lint;
+#   4. live-snapshot schema validation over the checked-in golden
+#      (tests/fixtures/live — the `obs live --validate` contract).
 # The whole default run is bounded to < 15 s wall-clock
 # (tests/test_lint_gate.py enforces it).
 #
@@ -38,7 +41,9 @@ python -m mpit_tpu.analysis "${@:-mpit_tpu/}"
 if [[ $# -eq 0 ]]; then
     python -m mpit_tpu.analysis mcheck
     python -m mpit_tpu.analysis conform tests/fixtures/conformance/good_run
+    # the live-snapshot schema contract, gated on the checked-in golden
+    python -m mpit_tpu.obs live tests/fixtures/live --validate
     # warn-only: bench trajectory drift should be SEEN at lint time, but
     # bench noise must never block a commit (--strict exists for CI)
-    python scripts/bench_gate.py || true
+    python scripts/bench_gate.py --trend || true
 fi

@@ -1,0 +1,42 @@
+#!/usr/bin/env bash
+# One-command live-telemetry demo (docs/OBSERVABILITY.md, *Live
+# telemetry plane*):
+#
+#   scripts/live_demo.sh [OUT_DIR] [MAX_SECONDS]
+#
+# Runs a small multi-process PS training (1 server, 2 clients over real
+# SocketTransport) with the live plane armed, then reads the per-rank
+# snapshots back two ways:
+#
+#   OUT_DIR/live/rank_{0,1,2}.json  atomic per-rank snapshots
+#   stdout                          dashboard table, then --once --json
+#
+# Wall-clock is bounded: the training run is killed at MAX_SECONDS
+# (default 120) rather than hanging the shell. The final --once pass
+# runs the alert engine; new alerts exit 1 and fail the demo — a clean
+# 3-rank run must be alert-free.
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+OUT_DIR="${1:-/tmp/mpit_live_demo}"
+MAX_SECONDS="${2:-120}"
+
+rm -rf "$OUT_DIR"
+mkdir -p "$OUT_DIR"
+
+echo "=== live_demo: 3-rank easgd run, snapshots -> $OUT_DIR/live ==="
+env JAX_PLATFORMS=cpu \
+    MPIT_OBS_DIR="$OUT_DIR" \
+    MPIT_OBS_LIVE=1 \
+    MPIT_OBS_LIVE_INTERVAL=0.25 \
+    timeout -k 10 "$MAX_SECONDS" \
+    python -m mpit_tpu.launch -n 3 examples/ptest_proc.py \
+    --model mlp --steps 16 --train-size 256 --algo ps-easgd
+
+echo "=== live_demo: dashboard (one pass) ==="
+python -m mpit_tpu.obs live "$OUT_DIR" --once --no-alerts
+
+echo "=== live_demo: machine-readable + alert gate ==="
+python -m mpit_tpu.obs live "$OUT_DIR" --once --json
+
+echo "live_demo: OK — watch a run in-flight with: python -m mpit_tpu.obs live $OUT_DIR"

@@ -6,7 +6,9 @@
 # Each round drives `python -m mpit_tpu.loadgen` with a fresh seed
 # (workload AND chaos schedule derive from it) into a throwaway journal
 # dir, then gates the journals through
-# `python -m mpit_tpu.obs slo --gate scripts/slo_smoke.json`. Wall-clock
+# `python -m mpit_tpu.obs slo --gate scripts/slo_smoke.json` and the
+# live alert engine (`obs live --once` — runs are live-armed; any alert
+# firing fails the round). Wall-clock
 # is bounded like chaos_soak.sh: no new round starts once MAX_SECONDS
 # (default 600) is spent. A failing seed prints its exact replay line —
 # the run is a pure function of the seed, so the failure reproduces.
@@ -28,10 +30,16 @@ for ((i = 0; i < N_SEEDS; i++)); do
   trap 'rm -rf "$OUT"' EXIT
   if ! env JAX_PLATFORMS=cpu python -m mpit_tpu.loadgen \
       --out "$OUT" --seed "$i" --requests 16 --rate 500 \
-      --cancel-prob 0.1 --chaos-delay-p 0.05; then
+      --cancel-prob 0.1 --chaos-delay-p 0.05 --live; then
     FAILED=1
   elif ! env JAX_PLATFORMS=cpu python -m mpit_tpu.obs slo "$OUT" \
       --gate scripts/slo_smoke.json; then
+    FAILED=1
+  # live health gate: alert thresholds aligned with slo_smoke.json
+  # (goodput_min 0.5 -> slo_target 0.5), so a run the SLO gate passes
+  # must not burn-alert; any firing exits 1 and fails the round
+  elif ! env JAX_PLATFORMS=cpu python -m mpit_tpu.obs live "$OUT" \
+      --once --json --slo-target 0.5 --burn-threshold 1.0; then
     FAILED=1
   fi
   rm -rf "$OUT"

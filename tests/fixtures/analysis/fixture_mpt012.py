@@ -1,0 +1,20 @@
+"""Seeded MPT012: a typo'd live-metric name published to the registry.
+
+The module is in the live plane's import closure (it imports
+``live_registry``), so every ``inc``/``set_gauge``/``observe`` first
+argument must be an ``M_*`` constant from ``mpit_tpu.obs.live``. The one
+publish below uses a string literal with a transposition
+(``train.ronuds``) — exactly the defect the rule exists for: the series
+forks silently and the dashboard's rounds column flatlines. The clean
+publish next to it pins the other direction (a namespace constant
+resolves and is NOT flagged). Parsed by the linter tests, never
+imported or executed.
+"""
+
+from mpit_tpu.obs.live import M_SAMPLES, live_registry
+
+
+def train_round(client, k, batch_size):
+    reg = live_registry(client.transport)
+    reg.inc(M_SAMPLES, k * batch_size)
+    reg.inc("train.ronuds")  # transposed "train.rounds" — forked series

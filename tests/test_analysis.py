@@ -50,12 +50,13 @@ def test_repo_matches_baseline():
 def test_baseline_has_no_new_rule_entries():
     """Satellite contract: the true positives MPT004/MPT007/MPT008 found
     in the repo were FIXED, not baselined — the baseline must carry zero
-    fingerprints for them, ever."""
+    fingerprints for them, ever. MPT012 joins the set: every live-metric
+    publish in the package uses the registered M_* constants."""
     baseline = findings_mod.load_baseline(BASELINE)
     polluted = [
         fp
         for fp in baseline
-        if fp.split("|")[0] in {"MPT004", "MPT007", "MPT008"}
+        if fp.split("|")[0] in {"MPT004", "MPT007", "MPT008", "MPT012"}
     ]
     assert polluted == []
 
@@ -92,6 +93,7 @@ def test_baseline_is_not_stale():
         # rule EXACTLY ONCE — the pairing/resolution around the one seeded
         # defect has to come out clean
         ("fixture_mpt007.py", "MPT007"),
+        ("fixture_mpt012.py", "MPT012"),
         ("fixture_mpt008", "MPT008"),
         ("fixture_mpt004_chain", "MPT004"),
         # model-checked rules: the whole miniature protocol pair is
@@ -390,6 +392,68 @@ def test_mpt007_config_override(tmp_path):
     )
     assert [f.rule for f in findings] == ["MPT007"]
     assert "drift" in findings[0].message
+
+
+# --------------------------------------------------------- MPT012 (metrics)
+
+_LIVE = "from mpit_tpu.obs.live import M_ROUNDS, live_registry\n"
+
+
+def test_mpt012_matching_literal_still_flagged(tmp_path):
+    """The literal equals a registered name TODAY, but a rename of the
+    constant would silently strand it — the M_* constant is required."""
+    findings = _lint_source(
+        tmp_path, _LIVE + "def f(reg):\n    reg.inc('train.rounds')\n"
+    )
+    assert [f.rule for f in findings] == ["MPT012"]
+    assert "strand" in findings[0].message
+
+
+def test_mpt012_wrong_valued_constant_is_drift(tmp_path):
+    """A module-local constant resolving to an unregistered value forks
+    the series exactly like a literal typo would."""
+    findings = _lint_source(
+        tmp_path,
+        _LIVE
+        + "M_BOGUS = 'train.bogus'\n"
+        "def f(reg):\n"
+        "    reg.set_gauge(M_BOGUS, 1.0)\n",
+    )
+    assert [f.rule for f in findings] == ["MPT012"]
+    assert "resolves to 'train.bogus'" in findings[0].message
+
+
+def test_mpt012_unresolvable_namespace_shaped_name(tmp_path):
+    """An M_* spelling the namespace does not define is a typo'd import
+    or a deleted constant, even when resolution gives up."""
+    findings = _lint_source(
+        tmp_path, _LIVE + "def f(reg):\n    reg.observe(M_MISSPELLED, 0.1)\n"
+    )
+    assert [f.rule for f in findings] == ["MPT012"]
+    assert "M_MISSPELLED" in findings[0].message
+
+
+def test_mpt012_registered_constant_clean(tmp_path):
+    findings = _lint_source(
+        tmp_path, _LIVE + "def f(reg):\n    reg.inc(M_ROUNDS)\n"
+    )
+    assert findings == []
+
+
+def test_mpt012_out_of_scope_observe_clean(tmp_path):
+    # no live-plane import: ``observe`` here is LogicalClock/SLO-style,
+    # not a registry publish — must not be checked at all
+    findings = _lint_source(
+        tmp_path, "def f(clock):\n    clock.observe('whatever')\n"
+    )
+    assert findings == []
+    # in scope, but the argument is a local non-M_* name: out of static
+    # reach, same stance as MPT007 on dynamic protocol expressions
+    findings = _lint_source(
+        tmp_path,
+        _LIVE + "def f(clock, remote_clk):\n    clock.observe(remote_clk)\n",
+    )
+    assert findings == []
 
 
 # ------------------------------------------------------------ MPT008 (roles)

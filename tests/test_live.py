@@ -1,0 +1,586 @@
+"""mpit_tpu.obs.live + alerts tests (docs/OBSERVABILITY.md, "live").
+
+Layers under test: the MetricsRegistry's rolling-window semantics under
+an injected clock, the exporter's atomic heartbeat contract
+(tmp+rename, monotonic ``seq``, first/final writes), the disabled fast
+path's overhead (NULL_REGISTRY, pinned by a micro-benchmark like
+NULL_SPAN), the recognized-knob env arming, the alert engine's three
+conditions with dedup/re-arm — including a dead-rank alert within one
+staleness window after a chaos kill silences a rank, and a straggler
+alert whose skew comes from a seeded chaos delay on one rank's wire —
+the checked-in golden snapshot, and the AsyncPSTrainer integration
+(in-thread and, slow-marked, the real 3-process socket launch).
+"""
+
+import json
+import os
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from mpit_tpu.obs import ObsConfig, config_from_env
+from mpit_tpu.obs.__main__ import main as obs_main
+from mpit_tpu.obs.alerts import (
+    AlertConfig,
+    AlertEngine,
+    read_alerts,
+    staleness_s,
+)
+from mpit_tpu.obs.live import (
+    M_COMPUTE_S,
+    M_EXCHANGE_S,
+    M_REQ_FINISHED,
+    M_ROUNDS,
+    M_SAMPLES,
+    M_SLO_MISSES,
+    NULL_REGISTRY,
+    SNAPSHOT_SCHEMA,
+    LiveExporter,
+    MetricsRegistry,
+    aggregate,
+    compute_fraction,
+    find_live_dir,
+    live_registry,
+    percentile_ms,
+    read_snapshots,
+    validate_snapshot,
+)
+from mpit_tpu.transport import (
+    Broker,
+    ChaosConfig,
+    ChaosTransport,
+    RecvTimeout,
+)
+
+FIXTURES = os.path.join(
+    os.path.dirname(os.path.abspath(__file__)), "fixtures", "live"
+)
+
+
+class _Clock:
+    """Injectable monotonic source for the rolling windows."""
+
+    def __init__(self, t=0.0):
+        self.t = t
+
+    def __call__(self):
+        return self.t
+
+
+# ------------------------------------------------------------- registry
+
+
+class TestRegistry:
+    def test_counter_total_and_rolling_rate(self):
+        clk = _Clock()
+        reg = MetricsRegistry(0, window_s=30.0, clock=clk)
+        for _ in range(10):
+            reg.inc(M_SAMPLES, 10)
+        clk.t = 10.0
+        snap = reg.snapshot()
+        c = snap["counters"][M_SAMPLES]
+        # covered = min(window, uptime) = 10s -> 100 samples / 10s
+        assert c["total"] == 100
+        assert c["rate"] == pytest.approx(10.0)
+
+    def test_rolling_window_expires_rate_keeps_total(self):
+        clk = _Clock()
+        reg = MetricsRegistry(0, window_s=30.0, clock=clk)
+        reg.inc(M_SAMPLES, 100)
+        clk.t = 100.0  # all slices aged out; uptime > window
+        snap = reg.snapshot()
+        c = snap["counters"][M_SAMPLES]
+        assert c["total"] == 100
+        assert c["rate"] == 0.0
+
+    def test_gauge_coerces_to_float(self):
+        reg = MetricsRegistry(0)
+        reg.set_gauge(M_ROUNDS, 3)  # int from a host-side counter dict
+        v = reg.snapshot()["gauges"][M_ROUNDS]
+        assert isinstance(v, float) and v == 3.0
+
+    def test_hist_buckets_and_percentiles(self):
+        reg = MetricsRegistry(0)
+        for _ in range(99):
+            reg.observe("x", 0.001)  # 1 ms
+        reg.observe("x", 0.1)  # one 100 ms outlier
+        h = reg.snapshot()["hists"]["x"]
+        assert h["count"] == 100
+        assert h["sum_s"] == pytest.approx(0.199, abs=1e-6)
+        p50 = percentile_ms(h["buckets"], 0.50)
+        p99 = percentile_ms(h["buckets"], 0.999)
+        assert 0.5 < p50 < 2.0
+        assert 50.0 < p99 < 200.0
+
+    def test_broken_collector_contained(self):
+        reg = MetricsRegistry(0)
+
+        def boom():
+            raise RuntimeError("collector died")
+
+        reg.add_collector("bad", boom)
+        reg.add_collector("good", lambda: {"n": 1})
+        snap = reg.snapshot()
+        assert "error" in snap["collect"]["bad"]
+        assert snap["collect"]["good"] == {"n": 1}
+
+    def test_compute_fraction_reads_rolling_rate(self):
+        clk = _Clock()
+        reg = MetricsRegistry(0, window_s=30.0, clock=clk)
+        reg.inc(M_COMPUTE_S, 6.0)
+        clk.t = 10.0
+        assert compute_fraction(reg.snapshot()) == pytest.approx(0.6)
+        assert compute_fraction(MetricsRegistry(1).snapshot()) is None
+
+    def test_rejects_bad_window(self):
+        with pytest.raises(ValueError):
+            MetricsRegistry(0, window_s=0.0)
+        with pytest.raises(ValueError):
+            MetricsRegistry(0, slices=0)
+
+
+# ------------------------------------------------------------- exporter
+
+
+class TestExporter:
+    def test_first_write_immediate_final_write_on_close(self, tmp_path):
+        reg = MetricsRegistry(3)
+        exp = LiveExporter(reg, str(tmp_path), interval_s=60.0)
+        try:
+            deadline = time.monotonic() + 5.0
+            while not os.path.exists(exp.path):
+                assert time.monotonic() < deadline, "no first heartbeat"
+                time.sleep(0.01)
+            with open(exp.path) as f:
+                first = json.load(f)
+            assert first["seq"] == 1  # immediately, not one interval in
+        finally:
+            exp.close()
+        exp.close()  # idempotent
+        with open(exp.path) as f:
+            last = json.load(f)
+        assert last["seq"] > first["seq"]
+        assert last["interval_s"] == 60.0
+        # atomic writes: no temp files survive
+        assert [p.name for p in tmp_path.glob("*.tmp.*")] == []
+        assert exp.write_errors == 0
+
+    def test_snapshot_schema_round_trip(self, tmp_path):
+        reg = MetricsRegistry(0, role="serve")
+        reg.inc(M_REQ_FINISHED, 5)
+        reg.observe("serve.e2e_s", 0.02)
+        reg.set_gauge("serve.waiting", 2)
+        reg.add_collector("wire", lambda: {"tx": {"msgs": 1}})
+        exp = LiveExporter(reg, str(tmp_path), interval_s=60.0, start=False)
+        exp.write()
+        snaps = read_snapshots(str(tmp_path))
+        assert list(snaps) == [0]
+        snap = snaps[0]
+        assert validate_snapshot(snap) == []
+        assert snap["schema"] == SNAPSHOT_SCHEMA
+        assert snap["role"] == "serve"
+        assert snap["counters"][M_REQ_FINISHED]["total"] == 5
+        assert snap["collect"]["wire"]["tx"]["msgs"] == 1
+
+    def test_read_snapshots_skips_torn_and_invalid(self, tmp_path):
+        reg = MetricsRegistry(0)
+        LiveExporter(reg, str(tmp_path), interval_s=60.0, start=False).write()
+        (tmp_path / "rank_1.json").write_text("{ torn")
+        (tmp_path / "rank_2.json").write_text('{"schema": 999}')
+        assert list(read_snapshots(str(tmp_path))) == [0]
+
+    def test_validate_flags_missing_fields(self):
+        assert validate_snapshot("nope") != []
+        reg = MetricsRegistry(0)
+        snap = reg.snapshot()  # no seq/interval_s: not exporter-stamped
+        problems = validate_snapshot(snap)
+        assert any("seq" in p for p in problems)
+
+
+# ------------------------------------------------- arming + disabled cost
+
+
+class TestArming:
+    def test_live_knob_arms_and_parses(self):
+        cfg = config_from_env(
+            {"MPIT_OBS_LIVE": "1", "MPIT_OBS_LIVE_INTERVAL": "0.25"}
+        )
+        assert cfg is not None and cfg.live and cfg.live_interval == 0.25
+        # recognized knob set to off-values must not flip live on
+        cfg = config_from_env(
+            {"MPIT_OBS_DIR": "/tmp/x", "MPIT_OBS_LIVE": "0"}
+        )
+        assert cfg is not None and not cfg.live
+
+    def test_unrecognized_knob_must_not_arm(self):
+        # the chaos contract: a typo'd knob is a silent no instead of a
+        # silently-different run
+        assert config_from_env({"MPIT_OBS_LIVELY": "1"}) is None
+
+    def test_bad_interval_rejected(self):
+        with pytest.raises(ValueError):
+            ObsConfig(live=True, live_interval=0.0)
+
+    def test_null_registry_shared_and_returned_for_unarmed(self):
+        assert live_registry(object()) is NULL_REGISTRY
+        assert live_registry(Broker(1).transports()[0]) is NULL_REGISTRY
+
+    def test_disabled_publish_micro_benchmark(self):
+        # the NULL_SPAN contract applied to metrics: with live off, a
+        # publish site is a getattr + no-op call. Generous ceiling —
+        # catches an accidental de-optimization, not scheduler noise.
+        tp = Broker(1).transports()[0]
+        n = 50_000
+        t0 = time.perf_counter()
+        for i in range(n):
+            reg = live_registry(tp)
+            reg.inc(M_SAMPLES, i)
+            reg.set_gauge(M_ROUNDS, i)
+        per_op = (time.perf_counter() - t0) / (2 * n)
+        assert per_op < 25e-6, f"disabled publish costs {per_op*1e6:.1f}µs"
+
+
+# --------------------------------------------------------------- alerts
+
+
+def _stamped(reg, t, interval_s=0.1, seq=1):
+    snap = reg.snapshot()
+    snap["seq"] = seq
+    snap["interval_s"] = interval_s
+    snap["t"] = t
+    return snap
+
+
+class TestAlertEngine:
+    def test_dead_rank_dedup_and_rearm(self, tmp_path):
+        path = str(tmp_path / "alerts.jsonl")
+        engine = AlertEngine(path, AlertConfig())
+        fresh = _stamped(MetricsRegistry(0), t=100.0)
+        stale = _stamped(MetricsRegistry(1), t=95.0)
+        fired = engine.evaluate({0: fresh, 1: stale})
+        assert [(f["kind"], f["rank"]) for f in fired] == [("dead_rank", 1)]
+        assert fired[0]["detail"]["age_s"] > staleness_s(stale, engine.config)
+        # condition persists: suppressed
+        assert engine.evaluate({0: fresh, 1: stale}) == []
+        # rank recovers: re-armed, then fires again on the next death
+        assert engine.evaluate({0: fresh, 1: _stamped(
+            MetricsRegistry(1), t=100.0)}) == []
+        fired = engine.evaluate({0: fresh, 1: stale})
+        assert [(f["kind"], f["rank"]) for f in fired] == [("dead_rank", 1)]
+        # the file carries both firings; a NEW engine preloads them and
+        # stays quiet on the still-active condition (--once re-runs)
+        assert len(read_alerts(path)) == 2
+        assert AlertEngine(path).evaluate({0: fresh, 1: stale}) == []
+
+    def test_straggler_flags_farthest_from_median(self):
+        clk = _Clock()
+        regs = [MetricsRegistry(r, clock=clk) for r in range(3)]
+        for reg, compute in zip(regs, (9.0, 0.2, 8.8)):
+            reg.inc(M_COMPUTE_S, compute)
+        clk.t = 10.0
+        snaps = {r: _stamped(regs[r], t=100.0) for r in range(3)}
+        fired = AlertEngine(None).evaluate(snaps)
+        assert [(f["kind"], f["rank"]) for f in fired] == [("straggler", 1)]
+        assert fired[0]["detail"]["compute_fraction"] == pytest.approx(
+            0.02, abs=1e-3
+        )
+
+    def test_straggler_guards_uptime_and_floor(self):
+        # below min_uptime the window is noise; all-idle ranks (a warmup
+        # barrier) have spread 0-vs-0 and must not alert
+        clk = _Clock()
+        regs = [MetricsRegistry(r, clock=clk) for r in range(2)]
+        regs[0].inc(M_COMPUTE_S, 0.5)
+        clk.t = 0.5
+        snaps = {r: _stamped(regs[r], t=100.0) for r in range(2)}
+        assert AlertEngine(None).evaluate(snaps) == []
+
+    def test_slo_burn(self):
+        clk = _Clock()
+        reg = MetricsRegistry(0, role="serve", clock=clk)
+        reg.inc(M_REQ_FINISHED, 100)
+        reg.inc(M_SLO_MISSES, 20)
+        clk.t = 10.0
+        fired = AlertEngine(None).evaluate({0: _stamped(reg, t=100.0)})
+        assert [(f["kind"], f["rank"]) for f in fired] == [("slo_burn", 0)]
+        # miss fraction 0.2 against a 0.05 error budget: burn 4x
+        assert fired[0]["detail"]["burn"] == pytest.approx(4.0)
+
+    def test_slo_burn_needs_traffic(self):
+        clk = _Clock()
+        reg = MetricsRegistry(0, role="serve", clock=clk)
+        reg.inc(M_REQ_FINISHED, 2)  # 0.2 req/s < min_finished_rate
+        reg.inc(M_SLO_MISSES, 2)
+        clk.t = 10.0
+        assert AlertEngine(None).evaluate({0: _stamped(reg, t=100.0)}) == []
+
+
+class TestAlertsEndToEnd:
+    def test_dead_rank_within_one_staleness_window_of_chaos_kill(
+        self, tmp_path
+    ):
+        """A chaos ``kill_after`` silences rank 1's wire; its ping loop
+        times out waiting for the echo that will never come and dies the
+        way a real client does (final snapshot on teardown). The alert
+        must fire within one staleness window of that death."""
+        tps = Broker(2).transports()
+        killed = ChaosTransport(tps[1], ChaosConfig(kill_after={1: 3}))
+        live_dir = str(tmp_path / "live")
+        interval = 0.1
+        cfg = AlertConfig(min_staleness_s=0.5, staleness_factor=3.0)
+        regs = [MetricsRegistry(r) for r in range(2)]
+        exps = [
+            LiveExporter(regs[r], live_dir, interval_s=interval)
+            for r in range(2)
+        ]
+        stop = threading.Event()
+
+        def echo():  # rank 0: reply to every ping until told to stop
+            while not stop.is_set():
+                try:
+                    m = tps[0].recv(1, 3, timeout=0.05)
+                except RecvTimeout:
+                    continue
+                tps[0].send(1, 4, m.payload)
+
+        def pinger():  # rank 1: dies on the first unanswered ping
+            try:
+                for i in range(100):
+                    killed.send(0, 3, i)
+                    regs[1].inc(M_ROUNDS)
+                    tps[1].recv(0, 4, timeout=0.3)
+            except RecvTimeout:
+                pass
+            exps[1].close()  # the teardown final write a dying rank does
+
+        t_echo = threading.Thread(target=echo, daemon=True)
+        t_ping = threading.Thread(target=pinger, daemon=True)
+        t_echo.start()
+        t_ping.start()
+        t_ping.join(timeout=10)
+        assert not t_ping.is_alive(), "kill never silenced the pinger"
+        death_t = time.time()
+
+        window = staleness_s(
+            {"interval_s": interval}, cfg
+        )  # max(0.5, 3 x 0.1)
+        engine = AlertEngine(str(tmp_path / "alerts.jsonl"), cfg)
+        fired = []
+        try:
+            deadline = death_t + 4 * window
+            while not fired and time.time() < deadline:
+                fired = engine.evaluate(read_snapshots(live_dir))
+                time.sleep(0.05)
+        finally:
+            stop.set()
+            t_echo.join(timeout=5)
+            exps[0].close()
+        assert [(f["kind"], f["rank"]) for f in fired] == [("dead_rank", 1)]
+        # one staleness window plus scheduling slack, not multiples of it
+        assert time.time() - death_t < 2 * window, (
+            f"detection took {time.time() - death_t:.2f}s "
+            f"for a {window:.2f}s window"
+        )
+        assert regs[1].snapshot()["counters"][M_ROUNDS]["total"] >= 3
+        assert read_alerts(str(tmp_path / "alerts.jsonl")) == fired
+
+    def test_straggler_from_seeded_chaos_delay(self, tmp_path):
+        """Three ranks run the same compute; rank 1's sends go through a
+        seeded chaos delay. Its compute FRACTION collapses (wall time is
+        eaten by the wire) and the straggler alert names it — the signal
+        a group leader would use to route around a congested link."""
+        tps = Broker(3).transports()
+        slowed = ChaosTransport(
+            tps[1], ChaosConfig(seed=5, delay=1.0, delay_s=0.03)
+        )
+        sends = {0: tps[0], 1: slowed, 2: tps[2]}
+        live_dir = str(tmp_path / "live")
+        regs = [MetricsRegistry(r) for r in range(3)]
+        exps = [
+            LiveExporter(regs[r], live_dir, interval_s=0.1)
+            for r in range(3)
+        ]
+
+        def work(rank):
+            deadline = time.monotonic() + 0.8
+            i = 0
+            while time.monotonic() < deadline:
+                t0 = time.perf_counter()
+                time.sleep(0.004)  # the "compute" every rank shares
+                regs[rank].inc(M_COMPUTE_S, time.perf_counter() - t0)
+                t1 = time.perf_counter()
+                sends[rank].send((rank + 1) % 3, 3, i)
+                regs[rank].inc(M_EXCHANGE_S, time.perf_counter() - t1)
+                i += 1
+
+        threads = [
+            threading.Thread(target=work, args=(r,), daemon=True)
+            for r in range(3)
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=10)
+        for e in exps:
+            e.close()
+
+        snaps = read_snapshots(live_dir)
+        assert len(snaps) == 3
+        engine = AlertEngine(
+            None,
+            AlertConfig(min_uptime_s=0.3, min_staleness_s=5.0),
+        )
+        fired = engine.evaluate(snaps)
+        stragglers = [f for f in fired if f["kind"] == "straggler"]
+        assert [f["rank"] for f in stragglers] == [1], fired
+        fr = stragglers[0]["detail"]["fractions"]
+        assert fr["1"] < min(fr["0"], fr["2"]) / 2, fr
+
+
+# ------------------------------------------------------- golden fixture
+
+
+class TestGoldenSnapshot:
+    def test_checked_in_snapshot_validates_and_aggregates(self):
+        snaps = read_snapshots(FIXTURES)
+        assert list(snaps) == [0], "golden rank_0.json missing/invalid"
+        assert validate_snapshot(snaps[0]) == []
+        report = aggregate(snaps)
+        assert report["run"]["ranks"] == 1
+        assert report["run"]["throughput"] > 0
+        row = report["ranks"][0]
+        assert row["phases"]["compute"] > 0
+        # the lint.sh gate is this exact CLI invocation
+        assert obs_main(["live", FIXTURES, "--validate"]) == 0
+
+    def test_find_live_dir_prefers_live_subdir(self, tmp_path):
+        (tmp_path / "live").mkdir()
+        assert find_live_dir(str(tmp_path)) == str(tmp_path / "live")
+        assert find_live_dir(str(tmp_path / "live")) == str(
+            tmp_path / "live"
+        )
+
+
+# ------------------------------------------------- trainer integration
+
+
+def _live_trainer(tmp_path, obs="explicit", **kw):
+    import jax.numpy as jnp
+    import optax
+
+    from mpit_tpu.models import MLP
+    from mpit_tpu.parallel import AsyncPSTrainer
+
+    return AsyncPSTrainer(
+        MLP(compute_dtype=jnp.float32),
+        optax.sgd(0.05, momentum=0.9),
+        num_clients=2,
+        num_servers=1,
+        algo="easgd",
+        tau=4,
+        transport="inproc",
+        obs=(
+            ObsConfig(dir=str(tmp_path), live=True, live_interval=0.05)
+            if obs == "explicit"
+            else None
+        ),
+        max_exchange_failures=5,
+        fetch_timeout=1.0,
+        fetch_retries=3,
+        **kw,
+    )
+
+
+@pytest.fixture(scope="module")
+def mnist():
+    from mpit_tpu.data import load_mnist
+
+    return load_mnist(synthetic_train=2048, synthetic_test=512)
+
+
+class TestTrainerIntegration:
+    def test_live_run_snapshots_aggregate_and_cli(
+        self, tmp_path, mnist, capsys
+    ):
+        x_tr, y_tr, *_ = mnist
+        trainer = _live_trainer(tmp_path)
+        _, stats = trainer.train(x_tr, y_tr, steps=24, batch_size=32)
+        assert all(np.isfinite(l).all() for l in stats["losses"] if l)
+
+        live_dir = str(tmp_path / "live")
+        snaps = read_snapshots(live_dir)
+        assert sorted(snaps) == [0, 1, 2]
+        assert all(validate_snapshot(s) == [] for s in snaps.values())
+        report = aggregate(snaps)
+        assert report["run"]["ranks"] == 3
+        assert report["run"]["throughput"] > 0  # samples/s, clients only
+        for rank in (1, 2):
+            row = report["ranks"][rank]
+            assert row["samples"] > 0 and row["rounds"] > 0
+            assert row["phases"]["compute"] > 0
+        # the server rank publishes no compute counter -> no phase row
+        assert "phases" not in report["ranks"][0]
+
+        # the CLI over the same dir: machine-readable one-shot
+        assert obs_main(
+            ["live", str(tmp_path), "--once", "--json", "--no-alerts"]
+        ) == 0
+        out = json.loads(capsys.readouterr().out)
+        assert out["run"]["ranks"] == 3
+        assert out["alerts_fired"] == []
+
+    def test_env_knobs_arm_live(self, tmp_path, mnist, monkeypatch):
+        x_tr, y_tr, *_ = mnist
+        monkeypatch.setenv("MPIT_OBS_DIR", str(tmp_path))
+        monkeypatch.setenv("MPIT_OBS_LIVE", "1")
+        monkeypatch.setenv("MPIT_OBS_LIVE_INTERVAL", "0.05")
+        trainer = _live_trainer(tmp_path, obs=None)  # config from env
+        trainer.train(x_tr, y_tr, steps=8, batch_size=32)
+        assert sorted(read_snapshots(str(tmp_path / "live"))) == [0, 1, 2]
+
+    def test_live_off_writes_nothing(self, tmp_path, mnist):
+        x_tr, y_tr, *_ = mnist
+        trainer = _live_trainer(tmp_path, obs=None)
+        trainer.train(x_tr, y_tr, steps=8, batch_size=32)
+        assert not (tmp_path / "live").exists()
+
+
+@pytest.mark.slow
+def test_two_process_socket_live(tmp_path):
+    """The acceptance run: 3 launcher-spawned OS processes over TCP with
+    the live plane armed via env; the aggregator must see every rank."""
+    import subprocess
+    import sys
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env.pop("MPIT_RANK", None)
+    env.pop("MPIT_WORLD_SIZE", None)
+    env["MPIT_OBS_DIR"] = str(tmp_path)
+    env["MPIT_OBS_LIVE"] = "1"
+    env["MPIT_OBS_LIVE_INTERVAL"] = "0.25"
+    r = subprocess.run(
+        [sys.executable, "-m", "mpit_tpu.launch", "-n", "3",
+         os.path.join(repo, "examples", "ptest_proc.py"),
+         "--model", "mlp", "--steps", "8", "--train-size", "256",
+         "--algo", "ps-easgd"],
+        cwd=repo, env=env, capture_output=True, text=True, timeout=240,
+    )
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert "LIVE telemetry" in r.stderr
+    snaps = read_snapshots(str(tmp_path / "live"))
+    assert sorted(snaps) == [0, 1, 2]
+    assert all(validate_snapshot(s) == [] for s in snaps.values())
+    report = aggregate(snaps)
+    assert report["run"]["throughput"] > 0
+    # socket transports report real queue depth in the wire fragment
+    assert any(
+        row["queue_depth"] is not None
+        for row in report["ranks"].values()
+    )
+    assert obs_main(
+        ["live", str(tmp_path), "--once", "--json", "--no-alerts"]
+    ) == 0
