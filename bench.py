@@ -489,7 +489,13 @@ def bench_ps_literal(
     line now carries — here measured for real (``phase_source: "obs"``),
     compute spans proof-of-completion-closed by the training loop. The
     warmup run stays un-instrumented: journals append, so a warmed
-    journal would pollute the timed window."""
+    journal would pollute the timed window.
+
+    The same journals also yield the ``dynamics`` roll-up (staleness
+    p99, final elastic distance, update/param norm ratio) — update
+    QUALITY riding next to samples/s, so an async-speedup comparison
+    carries its own convergence-cost evidence (``scripts/bench_gate.py``
+    compares the fields across runs)."""
     import tempfile
 
     import optax
@@ -540,6 +546,13 @@ def bench_ps_literal(
         live_invalid = sum(
             1 for s in snaps.values() if validate_snapshot(s)
         )
+        # update-quality roll-up from the same journals (must run inside
+        # the with-block — the tempdir dies at dedent): staleness p99,
+        # final elastic distance, update/param norm ratio — the quality
+        # counterweight to samples/s for async-speedup comparisons
+        from mpit_tpu.obs.dynamics import aggregate_dynamics
+
+        dyn_run = aggregate_dynamics([obs_dir])["run"]
     run = report["run"]
     samples = steps * per_client * cfg.clients
     return {
@@ -570,6 +583,19 @@ def bench_ps_literal(
                 "invalid_snapshots": live_invalid,
             },
         } if live_rep is not None else {}),
+        **({
+            "dynamics": {
+                "staleness_p99": dyn_run["staleness_p99"],
+                "elastic_dist_final": (
+                    None if dyn_run["elastic_dist_final"] is None
+                    else round(dyn_run["elastic_dist_final"], 4)
+                ),
+                "norm_ratio": (
+                    None if dyn_run["norm_ratio"] is None
+                    else round(dyn_run["norm_ratio"], 5)
+                ),
+            },
+        } if dyn_run is not None else {}),
     }
 
 
@@ -1383,7 +1409,8 @@ def main():
             **{k: res[k] for k in ("chips", "algo", "model")},
             **{
                 k: res[k]
-                for k in ("mfu", "spread", "phases", "phase_source")
+                for k in ("mfu", "spread", "phases", "phase_source",
+                          "live", "dynamics")
                 if k in res
             },
             **({"platform_note": platform_note} if platform_note else {}),

@@ -116,12 +116,15 @@ def _main_conform(argv) -> int:
     parser = argparse.ArgumentParser(
         prog="python -m mpit_tpu.analysis conform",
         description="Replay obs journals against the extracted protocol "
-        "(TC201-TC203).",
+        "(TC201-TC204).",
     )
     parser.add_argument(
         "obs_dir",
-        help="directory with obs_rank*.jsonl journals (and, for "
-        "chaos runs, faults*.jsonl), or a single journal file",
+        nargs="+",
+        help="directories with obs_rank*.jsonl journals (and, for "
+        "chaos runs, faults*.jsonl), or single journal files; several "
+        "run dirs share one protocol extraction, each is audited "
+        "separately",
     )
     parser.add_argument(
         "--faults",
@@ -137,42 +140,54 @@ def _main_conform(argv) -> int:
         "--json", action="store_true", help="machine-readable output"
     )
     args = parser.parse_args(argv)
-    if not Path(args.obs_dir).exists():
-        print(f"error: no such path: {args.obs_dir}", file=sys.stderr)
-        return 2
+    for d in args.obs_dir:
+        if not Path(d).exists():
+            print(f"error: no such path: {d}", file=sys.stderr)
+            return 2
     if not Path(args.package).exists():
         print(f"error: no such path: {args.package}", file=sys.stderr)
         return 2
-    report = conformance.check_conformance(
-        args.obs_dir, _load_project(args.package), faults_path=args.faults
-    )
-    if not report.journals:
-        print(
-            f"error: no obs_rank*.jsonl journals under {args.obs_dir}",
-            file=sys.stderr,
+    project = _load_project(args.package)  # extracted once, audited per dir
+    docs = []
+    bad = False
+    for d in args.obs_dir:
+        report = conformance.check_conformance(
+            d, project, faults_path=args.faults
         )
-        return 2
+        if not report.journals:
+            print(
+                f"error: no obs_rank*.jsonl journals under {d}",
+                file=sys.stderr,
+            )
+            return 2
+        bad = bad or bool(report.violations)
+        if args.json:
+            docs.append({
+                "obs_dir": d,
+                "journals": [str(p) for p in report.journals],
+                "events": report.events,
+                "sends": report.sends,
+                "recvs": report.recvs,
+                "faults": report.faults,
+                "violations": [
+                    {"rule": v.rule, "detail": v.detail}
+                    for v in report.violations
+                ],
+            })
+        else:
+            for v in report.violations:
+                print(v)
+            where = f" [{d}]" if len(args.obs_dir) > 1 else ""
+            print(
+                f"{len(report.violations)} violation(s) in "
+                f"{len(report.journals)} journal(s): {report.sends} "
+                f"send(s), {report.recvs} recv(s), "
+                f"{report.faults} fault record(s)" + where
+            )
     if args.json:
-        print(json.dumps({
-            "journals": [str(p) for p in report.journals],
-            "events": report.events,
-            "sends": report.sends,
-            "recvs": report.recvs,
-            "faults": report.faults,
-            "violations": [
-                {"rule": v.rule, "detail": v.detail}
-                for v in report.violations
-            ],
-        }, indent=2))
-    else:
-        for v in report.violations:
-            print(v)
-        print(
-            f"{len(report.violations)} violation(s) in "
-            f"{len(report.journals)} journal(s): {report.sends} send(s), "
-            f"{report.recvs} recv(s), {report.faults} fault record(s)"
-        )
-    return 1 if report.violations else 0
+        # single-dir invocations keep the original flat document shape
+        print(json.dumps(docs[0] if len(docs) == 1 else docs, indent=2))
+    return 1 if bad else 0
 
 
 def main(argv=None) -> int:

@@ -32,7 +32,14 @@ Checked properties:
 - **TC203 role conformance** — each rank's sent-tag alphabet fits
   inside ONE extracted role (a rank sending both FETCH and PARAM is
   playing client and server at once, which the role model forbids), and
-  every tag on the wire belongs to the extracted protocol alphabet.
+  every tag on the wire belongs to the extracted protocol alphabet;
+- **TC204 version monotonicity** — per server rank, the center
+  ``version`` stamped into PARAM replies (journaled as
+  ``param_version`` records by the dynamics plane) never decreases in
+  journal order. Journals are per-rank monotone by construction, so a
+  decrease means the version counter itself regressed — the staleness
+  accounting built on it would be garbage. Vacuous for pre-dynamics
+  journals (no ``param_version`` records).
 
 Caveat: journals record what the *sampler* kept. Conformance needs the
 complete event stream, so runs checked here must use ``sample=1`` (the
@@ -59,7 +66,7 @@ _DUP_KINDS = {"duplicate"}
 
 @dataclasses.dataclass(frozen=True)
 class Violation:
-    rule: str  # TC201 | TC202 | TC203
+    rule: str  # TC201 | TC202 | TC203 | TC204
     detail: str
 
     def __str__(self) -> str:
@@ -86,7 +93,7 @@ def _load(obs_dir: str, faults_path: Optional[str]):
     for p in paths:
         records.extend(
             r for r in merge.read_journal(p) if r.get("ev") in
-            ("send", "isend", "recv")
+            ("send", "isend", "recv", "param_version")
         )
     faults = merge.read_fault_log(faults_path or obs_dir)
     return paths, records, faults
@@ -241,6 +248,28 @@ def _tc203_roles(records, roles) -> Iterable[Violation]:
             )
 
 
+def _tc204_version_monotonic(records) -> Iterable[Violation]:
+    # journal-file order IS per-rank real-time order (the journal lock
+    # stamps t monotonically), so a simple last-seen scan suffices
+    last: dict = {}
+    for r in records:
+        if r["ev"] != "param_version":
+            continue
+        v = r.get("version")
+        if not isinstance(v, int):
+            continue
+        rank = merge._rec_rank(r)
+        prev = last.get(rank)
+        if prev is not None and v < prev:
+            yield Violation(
+                "TC204",
+                f"server rank {rank} PARAM reply carries version {v} "
+                f"after already replying with {prev} — the center "
+                "version counter went backwards",
+            )
+        last[rank] = max(v, prev) if prev is not None else v
+
+
 def check_conformance(
     obs_dir: str,
     project,
@@ -255,6 +284,7 @@ def check_conformance(
     violations = list(_tc201_causality(records))
     violations.extend(_tc202_conservation(records, faults, sem))
     violations.extend(_tc203_roles(records, roles))
+    violations.extend(_tc204_version_monotonic(records))
     return ConformanceReport(
         journals=paths,
         events=len(records),

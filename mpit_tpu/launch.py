@@ -109,6 +109,16 @@ def main(argv=None) -> int:
             f"{os.environ['MPIT_OBS_DIR']}`",
             file=sys.stderr,
         )
+    # and hung-job forensics: each rank will dump all-thread stacks on a
+    # timer (stacks_rank<r>.txt next to the journal, stderr without a dir)
+    if os.environ.get("MPIT_OBS_FAULTHANDLER", "0") not in ("", "0"):
+        print(
+            "[launch] FAULTHANDLER armed in all ranks: periodic "
+            "all-thread stack dumps every "
+            f"{os.environ['MPIT_OBS_FAULTHANDLER']}"
+            " (1 = 300 s default interval)",
+            file=sys.stderr,
+        )
 
     # one extra port for the jax.distributed coordinator (rank 0 binds it)
     reserving, ports = _reserve_ports(ns.n + (1 if ns.jax_distributed else 0))

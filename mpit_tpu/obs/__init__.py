@@ -15,6 +15,12 @@ in-run snapshots: a per-rank :class:`~mpit_tpu.obs.live.MetricsRegistry`
 exported atomically to ``<dir>/live/rank_<r>.json``, aggregated by
 ``python -m mpit_tpu.obs live <dir>`` into a dashboard with online
 health alerts (:mod:`mpit_tpu.obs.alerts`).
+
+The dynamics plane (:mod:`mpit_tpu.obs.dynamics`) reduces the same
+journals to update-quality evidence — per-source push staleness,
+per-client elastic-distance trajectories with a divergence verdict,
+update/param norm ratios — via ``python -m mpit_tpu.obs dynamics
+<dir> [--gate dynamics.json]``.
 """
 
 from mpit_tpu.obs.alerts import (  # noqa: F401
@@ -29,9 +35,17 @@ from mpit_tpu.obs.core import (  # noqa: F401
     ObsConfig,
     SpanContext,
     Tracer,
+    arm_faulthandler,
     config_from_env,
+    disarm_faulthandler,
     span,
     write_fault_log,
+)
+from mpit_tpu.obs.dynamics import (  # noqa: F401
+    aggregate_dynamics,
+    check_dynamics_gate,
+    diverging,
+    load_gate,
 )
 from mpit_tpu.obs.live import (  # noqa: F401
     LiveExporter,

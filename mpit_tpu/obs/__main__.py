@@ -5,6 +5,7 @@
     python -m mpit_tpu.obs summary --diff RUN_A RUN_B
     python -m mpit_tpu.obs roofline RUN_DIR [--json]
     python -m mpit_tpu.obs slo RUN_DIR [--gate slo.json] [--json]
+    python -m mpit_tpu.obs dynamics RUN_DIR [--gate dynamics.json] [--json]
     python -m mpit_tpu.obs live RUN_DIR [--once] [--json] [--validate]
 
 ``RUN_DIR`` is the ``MPIT_OBS_DIR`` of the run (or explicit journal
@@ -21,6 +22,12 @@ client is flagged as straggler). ``slo`` reduces the serving lifecycle
 events (``models/serving.py`` under the loadgen harness — see
 docs/SERVING.md) to TTFT/TPOT/e2e percentiles, goodput, queue depth and
 occupancy; ``--gate slo.json`` checks them against ceilings/floors.
+``dynamics`` reduces the training-dynamics records
+(docs/OBSERVABILITY.md "dynamics") to per-client staleness percentiles,
+elastic-distance trajectories with a monotone-growth divergence
+verdict, and update/param norm ratios; ``--gate dynamics.json`` checks
+the run roll-up (``staleness_p99_max``, ``elastic_dist_final_max``,
+``norm_ratio_max``, ``allow_diverging``).
 ``live`` reads the in-run snapshots a ``MPIT_OBS_LIVE=1`` run exports
 (``live/rank_<r>.json``), renders a refreshing cross-rank dashboard
 (``--once --json`` for scripting), and runs the online alert engine
@@ -99,6 +106,58 @@ def _fmt_ms(v) -> str:
     return "-" if v is None else f"{v:.1f}"
 
 
+def _fmt(v) -> str:
+    if v is None:
+        return "-"
+    if isinstance(v, float):
+        return f"{v:.4g}"
+    return str(v)
+
+
+def _print_dynamics(report: dict) -> None:
+    run = report["run"]
+    verdict = "DIVERGING" if run["diverging"] else "stable"
+    print(
+        f"dynamics: {run['clients']} client(s) / {run['servers']} "
+        f"server(s) — staleness p99 {_fmt(run['staleness_p99'])}, "
+        f"elastic final {_fmt(run['elastic_dist_final'])}, "
+        f"norm ratio {_fmt(run['norm_ratio'])} — {verdict}"
+    )
+    if report["staleness"]:
+        print(f"{'src':>4} {'pushes':>7} {'p50':>5} {'p99':>5} "
+              f"{'max':>5} {'mean':>7}")
+        for src, row in report["staleness"].items():
+            print(
+                f"{src:>4} {row['pushes']:>7} {_fmt(row['p50']):>5} "
+                f"{_fmt(row['p99']):>5} {_fmt(row['max']):>5} "
+                f"{row['mean']:>7.2f}"
+            )
+    if report["clients"]:
+        print(f"{'rank':>4} {'algo':>8} {'rounds':>7} {'elastic':>9} "
+              f"{'(first->final)':>16} {'push':>9} {'ratio':>7}  verdict")
+        for rank, row in report["clients"].items():
+            el = row.get("elastic")
+            span = (
+                f"{_fmt(el['first'])}->{_fmt(el['final'])}"
+                if el is not None else "-"
+            )
+            print(
+                f"{rank:>4} {str(row.get('algo')):>8} "
+                f"{row['rounds']:>7} "
+                f"{_fmt(el['final'] if el else None):>9} {span:>16} "
+                f"{_fmt(row.get('push_norm')):>9} "
+                f"{_fmt(row.get('norm_ratio')):>7}  "
+                + ("DIVERGING" if row.get("diverging") else "stable")
+            )
+    for rank, row in report["servers"].items():
+        mono = "monotonic" if row["monotonic"] else "NON-MONOTONIC"
+        print(
+            f" server rank {rank}: {row['param_replies']} PARAM "
+            f"replies, version {row['first_version']} -> "
+            f"{row['final_version']} ({mono})"
+        )
+
+
 def _print_live(report: dict, live_dir: str, fired: list) -> None:
     run = report["run"]
     print(
@@ -145,6 +204,18 @@ def _print_live(report: dict, live_dir: str, fired: list) -> None:
                 f"ttft p50 {_fmt_ms(srow.get('ttft_p50_ms'))}ms "
                 f"p99 {_fmt_ms(srow.get('ttft_p99_ms'))}ms"
             )
+        stal = row.get("staleness")
+        dyn = row.get("dynamics")
+        if stal is not None or dyn is not None:
+            parts = []
+            if stal is not None:
+                parts.append("staleness p50/p99 "
+                             f"{_fmt(stal['p50'])}/{_fmt(stal['p99'])}")
+            if dyn is not None:
+                parts.append(f"elastic {_fmt(dyn['elastic_dist'])}")
+                parts.append(f"push {_fmt(dyn['push_norm'])}")
+                parts.append(f"ratio {_fmt(dyn['norm_ratio'])}")
+            print("     dynamics: " + "  ".join(parts))
     for rec in fired:
         print(
             f"ALERT {rec['kind']} rank {rec['rank']}: "
@@ -289,6 +360,20 @@ def main(argv=None) -> int:
                     help="e2e SLO applied to requests submitted without "
                          "one (default: such requests meet vacuously)")
 
+    dp = sub.add_parser(
+        "dynamics",
+        help="training-dynamics report: staleness, elastic distance, "
+             "update/param norm ratios, divergence verdict",
+    )
+    dp.add_argument("paths", nargs="+",
+                    help="run dir (MPIT_OBS_DIR) or journal files")
+    dp.add_argument("--gate", default=None,
+                    help="JSON gate file (keys: staleness_p99_max, "
+                         "elastic_dist_final_max, norm_ratio_max, "
+                         "allow_diverging); violations exit 1")
+    dp.add_argument("--json", action="store_true",
+                    help="emit the report (plus any violations) as JSON")
+
     vp = sub.add_parser(
         "live",
         help="live dashboard + alerts over live/rank_*.json snapshots",
@@ -378,6 +463,36 @@ def main(argv=None) -> int:
             print(format_report(report))
             for v in violations:
                 print(f"SLO VIOLATION: {v}")
+        if violations:
+            return 1
+        return 0
+
+    if ns.cmd == "dynamics":
+        from mpit_tpu.obs.dynamics import (
+            aggregate_dynamics, check_dynamics_gate, load_gate,
+        )
+
+        report = aggregate_dynamics(journals)
+        if report["run"] is None:
+            print("journals carry no training-dynamics records "
+                  "(train with obs armed — docs/OBSERVABILITY.md "
+                  "\"dynamics\")", file=sys.stderr)
+            return 2
+        violations = []
+        if ns.gate is not None:
+            try:
+                gate = load_gate(ns.gate)
+            except (OSError, ValueError) as e:
+                print(f"bad gate file {ns.gate}: {e}", file=sys.stderr)
+                return 2
+            violations = check_dynamics_gate(report, gate)
+        if ns.json:
+            json.dump({**report, "violations": violations}, sys.stdout)
+            print()
+        else:
+            _print_dynamics(report)
+            for v in violations:
+                print(f"DYNAMICS VIOLATION: {v}")
         if violations:
             return 1
         return 0

@@ -21,6 +21,20 @@ three conditions and appends structured records to ``alerts.jsonl``:
   by the error budget ``(1 - slo_target)``, exceeds ``burn_threshold``.
   Burn 1.0 means the budget is being consumed exactly as fast as it
   accrues; >1 means the run will blow its SLO if the window persists.
+- **staleness_runaway** — a server rank's rolling push-staleness p99
+  (the ``train.staleness`` histogram, docs/OBSERVABILITY.md "dynamics")
+  jumps past ``staleness_runaway_factor`` x its OWN baseline (the
+  median of its prior observations, floored at ``staleness_floor``
+  units). Relative-to-self, so a topology whose steady state is 3
+  updates of staleness doesn't false-positive where one whose steady
+  state is 0.2 would.
+- **divergence** — a client rank's elastic distance ‖x_local − x̃‖
+  gauge grows strictly monotonically across ``divergence_windows``
+  consecutive exports AND by more than ``divergence_factor`` overall —
+  the EASGD exploration term failing to pull workers back to the
+  center (unstable alpha/lr), caught while the run still has something
+  to save. Histories advance only when a rank's snapshot ``seq``
+  advances, so re-reading an unchanged snapshot set is idempotent.
 
 Alerts deduplicate per ``(kind, rank)`` while the condition holds and
 re-arm on recovery; existing ``alerts.jsonl`` content seeds the active
@@ -37,10 +51,17 @@ import statistics
 from typing import Mapping, Optional
 
 from mpit_tpu.obs.live import (
+    M_ELASTIC_DIST,
     M_REQ_FINISHED,
     M_SLO_MISSES,
+    M_STALENESS,
     compute_fraction,
+    percentile_ms,
 )
+
+# per-rank dynamics histories are capped — the engine may outlive a long
+# run and the conditions only ever look at a recent suffix
+_HISTORY_CAP = 64
 
 
 @dataclasses.dataclass(frozen=True)
@@ -59,12 +80,28 @@ class AlertConfig:
     burn_threshold: float = 1.0
     slo_target: float = 0.95
     min_finished_rate: float = 0.5
+    # training-dynamics rules (docs/OBSERVABILITY.md "dynamics")
+    divergence_windows: int = 4
+    divergence_factor: float = 2.0
+    staleness_runaway_factor: float = 3.0
+    staleness_floor: float = 1.0
+    staleness_baseline_len: int = 3
 
     def __post_init__(self):
         if self.staleness_factor <= 0:
             raise ValueError("staleness_factor must be > 0")
         if not 0 < self.slo_target < 1:
             raise ValueError("slo_target must be in (0, 1)")
+        if self.divergence_windows < 2:
+            raise ValueError("divergence_windows must be >= 2")
+        if self.divergence_factor <= 1.0:
+            raise ValueError("divergence_factor must be > 1")
+        if self.staleness_runaway_factor <= 1.0:
+            raise ValueError("staleness_runaway_factor must be > 1")
+        if self.staleness_floor <= 0:
+            raise ValueError("staleness_floor must be > 0")
+        if self.staleness_baseline_len < 1:
+            raise ValueError("staleness_baseline_len must be >= 1")
 
 
 def staleness_s(snap: dict, config: AlertConfig) -> float:
@@ -84,6 +121,12 @@ class AlertEngine:
         self.path = path
         self.config = config
         self._active: set = set()  # (kind, rank) currently firing
+        # dynamics histories: rank -> [(seq, value), ...] capped at
+        # _HISTORY_CAP; advanced once per NEW snapshot seq (see
+        # _observe_dynamics) — the memory behind staleness_runaway and
+        # divergence
+        self._elastic_hist: dict = {}
+        self._staleness_hist: dict = {}
         if path is not None and os.path.exists(path):
             for rec in _read_jsonl(path):
                 if rec.get("ev") == "alert":
@@ -155,6 +198,72 @@ class AlertEngine:
                 ))
         return out
 
+    def _observe_dynamics(self, snapshots: Mapping[int, dict]) -> None:
+        """Advance the per-rank dynamics histories — one observation per
+        new snapshot ``seq``, so evaluating an unchanged snapshot set
+        twice (``--once`` re-runs, slow pollers) never manufactures a
+        trend that isn't there."""
+        for rank, snap in snapshots.items():
+            seq = snap.get("seq")
+            elastic = snap.get("gauges", {}).get(M_ELASTIC_DIST)
+            if elastic is not None:
+                hist = self._elastic_hist.setdefault(rank, [])
+                if not hist or hist[-1][0] != seq:
+                    hist.append((seq, float(elastic)))
+                    del hist[:-_HISTORY_CAP]
+            h = snap.get("hists", {}).get(M_STALENESS)
+            if h is not None:
+                buckets = h.get("rolling") or h.get("buckets") or {}
+                p99 = percentile_ms(buckets, 0.99)
+                if p99 is not None:
+                    hist = self._staleness_hist.setdefault(rank, [])
+                    if not hist or hist[-1][0] != seq:
+                        # /1e3 undoes percentile_ms's ms scaling — the
+                        # staleness hist is in units, not time
+                        hist.append((seq, p99 / 1e3))
+                        del hist[:-_HISTORY_CAP]
+
+    def _divergences(self) -> list:
+        cfg = self.config
+        out = []
+        for rank, hist in sorted(self._elastic_hist.items()):
+            vals = [v for _, v in hist][-cfg.divergence_windows:]
+            if len(vals) < cfg.divergence_windows or vals[0] <= 0:
+                continue
+            if all(b > a for a, b in zip(vals, vals[1:])) and (
+                vals[-1] / vals[0] > cfg.divergence_factor
+            ):
+                out.append((
+                    "divergence", rank,
+                    {
+                        "elastic_dist": round(vals[-1], 6),
+                        "growth": round(vals[-1] / vals[0], 3),
+                        "windows": cfg.divergence_windows,
+                        "trajectory": [round(v, 6) for v in vals],
+                    },
+                ))
+        return out
+
+    def _staleness_runaways(self) -> list:
+        cfg = self.config
+        out = []
+        for rank, hist in sorted(self._staleness_hist.items()):
+            vals = [v for _, v in hist]
+            if len(vals) < cfg.staleness_baseline_len + 1:
+                continue
+            newest = vals[-1]
+            baseline = max(statistics.median(vals[:-1]), cfg.staleness_floor)
+            if newest > cfg.staleness_runaway_factor * baseline:
+                out.append((
+                    "staleness_runaway", rank,
+                    {
+                        "staleness_p99": round(newest, 3),
+                        "baseline": round(baseline, 3),
+                        "factor": round(newest / baseline, 3),
+                    },
+                ))
+        return out
+
     # -- driver -----------------------------------------------------------
 
     def evaluate(
@@ -170,10 +279,13 @@ class AlertEngine:
             return []
         if now is None:
             now = max(s["t"] for s in snapshots.values())
+        self._observe_dynamics(snapshots)
         found = (
             self._dead_ranks(snapshots, now)
             + self._stragglers(snapshots)
             + self._slo_burns(snapshots)
+            + self._staleness_runaways()
+            + self._divergences()
         )
         condition_keys = {(kind, rank) for kind, rank, _ in found}
         fired = []

@@ -46,6 +46,13 @@ obs must never arm implicitly — only recognized ``MPIT_OBS_*`` knobs count.
                              (:mod:`mpit_tpu.obs.live`; default 0)
   MPIT_OBS_LIVE_INTERVAL
                         sec  live snapshot export interval (default 1.0)
+  MPIT_OBS_FAULTHANDLER 0|1|sec  hang forensics: arm
+                             ``faulthandler.dump_traceback_later`` so a
+                             wedged rank leaves an all-threads stack
+                             dump in ``<dir>/stacks_rank<r>.txt`` (or
+                             stderr with no dir) every interval instead
+                             of nothing ("1" = 300 s default interval,
+                             a number = that interval in seconds)
 """
 
 from __future__ import annotations
@@ -191,7 +198,12 @@ class ObsConfig:
     ``live=True`` arms the live telemetry plane — a per-rank
     :class:`mpit_tpu.obs.live.MetricsRegistry` plus a background
     exporter snapshotting ``<dir>/live/rank_<r>.json`` every
-    ``live_interval`` seconds (registry only when ``dir`` is None)."""
+    ``live_interval`` seconds (registry only when ``dir`` is None);
+    ``faulthandler`` > 0 arms hang forensics — a repeating
+    :func:`faulthandler.dump_traceback_later` timer at that interval in
+    seconds, dumping all threads' stacks to ``<dir>/stacks_<label>.txt``
+    (stderr when ``dir`` is None) so a wedged rank leaves evidence next
+    to its journal instead of nothing (0.0 = off)."""
 
     dir: Optional[str] = None
     trace: bool = True
@@ -200,6 +212,7 @@ class ObsConfig:
     max_records: Optional[int] = None
     live: bool = False
     live_interval: float = 1.0
+    faulthandler: float = 0.0
 
     def __post_init__(self):
         if self.sample < 1:
@@ -208,15 +221,31 @@ class ObsConfig:
             raise ValueError("max_records must be >= 1")
         if self.live_interval <= 0:
             raise ValueError("live_interval must be > 0")
+        if self.faulthandler < 0:
+            raise ValueError("faulthandler must be >= 0 (0 = off)")
 
 
 _ENV_KNOBS = frozenset(
     "MPIT_OBS_" + k
     for k in (
         "DIR", "TRACE", "TELEMETRY", "SAMPLE", "MAX_RECORDS",
-        "LIVE", "LIVE_INTERVAL",
+        "LIVE", "LIVE_INTERVAL", "FAULTHANDLER",
     )
 )
+
+# MPIT_OBS_FAULTHANDLER=1 means "on, default cadence": dump every 5
+# minutes — long enough that a healthy run never dumps (exchanges are
+# sub-second), short enough that a wedged rank leaves evidence before
+# anyone reaches for kill -9
+_FAULTHANDLER_DEFAULT_S = 300.0
+
+
+def _parse_faulthandler(raw: Optional[str]) -> float:
+    if raw is None or raw in ("", "0", "false", "no"):
+        return 0.0
+    if raw in ("1", "true", "yes"):
+        return _FAULTHANDLER_DEFAULT_S
+    return float(raw)
 
 
 def config_from_env(
@@ -235,7 +264,70 @@ def config_from_env(
         max_records=int(max_records) if max_records else None,
         live=env.get("MPIT_OBS_LIVE", "0") not in ("", "0"),
         live_interval=float(env.get("MPIT_OBS_LIVE_INTERVAL", 1.0)),
+        faulthandler=_parse_faulthandler(env.get("MPIT_OBS_FAULTHANDLER")),
     )
+
+
+# -- hang forensics ---------------------------------------------------------
+# One arm per process: faulthandler.dump_traceback_later is process-global
+# (a repeating timer over ALL threads), so the thread-mode trainer arms it
+# once for the world and process mode arms it per rank. The dump file
+# stays open for the process lifetime — faulthandler holds the fd.
+
+_FAULTHANDLER_LOCK = threading.Lock()
+_FAULTHANDLER_FILE = None
+
+
+def arm_faulthandler(config: Optional["ObsConfig"], label: str) -> Optional[str]:
+    """Arm the repeating all-threads stack dump when
+    ``config.faulthandler`` > 0 — the MPIT_OBS_FAULTHANDLER knob's
+    engine. Returns the dump path (``<dir>/stacks_<label>.txt``; None
+    with the dump going to stderr, or when not armed). Idempotent per
+    process: a second arm re-schedules the timer but keeps the first
+    file. Never raises — forensics must not kill the run it exists to
+    explain."""
+    global _FAULTHANDLER_FILE
+    if config is None or config.faulthandler <= 0:
+        return None
+    import faulthandler
+    import sys
+
+    # path work happens OUTSIDE the lock — only the file-slot check and
+    # the (non-blocking) timer rearm sit in the critical section
+    path = None
+    if config.dir is not None:
+        try:
+            os.makedirs(config.dir, exist_ok=True)
+        except OSError:
+            return None
+        path = os.path.join(config.dir, f"stacks_{label}.txt")
+    with _FAULTHANDLER_LOCK:
+        try:
+            if path is not None:
+                if _FAULTHANDLER_FILE is None:
+                    _FAULTHANDLER_FILE = open(path, "w")
+                else:
+                    path = _FAULTHANDLER_FILE.name
+            out = (
+                _FAULTHANDLER_FILE if _FAULTHANDLER_FILE is not None
+                else sys.stderr
+            )
+            faulthandler.dump_traceback_later(
+                config.faulthandler, repeat=True, file=out
+            )
+        except (OSError, ValueError):
+            return None
+        return path
+
+
+def disarm_faulthandler() -> None:
+    """Cancel the pending dump timer (clean teardown: a finished run
+    must not dump stacks from whatever outlives it). The dump file
+    stays open — faulthandler may still hold it on some paths, and one
+    fd per process is the documented cost."""
+    import faulthandler
+
+    faulthandler.cancel_dump_traceback_later()
 
 
 class _NullSpan:

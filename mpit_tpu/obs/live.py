@@ -70,6 +70,18 @@ M_SKIPPED_ROUNDS = "train.skipped_rounds"
 M_EXCHANGE_FAILURES = "train.exchange_failures"
 M_STALE_PARAMS = "train.stale_params_dropped"
 
+# training-dynamics plane (docs/OBSERVABILITY.md "dynamics"):
+# M_STALENESS is a histogram published by the SERVER per applied
+# versioned push — one staleness unit recorded as one "second", so the
+# unit-agnostic geometric buckets apply and percentile_ms/1000 recovers
+# staleness units within one ~10% bucket step. The rest are per-round
+# client gauges from parallel/ps_roles._record_dynamics.
+M_STALENESS = "train.staleness"
+M_ELASTIC_DIST = "train.elastic_dist"
+M_PUSH_NORM = "train.push_norm"
+M_PARAM_NORM = "train.param_norm"
+M_NORM_RATIO = "train.norm_ratio"
+
 # serving plane (published by models/serving.py lifecycle events)
 M_REQ_SUBMITTED = "serve.submitted"
 M_REQ_FINISHED = "serve.finished"
@@ -563,6 +575,27 @@ def aggregate(snapshots: Mapping[int, dict]) -> dict:
                 "p50": percentile_ms(buckets, 0.50),
                 "p90": percentile_ms(buckets, 0.90),
                 "p99": percentile_ms(buckets, 0.99),
+            }
+        # training-dynamics rows (docs/OBSERVABILITY.md "dynamics"):
+        # server ranks publish the staleness hist (units, not time —
+        # hence /1e3 undoing percentile_ms's ms scaling), client ranks
+        # the per-round quality gauges
+        stal = snap.get("hists", {}).get(M_STALENESS)
+        if stal is not None:
+            buckets = stal["rolling"] or stal["buckets"]
+            p50 = percentile_ms(buckets, 0.50)
+            p99 = percentile_ms(buckets, 0.99)
+            row["staleness"] = {
+                "p50": None if p50 is None else round(p50 / 1e3, 3),
+                "p99": None if p99 is None else round(p99 / 1e3, 3),
+            }
+        elastic = _gauge(snap, M_ELASTIC_DIST)
+        if elastic is not None:
+            row["dynamics"] = {
+                "elastic_dist": elastic,
+                "push_norm": _gauge(snap, M_PUSH_NORM),
+                "param_norm": _gauge(snap, M_PARAM_NORM),
+                "norm_ratio": _gauge(snap, M_NORM_RATIO),
             }
         if snap.get("role") == "serve":
             finished = _counter(snap, M_REQ_FINISHED)

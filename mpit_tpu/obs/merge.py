@@ -29,7 +29,12 @@ Rendering:
   ``segment`` work slices (events carry end time + ``dur``, like recv)
   and ``serve_fault`` instants, ``tid 2`` holds one async span per
   request (``ph: "b"/"n"/"e"``, id = rid) from enqueue through admit /
-  first token to finish or cancel — queueing time visible per request.
+  first token to finish or cancel — queueing time visible per request;
+- training-dynamics records (docs/OBSERVABILITY.md "dynamics") become
+  Perfetto counter tracks (``ph: "C"``): an ``elastic_dist`` lane per
+  client rank and one ``staleness src <r>`` lane per pushing client on
+  each server rank — update quality rendered on the same timeline as
+  the wire traffic that caused it.
 
 This module reads only files — it must import neither jax nor the
 transport stack, so the CLI stays fast and safe to run anywhere.
@@ -271,6 +276,27 @@ def merge_to_chrome_trace(
                         for k in ("boundary", "delay")
                         if k in rec
                     },
+                })
+            elif ev == "dynamics":
+                # training-dynamics counter track (per client rank):
+                # Perfetto renders ph "C" as a value-over-time lane, so
+                # the elastic distance ‖x_local − x̃‖ trajectory sits
+                # directly under the rank's wire/span slices
+                events.append({
+                    "ph": "C", "name": "elastic_dist", "cat": "dynamics",
+                    "pid": rank, "tid": 0, "ts": us(t),
+                    "args": {"value": rec.get("elastic", 0.0)},
+                })
+            elif ev == "push_stale":
+                # per-source staleness counter track on the server rank:
+                # one lane per pushing client, so a delayed client's
+                # elevated staleness is visually attributable
+                events.append({
+                    "ph": "C",
+                    "name": f"staleness src {rec.get('src')}",
+                    "cat": "dynamics", "pid": rank, "tid": 0,
+                    "ts": us(t),
+                    "args": {"value": rec.get("staleness", 0)},
                 })
 
     if faults_path is not None:

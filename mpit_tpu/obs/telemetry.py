@@ -45,6 +45,7 @@ from mpit_tpu.obs.core import (
     SpanContext,
     Tracer,
     _new_id,
+    arm_faulthandler,
     config_from_env,
 )
 from mpit_tpu.transport.base import RecvTimeout, Transport
@@ -464,5 +465,10 @@ def maybe_wrap(
 def wrap_from_env(transport: Transport) -> Transport:
     """Process-mode hook (examples/ptest_proc.py): wrap iff ``MPIT_OBS_*``
     is armed in the environment — one line in a launch script instruments
-    a whole run without code changes anywhere else."""
-    return maybe_wrap(transport, config_from_env())
+    a whole run without code changes anywhere else. MPIT_OBS_FAULTHANDLER
+    additionally arms periodic all-thread stack dumps for this process
+    (``stacks_rank<r>.txt`` next to the journal) — hung-job forensics."""
+    config = config_from_env()
+    if config is not None:
+        arm_faulthandler(config, f"rank{transport.rank}")
+    return maybe_wrap(transport, config)

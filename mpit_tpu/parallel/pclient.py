@@ -15,9 +15,13 @@ Fault tolerance (docs/ROBUSTNESS.md; the reference would simply hang):
   stale reply belonging to a timed-out earlier attempt (or a
   chaos-duplicated one) is discarded instead of being mis-assembled into
   the wrong chunk slot.
-- pushes carry an ``(epoch, seq, chunk)`` envelope; the server's dedup
-  window applies each (epoch, seq) exactly once, so send retries after a
-  connection reset (and duplicated frames) can never double-apply.
+- pushes carry an ``(epoch, seq, basis_version, chunk)`` envelope; the
+  server's dedup window applies each (epoch, seq) exactly once, so send
+  retries after a connection reset (and duplicated frames) can never
+  double-apply. ``basis_version`` echoes the center version stamped
+  into the last PARAM reply this client accepted from that server
+  (``server_version``), which lets the server journal per-push
+  staleness — the training-dynamics plane of docs/OBSERVABILITY.md.
 - transient send failures (``ConnectionError``/``OSError``) are retried
   with the same backoff schedule before surfacing to the caller.
 - a PARAM reply mangled on the wire (chaos ``corrupt``/``truncate``) is
@@ -102,6 +106,10 @@ class PClient:
         self._attempt_ids = itertools.count(1)
         self._push_seq = itertools.count(1)
         self.push_sent: dict[int, int] = {r: 0 for r in self.server_ranks}
+        # center version last seen per server (stamped into attempt-id'd
+        # PARAM replies) — echoed as the fetch basis in push envelopes
+        # so the server can attribute per-push staleness
+        self.server_version: dict[int, int] = {}
         self.stale_params_dropped = 0
         self.corrupt_params_dropped = 0
         self._hb_stop = threading.Event()
@@ -209,8 +217,10 @@ class PClient:
                     last_exc = e
                     break
                 payload = msg.payload
-                if isinstance(payload, tuple) and len(payload) == 2:
-                    got_id, chunk = payload
+                if isinstance(payload, tuple) and len(payload) == 3:
+                    # versioned reply (attempt_id, version, chunk) — the
+                    # only shape today's server emits for id'd fetches
+                    got_id, version, chunk = payload
                     if got_id != attempt_id:
                         self.stale_params_dropped += 1
                         continue  # a timed-out attempt's late reply
@@ -218,6 +228,24 @@ class PClient:
                     if arr is None:
                         # mangled on the wire: keep waiting; the timeout
                         # re-fetches (the server won't resend on its own)
+                        self.corrupt_params_dropped += 1
+                        continue
+                    if isinstance(version, int):
+                        # basis for this client's next push envelopes; a
+                        # chaos-mangled non-int version just leaves the
+                        # previous basis in place (staleness degrades to
+                        # an overestimate, never a crash)
+                        self.server_version[rank] = version
+                    return arr
+                if isinstance(payload, tuple) and len(payload) == 2:
+                    # pre-version (attempt_id, chunk) reply — kept for
+                    # hand-rolled protocol tests and mixed-version runs
+                    got_id, chunk = payload
+                    if got_id != attempt_id:
+                        self.stale_params_dropped += 1
+                        continue
+                    arr = self._chunk_ok(chunk, expected)
+                    if arr is None:
                         self.corrupt_params_dropped += 1
                         continue
                     return arr
@@ -292,10 +320,17 @@ class PClient:
             )
         # one seq per logical push: every server's chunk shares it, and a
         # send retry re-offers the same (epoch, seq) — the server window
-        # turns at-least-once delivery into exactly-once application
+        # turns at-least-once delivery into exactly-once application.
+        # Each chunk carries that server's last-fetched center version
+        # as its staleness basis (0 = never fetched a versioned reply).
         seq = next(self._push_seq)
         for rank, (start, end) in zip(self.server_ranks, self.bounds):
             self._send_with_retry(
-                rank, tag, (self._epoch, seq, flat[start:end])
+                rank, tag,
+                (
+                    self._epoch, seq,
+                    self.server_version.get(rank, 0),
+                    flat[start:end],
+                ),
             )
             self.push_sent[rank] += 1

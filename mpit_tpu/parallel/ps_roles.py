@@ -26,10 +26,14 @@ import optax
 from mpit_tpu.obs.core import span as obs_span
 from mpit_tpu.obs.live import (
     M_COMPUTE_S,
+    M_ELASTIC_DIST,
     M_EXCHANGE_FAILURES,
     M_EXCHANGE_LAT,
     M_EXCHANGE_S,
+    M_NORM_RATIO,
+    M_PARAM_NORM,
     M_PUSHES,
+    M_PUSH_NORM,
     M_ROUNDS,
     M_SAMPLES,
     M_SKIPPED_ROUNDS,
@@ -67,6 +71,64 @@ def make_local_step(
         return optax.apply_updates(params, updates), opt_state, loss
 
     return jax.jit(local_step)
+
+
+def _record_dynamics(
+    transport,
+    reg,
+    round_no: int,
+    algo: str,
+    flat: np.ndarray,
+    center: np.ndarray,
+    prev_center: Optional[np.ndarray],
+    push_vec: Optional[np.ndarray] = None,
+    alpha: Optional[float] = None,
+) -> None:
+    """Per-exchange training-dynamics record (docs/OBSERVABILITY.md
+    "dynamics"): elastic distance ‖x_local − x̃‖ — THE quantity the EASGD
+    analysis bounds — plus push-delta norm, fetch-delta norm (how far
+    the center moved since this client's previous pull), param norm, and
+    the update/param norm ratio.
+
+    Every input is host numpy the exchange already materialized (the
+    τ-boundary flatten and the fetched center), so this adds ZERO device
+    syncs; it lives outside the training loop so MPT005 stays clean, and
+    the caller only invokes it when the transport is obs-wrapped — the
+    obs-off cost is one attribute check per round (pinned by
+    tests/test_dynamics.py).
+
+    ``push_vec`` (downpour) is the pushed delta; for EASGD the push is
+    the elastic move itself, so ``alpha`` is passed instead and
+    push_norm = alpha·elastic without forming another vector.
+    """
+    elastic = float(np.linalg.norm(flat - center))
+    push_norm = (
+        float(np.linalg.norm(push_vec)) if push_vec is not None
+        else float(alpha) * elastic
+    )
+    param_norm = float(np.linalg.norm(flat))
+    fetch_delta = (
+        0.0 if prev_center is None
+        else float(np.linalg.norm(center - prev_center))
+    )
+    ratio = push_norm / param_norm if param_norm > 0.0 else 0.0
+    tracer = getattr(transport, "obs_tracer", None)
+    if tracer is not None and tracer.journal is not None:
+        tracer.journal.event(
+            "dynamics",
+            tracer.clock.tick(),
+            round=round_no,
+            algo=algo,
+            elastic=elastic,
+            push_norm=push_norm,
+            param_norm=param_norm,
+            fetch_delta=fetch_delta,
+            ratio=ratio,
+        )
+    reg.set_gauge(M_ELASTIC_DIST, elastic)
+    reg.set_gauge(M_PUSH_NORM, push_norm)
+    reg.set_gauge(M_PARAM_NORM, param_norm)
+    reg.set_gauge(M_NORM_RATIO, ratio)
 
 
 def client_train_loop(
@@ -128,6 +190,11 @@ def client_train_loop(
         params = unflatten_params(spec, jnp.asarray(client.fetch()))
     opt_state = optimizer.init(params)
     last_pull = np.asarray(flatten_params(params)[0])
+    # training-dynamics plane: armed iff the transport is obs-wrapped —
+    # the same zero-cost-when-off contract as the spans above. prev_center
+    # remembers the previously fetched center for the fetch-delta norm.
+    dyn_on = getattr(client.transport, "obs_tracer", None) is not None
+    prev_center: Optional[np.ndarray] = None
     losses: list[float] = []
     pending: list = []
     consecutive_failures = 0
@@ -181,13 +248,29 @@ def client_train_loop(
                     # alpha*(1-alpha) effective move).
                     center = client.fetch()
                     client.push_easgd(flat)
+                    if dyn_on:
+                        _record_dynamics(
+                            client.transport, reg, round_no, algo,
+                            flat, center, prev_center, alpha=alpha,
+                        )
+                        prev_center = center
                     flat = flat - alpha * (flat - center)
                 else:
-                    client.push_delta(flat - last_pull)
+                    delta = flat - last_pull
+                    client.push_delta(delta)
                     # the pushed delta now belongs to the server: a fetch
                     # failure below must not get it re-pushed next round
+                    prev_pull = last_pull
                     last_pull = flat
-                    flat = client.fetch()
+                    fetched = client.fetch()
+                    if dyn_on:
+                        # elastic here = ‖local − fetched center‖; the
+                        # fetch-delta baseline is the previous pull
+                        _record_dynamics(
+                            client.transport, reg, round_no, algo,
+                            flat, fetched, prev_pull, push_vec=delta,
+                        )
+                    flat = fetched
                     last_pull = flat
             except (RecvTimeout, ConnectionError, OSError) as e:
                 total_failures += 1

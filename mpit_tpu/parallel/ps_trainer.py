@@ -22,7 +22,12 @@ import numpy as np
 import optax
 
 from mpit_tpu.data.datasets import shard_for_worker
-from mpit_tpu.obs.core import ObsConfig, write_fault_log
+from mpit_tpu.obs.core import (
+    ObsConfig,
+    arm_faulthandler,
+    disarm_faulthandler,
+    write_fault_log,
+)
 from mpit_tpu.obs.core import config_from_env as obs_config_from_env
 from mpit_tpu.obs.telemetry import wrap_obs_transports
 from mpit_tpu.parallel import common, ps_roles
@@ -228,6 +233,9 @@ class AsyncPSTrainer:
         obs_cfg = self.obs if self.obs is not None else obs_config_from_env()
         obs_transports: list = []
         if obs_cfg is not None:
+            # hung-job forensics (MPIT_OBS_FAULTHANDLER): periodic all-thread
+            # stack dumps while the job runs, cancelled at clean teardown
+            arm_faulthandler(obs_cfg, "trainer")
             transports = wrap_obs_transports(transports, obs_cfg)
             obs_transports = transports
             if obs_cfg.live and self.fault_log is not None:
@@ -369,6 +377,17 @@ class AsyncPSTrainer:
             "exchange_failures": [
                 s.get("exchange_failures", 0) for s in exchange_stats
             ],
+            # dynamics plane (docs/OBSERVABILITY.md "dynamics"): per-server
+            # center version reached, and per-source push-staleness tallies
+            # (center updates applied between a client's fetch basis and
+            # its push landing) — the in-memory twin of the journal's
+            # push_stale records
+            "server_versions": [s.version for s in servers],
+            "staleness_by_src": [
+                {src: dict(st) for src, st in sorted(
+                    s.staleness_by_src.items())}
+                for s in servers
+            ],
         }
         if self.fault_log is not None:
             stats["chaos_faults"] = self.fault_log.counts()
@@ -387,6 +406,8 @@ class AsyncPSTrainer:
                 t.obs_tracer.close()
                 # stop live exporters too (final snapshot hits disk)
                 t.close_live()
+            if obs_cfg.faulthandler > 0:
+                disarm_faulthandler()
         return center_params, stats
 
     def evaluate(self, params, x, y, batch: int = 512) -> float:

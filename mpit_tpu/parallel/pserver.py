@@ -18,7 +18,7 @@ Protocol tags (client → server unless noted):
   FETCH       (attempt_id|None)  server replies PARAM to requester
   PUSH_EASGD  (envelope)         center += alpha * (x_chunk - center)
   PUSH_DELTA  (envelope)         center += server_lr * delta_chunk
-  PARAM       ((attempt_id, chunk) | chunk)   server → client fetch reply
+  PARAM       ((attempt_id, version, chunk) | chunk)  server → client reply
   STOP        ()                 client detaches; server exits when all did
   HEARTBEAT   ()                 liveness only (refreshes the watchdog)
 
@@ -26,13 +26,23 @@ Fault-tolerant envelopes (docs/ROBUSTNESS.md): a FETCH carrying an
 ``attempt_id`` gets it echoed in the PARAM reply, so a client whose
 earlier attempt timed out can discard the stale reply instead of
 mis-assembling chunks across attempts. A push envelope is ``(epoch, seq,
-chunk)``: ``seq`` is the client's per-push counter and ``epoch`` its
-per-instance identity, deduplicated server-side in a sliding window so a
-duplicated/retransmitted push applies **exactly once** (rejects counted
-in ``counts["dup_dropped"]``); a *replacement* client on a reused rank
-has a fresh epoch, so its restarted seq stream is not mistaken for
-replays of its predecessor's. Bare payloads (no envelope) keep the
-legacy apply-always semantics for hand-rolled protocol tests. A frame
+basis_version, chunk)``: ``seq`` is the client's per-push counter and
+``epoch`` its per-instance identity, deduplicated server-side in a
+sliding window so a duplicated/retransmitted push applies **exactly
+once** (rejects counted in ``counts["dup_dropped"]``); a *replacement*
+client on a reused rank has a fresh epoch, so its restarted seq stream
+is not mistaken for replays of its predecessor's.
+``basis_version`` is the training-dynamics plane
+(docs/OBSERVABILITY.md "dynamics"): the server keeps a monotonic
+``version`` counter over its center chunk, bumped once per applied
+push and stamped into every attempt-id'd PARAM reply; the client
+echoes the version it last fetched into its push envelopes, so the
+server can journal per-push **staleness** — how many other updates
+landed between this client's fetch and its push applying, the
+asynchrony quantity the EASGD analysis bounds. Both the
+``(epoch, seq, chunk)`` 3-tuple and bare payloads (no envelope) keep
+working — legacy envelopes just carry no basis, so their pushes apply
+without a staleness record. A frame
 mangled on the wire (chaos ``corrupt``/``truncate`` — a
 ``CorruptedPayload`` marker or a wrong-shape chunk) is dropped whole and
 counted in ``counts["malformed_dropped"]``; it never consumes a dedup
@@ -57,6 +67,7 @@ from typing import Optional, Sequence
 import numpy as np
 
 from mpit_tpu.analysis.runtime import make_lock
+from mpit_tpu.obs.live import M_STALENESS, live_registry
 from mpit_tpu.transport import (
     ANY_SOURCE,
     ANY_TAG,
@@ -176,6 +187,14 @@ class PServer:
         self.counts = {"fetch": 0, "push_easgd": 0, "push_delta": 0,
                        "heartbeat": 0, "dup_dropped": 0,
                        "malformed_dropped": 0}
+        # training-dynamics plane (docs/OBSERVABILITY.md "dynamics"):
+        # monotonic center-update version — bumped per applied push,
+        # stamped into attempt-id'd PARAM replies, echoed back by
+        # clients as the fetch basis of their push envelopes
+        self.version = 0
+        # per-src staleness accounting {src: {pushes, sum, max}} for
+        # versioned pushes only (legacy envelopes carry no basis)
+        self.staleness_by_src: dict[int, dict[str, int]] = {}
         self._dedup = _DedupWindow(dedup_window)
         self.dead_clients: set[int] = set()
         self._stopped: set[int] = set()
@@ -244,12 +263,19 @@ class PServer:
             if msg.tag == TAG_FETCH:
                 with self._lock:
                     snapshot = self.center.copy()
+                    version = self.version
                     self.counts["fetch"] += 1
                 # echo the client's attempt id so a retrying fetch can
-                # tell this reply from a stale one (None = legacy FETCH)
+                # tell this reply from a stale one (None = legacy FETCH);
+                # id'd replies also carry the center's update version —
+                # the client echoes it back as its push basis so the
+                # server can attribute per-push staleness
                 reply = (
                     snapshot if msg.payload is None
-                    else (msg.payload, snapshot)
+                    else (msg.payload, version, snapshot)
+                )
+                self._journal_dynamics(
+                    "param_version", dst=msg.src, version=version
                 )
                 self.transport.send(msg.src, TAG_PARAM, reply)
             elif msg.tag == TAG_PUSH_EASGD:
@@ -261,6 +287,9 @@ class PServer:
                         )
                         self.counts["push_easgd"] += 1
                         self._updates_since_save += 1
+                        self.version += 1
+                        version = self.version
+                    self._record_push(msg, version)
                     self._maybe_persist()
             elif msg.tag == TAG_PUSH_DELTA:
                 if self._admit_push(msg):
@@ -268,6 +297,9 @@ class PServer:
                         self.center += self.server_lr * np.asarray(msg.payload)
                         self.counts["push_delta"] += 1
                         self._updates_since_save += 1
+                        self.version += 1
+                        version = self.version
+                    self._record_push(msg, version)
                     self._maybe_persist()
             elif msg.tag == TAG_HEARTBEAT:
                 with self._lock:
@@ -284,15 +316,31 @@ class PServer:
         """Unwrap a push envelope, validate the chunk, and run the
         exactly-once check.
 
-        ``(epoch, seq, chunk)`` envelopes are deduplicated per (src,
-        epoch); the validated chunk is rebound onto ``msg.payload`` so
-        the apply path below handles both envelope and legacy bare-chunk
-        pushes identically. Returns False for a replay or a malformed
-        chunk (both counted, never applied). Validation runs BEFORE the
-        dedup admit: a chaos-truncated frame must not consume its
-        (epoch, seq) slot — a clean retransmit of the same push should
-        still be able to land."""
+        ``(epoch, seq, basis_version, chunk)`` (and legacy ``(epoch,
+        seq, chunk)``) envelopes are deduplicated per (src, epoch); the
+        validated chunk is rebound onto ``msg.payload`` so the apply
+        path below handles envelope and legacy bare-chunk pushes
+        identically, and the basis version (when present) is stashed on
+        the message for the post-apply staleness record. Returns False
+        for a replay or a malformed chunk (both counted, never
+        applied). Validation runs BEFORE the dedup admit: a
+        chaos-truncated frame must not consume its (epoch, seq) slot —
+        a clean retransmit of the same push should still be able to
+        land."""
         payload = msg.payload
+        basis: Optional[int] = None
+        if (
+            isinstance(payload, tuple)
+            and len(payload) == 4
+            and isinstance(payload[0], int)
+            and isinstance(payload[1], int)
+            and isinstance(payload[2], int)
+        ):
+            # versioned envelope: peel the fetch-basis version off and
+            # fall through to the common (epoch, seq, chunk) handling —
+            # dedup and validation are identical either way
+            epoch, seq, basis, chunk = payload
+            payload = (epoch, seq, chunk)
         if (
             isinstance(payload, tuple)
             and len(payload) == 3
@@ -310,6 +358,8 @@ class PServer:
                 with self._lock:
                     self.counts["dup_dropped"] += 1
                 return False
+            msg.basis_version = basis
+            msg.push_epoch = epoch
             return True
         arr = self._validate_chunk(payload)
         if arr is None:
@@ -318,6 +368,51 @@ class PServer:
             return False
         msg.payload = arr
         return True
+
+    def _journal_dynamics(self, ev: str, **fields) -> None:
+        """Write a training-dynamics record through the transport's obs
+        tracer. No-op (one getattr) when the transport is not
+        obs-wrapped or journaling is off — the disabled-cost contract
+        of the rest of the obs plane."""
+        tracer = getattr(self.transport, "obs_tracer", None)
+        if tracer is None or tracer.journal is None:
+            return
+        tracer.journal.event(ev, tracer.clock.tick(), **fields)
+
+    def _record_push(self, msg, version: int) -> None:
+        """Account, journal, and live-publish an applied push's
+        staleness when its envelope carried a fetch-basis version
+        (legacy envelopes don't — they apply silently, as before).
+
+        staleness = pre-apply version − basis version: the number of
+        center updates that landed between this client's fetch and its
+        push applying. 0 means the push coupled against exactly the
+        center it fetched; under contention it grows with how many
+        other clients' pushes raced in between — the per-(src, epoch)
+        asynchrony signal ``obs dynamics`` aggregates."""
+        basis = getattr(msg, "basis_version", None)
+        if basis is None:
+            return
+        staleness = max(0, version - 1 - basis)
+        with self._lock:
+            st = self.staleness_by_src.setdefault(
+                msg.src, {"pushes": 0, "sum": 0, "max": 0}
+            )
+            st["pushes"] += 1
+            st["sum"] += staleness
+            st["max"] = max(st["max"], staleness)
+        self._journal_dynamics(
+            "push_stale",
+            src=msg.src,
+            epoch=getattr(msg, "push_epoch", None),
+            staleness=staleness,
+            version=version,
+        )
+        # live histogram: one staleness unit recorded as one "second" —
+        # the geometric buckets are unit-agnostic, so the dashboard's
+        # percentile_ms/1000 recovers staleness units within bucket
+        # resolution (~10%)
+        live_registry(self.transport).observe(M_STALENESS, float(staleness))
 
     def _validate_chunk(self, chunk) -> Optional[np.ndarray]:
         """float32 view/copy of an update chunk, or None when the frame

@@ -10,7 +10,13 @@ threshold in the direction that hurts:
 
 - throughput (``value``) dropping;
 - latency fields (``*_ms``) rising;
-- ``goodput`` dropping.
+- ``goodput`` dropping;
+- update-quality fields under ``dynamics`` (mnist-ps legs) moving in
+  the direction that hurts: ``staleness_p99`` or ``elastic_dist_final``
+  rising, ``norm_ratio`` drifting either way (its healthy value is an
+  equilibrium, not a maximum). A field newly appearing from a zero/
+  absent baseline warns too — quality cost showing up where there was
+  none is exactly what an async-speedup "win" must disclose.
 
 ``--trend`` additionally scores the newest round against the BEST round
 in the longest comparable history suffix (same metric, same platform
@@ -103,6 +109,26 @@ def compare(old: dict, new: dict, threshold: float) -> list:
         drop = (ov - nv) / ov
         if drop > threshold:
             flags.append(f"goodput {ov} -> {nv} ({drop:.1%} drop)")
+    od = old.get("dynamics") if isinstance(old.get("dynamics"), dict) else {}
+    nd = new.get("dynamics") if isinstance(new.get("dynamics"), dict) else {}
+    for k in ("staleness_p99", "elastic_dist_final"):
+        ov, nv = _num(od, k), _num(nd, k)
+        if nv is None:
+            continue
+        if ov is not None and ov > 0:
+            rise = (nv - ov) / ov
+            if rise > threshold:
+                flags.append(f"dynamics.{k} {ov} -> {nv} "
+                             f"({rise:.1%} rise)")
+        elif nv > 0 and od:  # baseline had dynamics but this value was 0
+            flags.append(f"dynamics.{k} 0 -> {nv} (quality cost "
+                         "appeared from a zero baseline)")
+    ov, nv = _num(od, "norm_ratio"), _num(nd, "norm_ratio")
+    if ov is not None and nv is not None and ov > 0:
+        drift = abs(nv - ov) / ov
+        if drift > threshold:
+            flags.append(f"dynamics.norm_ratio {ov} -> {nv} "
+                         f"({drift:.1%} drift)")
     return flags
 
 

@@ -6,15 +6,20 @@
 #   scripts/lint.sh --fix           # rewrite fixable MPT002 sites, then gate
 #   scripts/lint.sh path/to/file.py # lint specific paths (vs the baseline)
 #
-# The default run is four gates behind the one baseline:
+# The default run is five gates behind the one baseline:
 #   1. the static lint (MPT001-008, MPT012) + protocol model check
 #      (MPT009-011);
 #   2. an explicit `mcheck` pass, so the exhaustive state counts land in
 #      the CI log even when everything is green;
-#   3. a smoke `conform` pass over the checked-in good-run journals —
-#      the trace-conformance path (TC201-203) exercised on every lint;
+#   3. smoke `conform` passes over the checked-in good-run journals —
+#      the trace-conformance path exercised on every lint: the chaos
+#      fixture covers TC201-203, the dynamics fixture carries
+#      param_version records so TC204 runs non-vacuously;
 #   4. live-snapshot schema validation over the checked-in golden
-#      (tests/fixtures/live — the `obs live --validate` contract).
+#      (tests/fixtures/live — the `obs live --validate` contract);
+#   5. the training-dynamics gate over the checked-in dynamics golden
+#      (tests/fixtures/dynamics/good_run vs scripts/dynamics_smoke.json
+#      — the `obs dynamics --gate` contract).
 # The whole default run is bounded to < 15 s wall-clock
 # (tests/test_lint_gate.py enforces it).
 #
@@ -40,9 +45,16 @@ python -m mpit_tpu.analysis "${@:-mpit_tpu/}"
 # explicit-path gates only make sense for the default whole-package run
 if [[ $# -eq 0 ]]; then
     python -m mpit_tpu.analysis mcheck
-    python -m mpit_tpu.analysis conform tests/fixtures/conformance/good_run
+    # one extraction, two audits: the chaos fixture covers TC201-203
+    # under faults, the dynamics fixture carries param_version records
+    # so TC204 (version monotonicity) runs non-vacuously
+    python -m mpit_tpu.analysis conform \
+        tests/fixtures/conformance/good_run tests/fixtures/dynamics/good_run
     # the live-snapshot schema contract, gated on the checked-in golden
     python -m mpit_tpu.obs live tests/fixtures/live --validate
+    # the update-quality contract, gated on the same dynamics golden
+    python -m mpit_tpu.obs dynamics tests/fixtures/dynamics/good_run \
+        --gate scripts/dynamics_smoke.json
     # warn-only: bench trajectory drift should be SEEN at lint time, but
     # bench noise must never block a commit (--strict exists for CI)
     python scripts/bench_gate.py --trend || true
