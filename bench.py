@@ -512,6 +512,11 @@ def bench_ps_literal(
     steps = 24 if cpu_smoke else 600
     x_tr, y_tr, x_te, y_te = load_mnist(synthetic_train=2048)
     x_tr = cast_input_dtype(x_tr, input_dtype)
+    # the wire-format A/B lever (docs/WIRE.md): MPIT_BENCH_PS_TRANSPORT=
+    # socket runs the same actors over real loopback TCP, where
+    # MPIT_WIRE_FORMAT / MPIT_WIRE_QUANT select the codec — the framed-vs-
+    # pickle serialize+deserialize comparison the fast-wire item records
+    ps_transport = os.environ.get("MPIT_BENCH_PS_TRANSPORT", "auto")
     trainer = AsyncPSTrainer(
         _build_model(cfg, {}),
         optax.sgd(cfg.lr, momentum=cfg.momentum),
@@ -520,6 +525,7 @@ def bench_ps_literal(
         algo=cfg.resolved_algo().removeprefix("ps-"),
         alpha=cfg.alpha if cfg.alpha is not None else 0.9 / cfg.clients,
         tau=cfg.tau,
+        transport=ps_transport,
     )
     from mpit_tpu.obs import ObsConfig, roofline
     from mpit_tpu.obs.live import aggregate, read_snapshots, validate_snapshot
@@ -555,6 +561,27 @@ def bench_ps_literal(
         dyn_run = aggregate_dynamics([obs_dir])["run"]
     run = report["run"]
     samples = steps * per_client * cfg.clients
+    # wire-phase seconds summed across ranks from the telemetry
+    # summaries: serialize/queue_wait/write off the SendHandles,
+    # transfer/deserialize off the socket read loops — the exact
+    # quantity the framed codec is meant to shrink (zero when the
+    # transport measures no split, i.e. the reference-passing brokers)
+    wire_detail = {
+        "serialize_s": 0.0, "queue_wait_s": 0.0, "write_s": 0.0,
+        "transfer_s": 0.0, "deserialize_s": 0.0,
+    }
+    for tel in stats.get("telemetry", []):
+        for s in tel.get("send", {}).values():
+            ph = s.get("phase_s", {})
+            wire_detail["serialize_s"] += ph.get("serialize", 0.0)
+            wire_detail["queue_wait_s"] += ph.get("queue_wait", 0.0)
+            wire_detail["write_s"] += ph.get("write", 0.0)
+        for v in tel.get("rx_phase_s", {}).values():
+            wire_detail["transfer_s"] += v.get("transfer", 0.0)
+            wire_detail["deserialize_s"] += v.get("deserialize", 0.0)
+    wire_detail = {k: round(v, 4) for k, v in wire_detail.items()}
+    from mpit_tpu.transport import wire as _wirecodec
+
     return {
         "samples_per_sec": samples / wall,
         # one host (and on this rig one chip) runs all actors
@@ -567,6 +594,20 @@ def bench_ps_literal(
         "accuracy": trainer.evaluate(center, x_te, y_te),
         "timed_seconds": round(wall, 3),
         "per_client_batch": per_client,
+        "ps_transport": ps_transport,
+        # effective codec knobs: the framed/pickle split only exists on
+        # the socket path; broker modes pass references (no codec at all)
+        "wire_format": (
+            _wirecodec.wire_format_from_env()
+            if ps_transport == "socket" else "none"
+        ),
+        "wire_quant": _wirecodec.quant_mode_from_env(),
+        "wire_detail": wire_detail,
+        **({
+            "wire_bytes_total": sum(
+                w["tx"] for w in stats["wire_bytes"]
+            ),
+        } if "wire_bytes" in stats else {}),
         **({
             "phases": {
                 k: round(v, 4) for k, v in run["phases"].items()
@@ -596,6 +637,126 @@ def bench_ps_literal(
                 ),
             },
         } if dyn_run is not None else {}),
+    }
+
+
+def bench_wire(cpu_smoke: bool = False) -> dict:
+    """Codec microbench (the ``--wire`` preset): per-payload-size
+    round-trip cost of the three wire paths — pickle (the old format),
+    framed (``transport/wire.py``, zero-copy binary), and framed+int8
+    quantized — plus a loopback-TCP one-way leg through real
+    :class:`SocketTransport` pairs in both formats.
+
+    The headline ``value`` is framed encode+decode throughput (MB/s,
+    largest payload — higher is better); the per-size ``*_ms`` fields are
+    what ``scripts/bench_gate.py --trend`` watches for codec regressions.
+    Payloads are the PS push envelope shape ``(epoch, seq, basis,
+    chunk)`` — the hot-path message this codec exists for."""
+    import pickle
+    import socket as _socket
+
+    from mpit_tpu.transport import wire
+    from mpit_tpu.transport.socket_transport import (
+        WIRE_PICKLE_PROTOCOL,
+        SocketTransport,
+    )
+
+    sizes = (
+        {"4kb": 1 << 10, "64kb": 1 << 14}
+        if cpu_smoke else
+        {"64kb": 1 << 14, "1mb": 1 << 18, "4mb": 1 << 20}
+    )
+    rng = np.random.default_rng(7)
+    fields: dict = {}
+    framed_mbps = 0.0
+    for label, n in sizes.items():
+        arr = rng.standard_normal(n).astype(np.float32)
+        payload = (1 << 62, 17, 3, arr)
+        nbytes = arr.nbytes
+        reps = max(3, min(200, int(2e8 / max(nbytes, 1))))
+
+        t0 = time.perf_counter()
+        for _ in range(reps):
+            blob = pickle.dumps(payload, protocol=WIRE_PICKLE_PROTOCOL)
+            pickle.loads(blob)
+        fields[f"pickle_{label}_ms"] = (
+            (time.perf_counter() - t0) / reps * 1e3
+        )
+
+        t0 = time.perf_counter()
+        for _ in range(reps):
+            bufs = wire.encode_frame(
+                1, 2, payload, version=wire.WIRE_FORMAT_VERSION
+            )
+            head = bytes(bufs[0])
+            body = b"".join(bytes(b) for b in bufs[1:])
+            _v, flags, hlen, hcrc = wire.split_preamble(
+                head[: wire.PREAMBLE_SIZE]
+            )
+            wire.decode_frame(
+                flags, hcrc, head[wire.PREAMBLE_SIZE:], body
+            )
+        dt = (time.perf_counter() - t0) / reps
+        fields[f"framed_{label}_ms"] = dt * 1e3
+        framed_mbps = nbytes / dt / 1e6  # last (largest) size wins
+
+        t0 = time.perf_counter()
+        for _ in range(reps):
+            q = wire.quantize(arr, "int8")
+            bufs = wire.encode_frame(
+                1, 2, (1 << 62, 17, 3, q),
+                version=wire.WIRE_FORMAT_VERSION,
+            )
+            head = bytes(bufs[0])
+            body = b"".join(bytes(b) for b in bufs[1:])
+            _v, flags, hlen, hcrc = wire.split_preamble(
+                head[: wire.PREAMBLE_SIZE]
+            )
+            _s, _t, out = wire.decode_frame(
+                flags, hcrc, head[wire.PREAMBLE_SIZE:], body
+            )
+            wire.dequantize(out[3])
+        fields[f"quant_int8_{label}_ms"] = (
+            (time.perf_counter() - t0) / reps * 1e3
+        )
+
+    # loopback-TCP one-way leg: real sockets, both codecs. Same payload
+    # count and size; the delta is the serialize+copy the framed path
+    # removed (plus the 4x bytes the pickle of an f32 array still moves).
+    msg_n = sizes[max(sizes, key=lambda k: sizes[k])]
+    msgs = 8 if cpu_smoke else 32
+    arr = rng.standard_normal(msg_n).astype(np.float32)
+    for fmt in ("pickle", "framed"):
+        probes = []
+        addrs = []
+        for _ in range(2):
+            s = _socket.socket()
+            s.bind(("127.0.0.1", 0))
+            addrs.append(("127.0.0.1", s.getsockname()[1]))
+            probes.append(s)
+        for s in probes:
+            s.close()
+        ta = SocketTransport(0, 2, addresses=addrs, wire_format=fmt)
+        tb = SocketTransport(1, 2, addresses=addrs, wire_format=fmt)
+        try:
+            ta.send(1, 2, (1, 0, 0, arr))  # warm the connection + hello
+            tb.recv(timeout=30)
+            t0 = time.perf_counter()
+            for i in range(msgs):
+                ta.send(1, 2, (1, i + 1, 0, arr))
+            for _ in range(msgs):
+                tb.recv(timeout=30)
+            fields[f"loopback_{fmt}_ms"] = (
+                (time.perf_counter() - t0) / msgs * 1e3
+            )
+        finally:
+            ta.close()
+            tb.close()
+    return {
+        "framed_mb_per_sec": framed_mbps,
+        "sizes": sorted(sizes),
+        "loopback_msgs": msgs,
+        **{k: round(v, 4) for k, v in fields.items()},
     }
 
 
@@ -1389,6 +1550,21 @@ def main():
         )
         return
 
+    if "--wire" in sys.argv:
+        with trace(profile_dir):
+            res = bench_wire(cpu_smoke=cpu)
+        print(json.dumps({
+            "metric": "wire_codec_throughput",
+            "value": round(res["framed_mb_per_sec"], 1),
+            "unit": "MB/sec",
+            "vs_baseline": None,  # pickle_*_ms columns ARE the baseline
+            **{k: v for k, v in res.items() if k != "framed_mb_per_sec"},
+            **({"platform_note": platform_note} if platform_note else {}),
+            **_probe_tag(),
+            **profiled,
+        }))
+        return
+
     name = flag_arg("--preset")
     if name is not None:
         try:
@@ -1410,7 +1586,9 @@ def main():
             **{
                 k: res[k]
                 for k in ("mfu", "spread", "phases", "phase_source",
-                          "live", "dynamics")
+                          "live", "dynamics", "ps_transport",
+                          "wire_format", "wire_quant", "wire_detail",
+                          "wire_bytes_total")
                 if k in res
             },
             **({"platform_note": platform_note} if platform_note else {}),

@@ -67,6 +67,12 @@ class Config:
     # as fallback; never imported)
     wire_protocol_name: str = "WIRE_PICKLE_PROTOCOL"
     wire_pickle_protocol: Optional[int] = None
+    # the canonical binary-frame version constant: its name, and an
+    # optional value override for tests (default: extracted from
+    # transport/wire.py the same way — scan set first, installed package
+    # as fallback; never imported)
+    wire_version_name: str = "WIRE_FORMAT_VERSION"
+    wire_format_version: Optional[int] = None
 
 
 @dataclasses.dataclass

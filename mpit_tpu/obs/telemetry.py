@@ -232,6 +232,14 @@ class TelemetryTransport(Transport):
                 if handle is not None and handle.done() and err is None
                 else None
             )
+            if phases is not None:
+                # byte-counting transports (SocketTransport) stamp the
+                # exact on-wire frame length next to the phase split —
+                # prefer it over the estimate, so summary bytes equal
+                # socket-level bytes (asserted by tests/test_obs.py)
+                exact = getattr(handle, "wire_nbytes", None)
+                if exact is not None:
+                    nbytes = exact
             depth = None
             with self._stats_lock:
                 s = self._stat(self._send_stats, dst, tag)
@@ -318,7 +326,12 @@ class TelemetryTransport(Transport):
             # parent the receiving thread's NEXT sends on this message
             # (None clears a stale parent when the sender wasn't tracing)
             self.obs_tracer.set_remote_parent(ctx)
-        nbytes = _approx_nbytes(payload)
+        # exact on-wire frame length when the inner stack counted it
+        # (SocketTransport stamps every delivered message); the estimate
+        # remains for reference-passing transports
+        nbytes = getattr(msg, "wire_nbytes", None)
+        if nbytes is None:
+            nbytes = _approx_nbytes(payload)
         with self._stats_lock:
             s = self._stat(self._recv_stats, msg.src, msg.tag)
             s.n += 1

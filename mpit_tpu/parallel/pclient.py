@@ -50,6 +50,12 @@ from mpit_tpu.parallel.pserver import (
     partition_bounds,
 )
 from mpit_tpu.transport import RecvTimeout, Transport
+from mpit_tpu.transport.wire import (
+    QuantArray,
+    dequantize,
+    quant_mode_from_env,
+    quantize,
+)
 
 # mpit-analysis: protocol-role[client->server]
 # (the client side of the PS wire protocol — MPT008 pairs every send/recv
@@ -89,6 +95,7 @@ class PClient:
         max_retries: int = 3,
         backoff_base: float = 0.05,
         backoff_max: float = 2.0,
+        quant: Optional[str] = None,
     ):
         if max_retries < 0:
             raise ValueError("max_retries must be >= 0")
@@ -96,6 +103,36 @@ class PClient:
         self.server_ranks = list(server_ranks)
         self.param_size = int(param_size)
         self.bounds = partition_bounds(self.param_size, len(self.server_ranks))
+        # coalescing: a rank appearing k times in server_ranks owns k
+        # adjacent chunks — merge them so each round sends ONE message per
+        # distinct server (one framed scatter instead of k sends, one
+        # FETCH/PARAM round trip instead of k). Non-adjacent repeats would
+        # make the merged chunk non-contiguous; reject them.
+        self.ranks: list[int] = []
+        self.rank_bounds: list[tuple[int, int]] = []
+        for rank, (start, end) in zip(self.server_ranks, self.bounds):
+            if self.ranks and rank == self.ranks[-1]:
+                self.rank_bounds[-1] = (self.rank_bounds[-1][0], end)
+            elif rank in self.ranks:
+                raise ValueError(
+                    f"server rank {rank} repeats non-adjacently in "
+                    f"{self.server_ranks} — its chunks would not be "
+                    "contiguous, so they cannot coalesce"
+                )
+            else:
+                self.ranks.append(rank)
+                self.rank_bounds.append((start, end))
+        if quant is None:
+            quant = quant_mode_from_env()
+        elif quant not in ("off", "bf16", "int8"):
+            raise ValueError(f"quant must be off|bf16|int8, got {quant!r}")
+        self.quant = quant
+        # error feedback (EF/EF21 shape): the quantization residual of
+        # each push is carried into the next one, so the quantizer's bias
+        # cancels over rounds instead of accumulating into the center.
+        # Keyed per (tag, rank): EASGD pushes params, Downpour pushes
+        # deltas — different quantities, separate residual streams.
+        self._residual: dict[tuple[int, int], np.ndarray] = {}
         self.timeout = timeout
         self.max_retries = int(max_retries)
         self.backoff_base = float(backoff_base)
@@ -164,9 +201,25 @@ class PClient:
         """float32 view of a PARAM chunk, or None when the reply is
         malformed (chaos ``corrupt`` replaced the frame, ``truncate`` cut
         the array short, or the shape just doesn't match this server's
-        partition)."""
+        partition). Accepts, beyond a bare ndarray: a quantized
+        :class:`QuantArray` (dequantized here) and a multi-chunk reply —
+        a list of ndarray/QuantArray parts that concatenate to this
+        server's merged partition (a sharded server answering one
+        coalesced FETCH with its per-shard chunks in one message)."""
         try:
-            arr = np.asarray(chunk, dtype=np.float32)
+            if isinstance(chunk, QuantArray):
+                arr = dequantize(chunk)
+            elif isinstance(chunk, list):
+                if not chunk:
+                    return None
+                arr = np.concatenate([
+                    dequantize(p) if isinstance(p, QuantArray)
+                    else np.asarray(p, dtype=np.float32)
+                    for p in chunk
+                ])
+            else:
+                arr = np.asarray(chunk, dtype=np.float32)
+            arr = np.asarray(arr, dtype=np.float32)
         except (TypeError, ValueError):
             return None
         if arr.shape != (expected,):
@@ -269,13 +322,13 @@ class PClient:
         retry-with-backoff on timeout, attempt-id'd against stale
         replies."""
         attempts: dict[int, Optional[int]] = {}
-        for rank in self.server_ranks:
+        for rank in self.ranks:
             try:
                 attempts[rank] = self._send_fetch(rank)
             except (ConnectionError, OSError):
                 attempts[rank] = None  # the retry path re-sends
         out = np.empty(self.param_size, np.float32)
-        for rank, (start, end) in zip(self.server_ranks, self.bounds):
+        for rank, (start, end) in zip(self.ranks, self.rank_bounds):
             out[start:end] = self._await_param(
                 rank, attempts[rank], end - start
             )
@@ -324,13 +377,28 @@ class PClient:
         # Each chunk carries that server's last-fetched center version
         # as its staleness basis (0 = never fetched a versioned reply).
         seq = next(self._push_seq)
-        for rank, (start, end) in zip(self.server_ranks, self.bounds):
+        for rank, (start, end) in zip(self.ranks, self.rank_bounds):
+            chunk = flat[start:end]
+            if self.quant != "off":
+                # error feedback: compensate this push with the residual
+                # the previous quantized push left behind, then carry the
+                # new residual forward — the bias cancels over rounds.
+                # The residual is folded in BEFORE send-retry, so a
+                # retried (deduplicated) send re-offers identical bytes.
+                key = (tag, rank)
+                res = self._residual.get(key)
+                comp = chunk if res is None else chunk + res
+                q = quantize(comp, self.quant)
+                self._residual[key] = comp - dequantize(q)
+                payload_chunk = q
+            else:
+                payload_chunk = chunk
             self._send_with_retry(
                 rank, tag,
                 (
                     self._epoch, seq,
                     self.server_version.get(rank, 0),
-                    flat[start:end],
+                    payload_chunk,
                 ),
             )
             self.push_sent[rank] += 1

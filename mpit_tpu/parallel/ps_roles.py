@@ -231,6 +231,11 @@ def client_train_loop(
             break  # steps % tau remainder trains without an exchange
         round_no += 1
         flush()
+        # zero-copy wire contract (docs/WIRE.md): the framed transport
+        # sends slices of this vector by reference (no serialize copy),
+        # and PClient's blocking sends return only once written — so the
+        # loop below must never mutate `flat` in place; the post-exchange
+        # elastic move builds a NEW array.
         flat = np.asarray(flatten_params(params)[0])
         t_x = time.perf_counter()
         with obs_span(

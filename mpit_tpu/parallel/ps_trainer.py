@@ -68,7 +68,12 @@ class AsyncPSTrainer:
       alpha: elastic coupling (both server- and client-side move).
       tau: local steps between exchanges.
       transport: "native" (C++ broker, ``mpit_tpu.native``), "inproc"
-        (pure-Python broker), or "auto" (native when buildable — it is the
+        (pure-Python broker), "socket" (real TCP loopback: every actor gets
+        its own :class:`SocketTransport` on an ephemeral port — actors are
+        still threads, but every message crosses a genuine socket with the
+        framed wire codec, so the serialize/transfer/deserialize phase
+        split and exact byte counters are real; the bench's wire-format
+        A/B mode), or "auto" (native when buildable — it is the
         reference-parity message plane, SURVEY.md §2 comp. 1). Tradeoff:
         inproc passes payload *references* (zero copies, fastest per-message
         for huge payloads), native moves real bytes (~memcpy bandwidth) but
@@ -135,7 +140,7 @@ class AsyncPSTrainer:
     ):
         if algo not in ("easgd", "downpour"):
             raise ValueError(f"unknown algo {algo!r}")
-        if transport not in ("auto", "native", "inproc"):
+        if transport not in ("auto", "native", "inproc", "socket"):
             raise ValueError(f"unknown transport {transport!r}")
         self.transport_kind = transport
         # failure detection (SURVEY.md §5 do-better): silence beyond this →
@@ -198,6 +203,31 @@ class AsyncPSTrainer:
                 return native.NativeBroker(size)
         return Broker(size)
 
+    def _make_transports(self, size: int) -> list:
+        if self.transport_kind != "socket":
+            return self._make_broker(size).transports()
+        # real-TCP loopback world: reserve one ephemeral port per rank
+        # (bind 0, read, release), then hand every rank the full address
+        # table. The release→bind window is racy in principle; in practice
+        # the kernel avoids handing a just-released ephemeral port straight
+        # back out, and a lost race fails loudly at bind.
+        import socket as _socket
+
+        from mpit_tpu.transport.socket_transport import SocketTransport
+
+        probes = []
+        addrs: list[tuple[str, int]] = []
+        for _ in range(size):
+            s = _socket.socket(_socket.AF_INET, _socket.SOCK_STREAM)
+            s.bind(("127.0.0.1", 0))
+            addrs.append(("127.0.0.1", s.getsockname()[1]))
+            probes.append(s)
+        for s in probes:
+            s.close()
+        return [
+            SocketTransport(r, size, addresses=addrs) for r in range(size)
+        ]
+
     def train(
         self,
         x: np.ndarray,
@@ -218,8 +248,10 @@ class AsyncPSTrainer:
         flat0, spec = flatten_params(params0)
         flat0 = np.asarray(flat0, np.float32)
 
-        broker = self._make_broker(self.num_servers + self.num_clients)
-        transports = broker.transports()
+        raw_transports = self._make_transports(
+            self.num_servers + self.num_clients
+        )
+        transports = raw_transports
         # fault injection: explicit config wins, env knobs activate it for
         # launcher-driven runs (MPIT_CHAOS_*; see launch.py's diagnostic)
         chaos_cfg = self.chaos if self.chaos is not None else config_from_env()
@@ -331,6 +363,16 @@ class AsyncPSTrainer:
             threading.Thread(target=client_main, args=(c,), daemon=True)
             for c in range(self.num_clients)
         ]
+        def teardown_transports():
+            # socket mode owns real OS resources (listeners, connections,
+            # sender threads) — close them; broker modes die with the run
+            if self.transport_kind == "socket":
+                for t in raw_transports:
+                    try:
+                        t.close()
+                    except OSError:
+                        pass
+
         for t in client_threads:
             t.start()
         for t in client_threads:
@@ -339,8 +381,10 @@ class AsyncPSTrainer:
             t.join(timeout=30)
         server_errors = [s.error for s in servers if s.error is not None]
         if server_errors:
+            teardown_transports()
             raise RuntimeError("pserver died during training") from server_errors[0]
         if errors:
+            teardown_transports()
             raise errors[0]
 
         center_flat = np.concatenate([s.snapshot() for s in servers])
@@ -408,6 +452,13 @@ class AsyncPSTrainer:
                 t.close_live()
             if obs_cfg.faulthandler > 0:
                 disarm_faulthandler()
+        # exact socket-level byte totals (socket mode only): ground truth
+        # next to the telemetry summaries' per-(peer,tag) byte counters
+        if self.transport_kind == "socket":
+            stats["wire_bytes"] = [
+                t.wire_byte_counts() for t in raw_transports
+            ]
+        teardown_transports()
         return center_params, stats
 
     def evaluate(self, params, x, y, batch: int = 512) -> float:

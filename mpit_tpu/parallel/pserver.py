@@ -75,6 +75,12 @@ from mpit_tpu.transport import (
     RecvTimeout,
     Transport,
 )
+from mpit_tpu.transport.wire import (
+    QuantArray,
+    dequantize,
+    quant_mode_from_env,
+    quantize,
+)
 
 # mpit-analysis: protocol-role[server->client]
 # (this module IS the server side of the PS wire protocol; the MPT008
@@ -153,6 +159,7 @@ class PServer:
         ckpt_path: Optional[str] = None,
         ckpt_every: Optional[int] = 100,
         dedup_window: int = 1024,
+        quant: Optional[str] = None,
     ):
         """``client_timeout``: seconds of per-client silence before the
         watchdog declares it dead (requires ``client_ranks``); None keeps
@@ -184,6 +191,15 @@ class PServer:
                     "client_timeout must be positive (use None to disable)"
                 )
         self.client_timeout = client_timeout
+        # opt-in quantized PARAM replies (MPIT_WIRE_QUANT, docs/WIRE.md):
+        # only attempt-id'd fetches get a quantized snapshot — an un-id'd
+        # FETCH is by definition a legacy client, which may predate
+        # QuantArray entirely
+        if quant is None:
+            quant = quant_mode_from_env()
+        elif quant not in ("off", "bf16", "int8"):
+            raise ValueError(f"quant must be off|bf16|int8, got {quant!r}")
+        self.quant = quant
         self.counts = {"fetch": 0, "push_easgd": 0, "push_delta": 0,
                        "heartbeat": 0, "dup_dropped": 0,
                        "malformed_dropped": 0}
@@ -270,10 +286,14 @@ class PServer:
                 # id'd replies also carry the center's update version —
                 # the client echoes it back as its push basis so the
                 # server can attribute per-push staleness
-                reply = (
-                    snapshot if msg.payload is None
-                    else (msg.payload, version, snapshot)
-                )
+                if msg.payload is None:
+                    reply = snapshot
+                elif self.quant != "off":
+                    reply = (
+                        msg.payload, version, quantize(snapshot, self.quant)
+                    )
+                else:
+                    reply = (msg.payload, version, snapshot)
                 self._journal_dynamics(
                     "param_version", dst=msg.src, version=version
                 )
@@ -419,8 +439,12 @@ class PServer:
         is malformed (chaos ``corrupt``/``truncate``, or just the wrong
         shape for this server's partition) — the safe side of
         at-most-once: an unparseable update is dropped whole, never
-        partially or wrongly applied."""
+        partially or wrongly applied. Quantized chunks are dequantized
+        here (a truncated QuantArray dequantizes to the wrong length and
+        fails the shape check like any cut frame)."""
         try:
+            if isinstance(chunk, QuantArray):
+                chunk = dequantize(chunk)
             arr = np.asarray(chunk, dtype=np.float32)
         except (TypeError, ValueError):
             return None

@@ -43,3 +43,10 @@ from mpit_tpu.transport.socket_transport import (  # noqa: F401
     WIRE_PICKLE_PROTOCOL,
     SocketTransport,
 )
+from mpit_tpu.transport.wire import (  # noqa: F401
+    WIRE_FORMAT_VERSION,
+    QuantArray,
+    WireDecodeError,
+    dequantize,
+    quantize,
+)

@@ -21,6 +21,10 @@ class Message:
     dst: int
     tag: int
     payload: Any
+    # exact on-wire byte count (length prefix + frame) stamped by byte-
+    # counting transports (SocketTransport); None for reference-passing
+    # transports, where obs telemetry falls back to its estimate
+    wire_nbytes: Optional[int] = None
 
     def matches(self, src: int, tag: int) -> bool:
         return (src == ANY_SOURCE or src == self.src) and (
@@ -44,6 +48,9 @@ class SendHandle:
         # BEFORE the handle completes; valid only once done() is true.
         # Transports without a phase breakdown leave it None.
         self.phases: Optional[dict] = None
+        # exact bytes written for this send (length prefix included),
+        # stamped alongside ``phases`` by byte-counting transports
+        self.wire_nbytes: Optional[int] = None
 
     def set_done(self):
         self._done.set()

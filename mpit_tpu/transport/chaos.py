@@ -73,6 +73,7 @@ from typing import Any, Iterable, Mapping, Optional, Sequence
 import numpy as np
 
 from mpit_tpu.transport.base import Transport
+from mpit_tpu.transport.wire import QuantArray
 
 _MASK = (1 << 64) - 1
 
@@ -127,13 +128,21 @@ def _truncate_payload(payload: Any) -> Optional[Any]:
         if payload.ndim >= 1 and payload.shape[0] > 1:
             return payload[: payload.shape[0] // 2]
         return None
-    if isinstance(payload, tuple):
+    if isinstance(payload, (tuple, list)):
         out, cut = [], False
         for item in payload:
             t = _truncate_payload(item)
             out.append(item if t is None else t)
             cut = cut or t is not None
-        return tuple(out) if cut else None
+        return type(payload)(out) if cut else None
+    # quantized chunks carry their bulk bytes in .data — cut those, same
+    # early-stream-end model as a raw ndarray (no extra RNG draws: the
+    # fault schedule for old seeds is unchanged)
+    if isinstance(payload, QuantArray):
+        t = _truncate_payload(payload.data)
+        if t is None:
+            return None
+        return dataclasses.replace(payload, data=t)
     return None
 
 

@@ -5,12 +5,30 @@ reference's multi-node MPI case, SURVEY.md §2 distributed-backend row). Data
 parallel *gradient* traffic should ride XLA collectives over ICI — this
 transport is for the PS protocol's small, latency-tolerant messages.
 
-Wire format: 8-byte big-endian length + pickle (``WIRE_PICKLE_PROTOCOL``,
-the canonical pin every wire writer must name — lint rule MPT007) of
-(src, tag, payload). Each rank listens on one port; outbound connections are
-cached per destination. A background acceptor/reader thread feeds a local
-:class:`Broker` mailbox, so recv semantics (tags, ANY_SOURCE, per-(src,tag)
-FIFO) are identical to :class:`InProcTransport`.
+Wire format: 8-byte big-endian length prefix, then ONE of two frame bodies,
+distinguished per-frame by the first two bytes:
+
+* **framed** (``transport/wire.py``, magic ``b"MW"``): a CRC-guarded binary
+  header (src, tag, envelope scalars, dtype/shape) followed by raw ndarray
+  bytes. The sender builds the frame from ``memoryview``s of the arrays —
+  no copy, no pickle — and writes it with vectorized ``sendmsg``; the
+  receiver reads the array bytes straight into a preallocated buffer with
+  ``recv_into`` and wraps it zero-copy. Every frame writer must pin
+  ``WIRE_FORMAT_VERSION`` by name (lint rule MPT007).
+* **pickle** (``WIRE_PICKLE_PROTOCOL``, the canonical pin every pickle wire
+  writer must name — lint rule MPT007) of (src, tag, payload). Pickle
+  protocol ≥2 streams start ``b"\\x80"``, which can never collide with the
+  framed magic. This is the fallback for payloads the binary codec cannot
+  express and for mixed-version peers.
+
+Negotiation: the *receiver* advertises — every accepted connection gets a
+4-byte HELLO carrying the receiver's framed-format version before any
+frames flow. The sender reads it (with a short timeout) right after
+connect; no HELLO ⇒ pickle-only peer. Legacy receivers never send HELLO
+(so new senders fall back), and legacy senders never read their outbound
+socket (so the unread HELLO is harmless) — both mixed pairings keep
+working. ``MPIT_WIRE_NEGOTIATE=0`` makes this transport behave like such a
+legacy peer (no HELLO sent or awaited, pickle only).
 
 Reconnect semantics: TCP gives FIFO within one connection; across a sender
 reconnect, a straggler frame from the old connection could otherwise be
@@ -42,6 +60,7 @@ import time
 from typing import Any, Optional, Sequence
 
 from mpit_tpu.analysis.runtime import make_lock
+from mpit_tpu.transport import wire
 from mpit_tpu.transport.base import (
     ANY_SOURCE,
     ANY_TAG,
@@ -49,7 +68,9 @@ from mpit_tpu.transport.base import (
     SendHandle,
     Transport,
 )
+from mpit_tpu.transport.chaos import CorruptedPayload
 from mpit_tpu.transport.inproc import Broker
+from mpit_tpu.transport.wire import WIRE_FORMAT_VERSION
 
 _LEN = struct.Struct(">Q")
 
@@ -60,6 +81,10 @@ _LEN = struct.Struct(">Q")
 # socket. Every dumps feeding a frame (here and in mpit_tpu/native) must
 # name this constant; the MPT007 lint rule enforces exactly that.
 WIRE_PICKLE_PROTOCOL = 5
+
+# sendmsg iovec count is bounded by IOV_MAX (1024 on Linux); a coalesced
+# scatter frame stays far below this, but cap defensively anyway
+_SENDMSG_MAX_BUFFERS = 512
 
 
 def _addresses(size: int, base_port: int) -> list[tuple[str, int]]:
@@ -87,6 +112,64 @@ def _recv_exact(sock: socket.socket, n: int) -> bytes:
     return bytes(buf)
 
 
+def _recv_into_exact(sock: socket.socket, buf: bytearray) -> None:
+    """Fill ``buf`` completely from the socket — the zero-copy receive:
+    bytes land directly in the buffer the decoded arrays will view."""
+    view = memoryview(buf)
+    got = 0
+    while got < len(buf):
+        n = sock.recv_into(view[got:])
+        if n == 0:
+            raise ConnectionError("peer closed")
+        got += n
+
+
+def _drain_exact(sock: socket.socket, n: int) -> None:
+    """Consume and discard n bytes (skip the rest of an undecodable frame
+    so the length-prefixed stream stays in sync)."""
+    left = n
+    while left > 0:
+        chunk = sock.recv(min(left, 65536))
+        if not chunk:
+            raise ConnectionError("peer closed")
+        left -= len(chunk)
+
+
+class _OutMessage:
+    """One queued outbound message, format-deferred.
+
+    The framed buffers are built eagerly at isend time (zero-copy: they
+    alias the caller's arrays, which MPI buffer semantics say are frozen
+    until the send completes) — but whether the *framed* or *pickle* bytes
+    actually hit the socket is decided by the drainer, after negotiation
+    has revealed what the peer speaks. The pickle frame is built lazily and
+    cached so an evict-retry does not re-serialize."""
+
+    __slots__ = ("src", "tag", "payload", "buffers", "_pickled")
+
+    def __init__(self, src: int, tag: int, payload: Any, buffers):
+        self.src = src
+        self.tag = tag
+        self.payload = payload
+        self.buffers = buffers  # list of buffers, or None (unencodable)
+        self._pickled: Optional[bytes] = None
+
+    def pickle_frame(self) -> bytes:
+        if self._pickled is None:
+            blob = pickle.dumps(
+                (self.src, self.tag, self.payload),
+                protocol=WIRE_PICKLE_PROTOCOL,
+            )
+            self._pickled = _LEN.pack(len(blob)) + blob
+        return self._pickled
+
+    def framed_buffers(self) -> list:
+        """Length-prefixed buffer list for sendmsg. The prefix is fused
+        onto the (small) header buffer; the array views ride untouched."""
+        total = wire.frame_nbytes(self.buffers)
+        return [_LEN.pack(total) + self.buffers[0], *self.buffers[1:]]
+
+
 class SocketTransport(Transport):
     def __init__(
         self,
@@ -95,16 +178,29 @@ class SocketTransport(Transport):
         base_port: int = 29_500,
         addresses: Optional[Sequence[tuple[str, int]]] = None,
         connect_retry_s: float = 30.0,
+        wire_format: Optional[str] = None,
     ):
         """``connect_retry_s``: window during which a refused outbound
         connection is retried — under a process launcher the peers come up
-        at different times (mpirun gave the reference this for free)."""
+        at different times (mpirun gave the reference this for free).
+        ``wire_format``: "framed" (default) or "pickle"; None reads
+        ``MPIT_WIRE_FORMAT``."""
         self.rank = rank
         self.size = size
         self.connect_retry_s = float(connect_retry_s)
         self._addrs = (
             list(addresses) if addresses is not None else _addresses(size, base_port)
         )
+        if wire_format is None:
+            wire_format = wire.wire_format_from_env()
+        elif wire_format not in ("framed", "pickle"):
+            raise ValueError(f"wire_format must be framed|pickle, got {wire_format!r}")
+        self._wire_format = wire_format
+        self._negotiate = wire.negotiate_enabled_from_env()
+        self._hello_timeout = wire.negotiate_timeout_from_env()
+        # per-dst negotiation outcome: True once the peer's HELLO proved it
+        # decodes framed; absent/False ⇒ pickle only
+        self._peer_framed: dict[int, bool] = {}
         # local mailbox reuses the broker's matching logic (1 "rank" = me)
         self._mailbox = Broker(1)
         # reconnect fencing: newest accept-ordered connection seq per src
@@ -127,6 +223,12 @@ class SocketTransport(Transport):
         # deliberately NOT counted). Harvested by obs telemetry summaries.
         self._rx_phases: dict[tuple[int, int], dict] = {}
         self._rx_lock = make_lock("SocketTransport._rx_lock")
+        # exact on-wire byte totals (length prefixes included), both
+        # directions — ground truth the obs summaries are asserted against
+        self._tx_wire_bytes = 0
+        self._rx_wire_bytes = 0
+        self._rx_corrupt_dropped = 0
+        self._byte_lock = make_lock("SocketTransport._byte_lock")
         self._closing = threading.Event()
 
         self._listener = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
@@ -153,6 +255,18 @@ class SocketTransport(Transport):
                 conn, _ = self._listener.accept()
             except OSError:
                 return
+            if self._negotiate:
+                # receiver-advertises: tell the peer what we decode before
+                # any frames flow (legacy receivers skip this, so a new
+                # sender's HELLO wait times out ⇒ pickle fallback)
+                try:
+                    conn.sendall(wire.encode_hello())
+                except OSError:
+                    try:
+                        conn.close()
+                    except OSError:
+                        pass
+                    continue
             with self._src_seq_lock:
                 self._accept_seq += 1
                 seq = self._accept_seq
@@ -165,13 +279,15 @@ class SocketTransport(Transport):
             while not self._closing.is_set():
                 # phase split: the header wait is inter-message idle (the
                 # reader blocks here between frames) and is NOT a phase;
-                # body streaming is payload-transfer, loads is deserialize
+                # body streaming is payload-transfer, decode is deserialize
                 (length,) = _LEN.unpack(_recv_exact(conn, _LEN.size))
                 t_h = time.perf_counter()
-                body = _recv_exact(conn, length)
-                t_b = time.perf_counter()
-                src, tag, payload = pickle.loads(body)
-                t_d = time.perf_counter()
+                msg = self._read_body(conn, length)
+                with self._byte_lock:
+                    self._rx_wire_bytes += _LEN.size + length
+                if msg is None:
+                    continue
+                src, tag, payload, t_b, t_d = msg
                 with self._rx_lock:
                     d = self._rx_phases.get((src, tag))
                     if d is None:
@@ -187,10 +303,60 @@ class SocketTransport(Transport):
                         continue  # straggler from before src's reconnect
                     self._src_seq[src] = seq
                 self._mailbox.put(
-                    Message(src=src, dst=0, tag=tag, payload=payload)
+                    Message(
+                        src=src,
+                        dst=0,
+                        tag=tag,
+                        payload=payload,
+                        wire_nbytes=_LEN.size + length,
+                    )
                 )
         except (ConnectionError, OSError):
             return
+
+    def _read_body(self, conn: socket.socket, length: int):
+        """Read one frame body of ``length`` bytes; dispatch on magic.
+
+        Returns (src, tag, payload, t_body_done, t_decode_done), or None
+        for an undecodable framed body that was consumed and counted but
+        yielded nothing deliverable (stream coordinates unknown)."""
+        if length < wire.PREAMBLE_SIZE:
+            body = _recv_exact(conn, length)
+            t_b = time.perf_counter()
+            src, tag, payload = pickle.loads(body)
+            return src, tag, payload, t_b, time.perf_counter()
+        head = _recv_exact(conn, wire.PREAMBLE_SIZE)
+        if head[:2] != wire.MAGIC:
+            body = head + _recv_exact(conn, length - wire.PREAMBLE_SIZE)
+            t_b = time.perf_counter()
+            src, tag, payload = pickle.loads(body)
+            return src, tag, payload, t_b, time.perf_counter()
+        consumed = wire.PREAMBLE_SIZE
+        try:
+            _version, flags, hlen, hcrc = wire.split_preamble(head)
+            if wire.PREAMBLE_SIZE + hlen > length:
+                raise wire.WireDecodeError("header length exceeds frame")
+            header = _recv_exact(conn, hlen)
+            consumed += hlen
+            body = bytearray(length - consumed)
+            _recv_into_exact(conn, body)
+            consumed = length
+            t_b = time.perf_counter()
+            src, tag, payload = wire.decode_frame(flags, hcrc, header, body)
+            return src, tag, payload, t_b, time.perf_counter()
+        except wire.WireDecodeError as e:
+            # a corrupted frame degrades exactly like a chaos `corrupt`
+            # fault: deliver a CorruptedPayload marker so the receiving
+            # role's malformed_dropped path absorbs it. Skip the rest of
+            # the frame first — the stream must stay length-synced.
+            if consumed < length:
+                _drain_exact(conn, length - consumed)
+            with self._byte_lock:
+                self._rx_corrupt_dropped += 1
+            t_b = time.perf_counter()
+            src = e.src if e.src is not None else -1
+            tag = e.tag if e.tag is not None else -1
+            return src, tag, CorruptedPayload(src=src, tag=tag), t_b, t_b
 
     def _dst_lock(self, dst: int):
         with self._out_cache_lock:
@@ -207,13 +373,30 @@ class SocketTransport(Transport):
             sock = self._out.get(dst)
         if sock is None:
             sock = self._connect_with_retry(dst)
+            framed_peer = False
+            if self._wire_format == "framed" and self._negotiate:
+                framed_peer = self._await_hello(sock)
             # back to blocking mode: a mid-frame timeout would desync the
             # length-prefixed stream for every later frame
             sock.settimeout(None)
             sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
             with self._out_cache_lock:
                 self._out[dst] = sock
+                self._peer_framed[dst] = framed_peer
         return sock
+
+    def _await_hello(self, sock: socket.socket) -> bool:
+        """Read the receiver's HELLO off a fresh outbound connection. A
+        legacy peer sends nothing — the timeout is the negative signal —
+        and nothing else ever arrives on this socket (frames only flow
+        inbound→listener), so the read cannot swallow real traffic."""
+        try:
+            sock.settimeout(self._hello_timeout)
+            data = _recv_exact(sock, wire.HELLO_SIZE)
+        except (ConnectionError, OSError):
+            return False
+        peer_version = wire.decode_hello(data)
+        return peer_version is not None and peer_version >= 1
 
     # transient connect failures retried within the window alongside a
     # clean refusal: real-DCN startup skew surfaces as timeouts and
@@ -246,6 +429,7 @@ class SocketTransport(Transport):
     def _evict(self, dst: int) -> None:
         with self._out_cache_lock:
             sock = self._out.pop(dst, None)
+            self._peer_framed.pop(dst, None)
         if sock is not None:
             try:
                 sock.close()
@@ -253,6 +437,7 @@ class SocketTransport(Transport):
                 pass
 
     def _write_frame(self, dst: int, frame: bytes) -> None:
+        """Write pre-serialized pickle bytes (legacy entry point)."""
         with self._dst_lock(dst):
             try:
                 self._connection(dst).sendall(frame)
@@ -263,6 +448,57 @@ class SocketTransport(Transport):
                 # the reader discards a connection on any partial frame.
                 self._evict(dst)
                 self._connection(dst).sendall(frame)
+        with self._byte_lock:
+            self._tx_wire_bytes += len(frame)
+
+    def _write_msg(self, dst: int, item: _OutMessage) -> int:
+        """Write one queued message in the best format the peer speaks;
+        returns exact bytes written. Called only from the dst's drainer."""
+        with self._dst_lock(dst):
+            try:
+                self._connection(dst)  # negotiates on a fresh connect
+                n = self._send_item(dst, item)
+            except (ConnectionError, OSError):
+                # stale cached socket (peer restarted): reconnect once,
+                # re-negotiating. Whole-message resend is safe — the
+                # receiver discards a connection on any partial frame, and
+                # the accept-order fence drops old-connection stragglers.
+                self._evict(dst)
+                self._connection(dst)
+                n = self._send_item(dst, item)
+        with self._byte_lock:
+            self._tx_wire_bytes += n
+        return n
+
+    def _send_item(self, dst: int, item: _OutMessage) -> int:
+        sock = self._out[dst]
+        if item.buffers is not None and self._peer_framed.get(dst):
+            return self._sendmsg_all(sock, item.framed_buffers())
+        frame = item.pickle_frame()
+        sock.sendall(frame)
+        return len(frame)
+
+    @staticmethod
+    def _sendmsg_all(sock: socket.socket, buffers: list) -> int:
+        """Vectorized write of the framed buffer list (writev semantics):
+        the kernel gathers header bytes + raw array views in one syscall
+        per batch — the arrays are never copied into a Python-level frame."""
+        bufs = [
+            b if isinstance(b, memoryview) else memoryview(b) for b in buffers
+        ]
+        total = sum(b.nbytes for b in bufs)
+        if not hasattr(sock, "sendmsg"):  # exotic platform fallback
+            for b in bufs:
+                sock.sendall(b)
+            return total
+        while bufs:
+            sent = sock.sendmsg(bufs[:_SENDMSG_MAX_BUFFERS])
+            while bufs and sent >= bufs[0].nbytes:
+                sent -= bufs[0].nbytes
+                bufs.pop(0)
+            if bufs and sent:
+                bufs[0] = bufs[0][sent:]  # partial write: advance in place
+        return total
 
     def _send_queue(self, dst: int) -> "_SendQueue":
         with self._out_cache_lock:
@@ -277,17 +513,23 @@ class SocketTransport(Transport):
         self.isend(dst, tag, payload).wait()
 
     def isend(self, dst: int, tag: int, payload: Any) -> SendHandle:
-        """Genuinely asynchronous: the frame (serialized NOW — the payload
-        is captured at call time, per MPI buffer semantics) is handed to the
-        dst's sender thread; the handle completes when it is written, with
-        its ``phases`` split (serialize / queue_wait / write) stamped."""
+        """Genuinely asynchronous: the frame (captured NOW — per MPI buffer
+        semantics the payload must not be mutated until the send completes)
+        is handed to the dst's sender thread; the handle completes when it
+        is written, with its ``phases`` split (serialize / queue_wait /
+        write) and exact ``wire_nbytes`` stamped. Framed encoding is
+        zero-copy (the buffers alias the payload's arrays); payloads the
+        codec cannot express — and all traffic to pickle-only peers — ride
+        the pickle fallback."""
         t0 = time.perf_counter()
-        blob = pickle.dumps(
-            (self.rank, tag, payload), protocol=WIRE_PICKLE_PROTOCOL
-        )
+        buffers = None
+        if self._wire_format == "framed":
+            buffers = wire.encode_frame(
+                self.rank, tag, payload, version=WIRE_FORMAT_VERSION
+            )
+        item = _OutMessage(self.rank, tag, payload, buffers)
         serialize_s = time.perf_counter() - t0
-        frame = _LEN.pack(len(blob)) + blob
-        return self._send_queue(dst).enqueue(frame, serialize_s=serialize_s)
+        return self._send_queue(dst).enqueue(item, serialize_s=serialize_s)
 
     def rx_phases(self) -> dict:
         """Snapshot of inbound phase seconds per ``"src:tag"`` stream
@@ -298,6 +540,16 @@ class SocketTransport(Transport):
                 for (src, tag), v in sorted(self._rx_phases.items())
             }
 
+    def wire_byte_counts(self) -> dict:
+        """Exact socket-level byte totals: {"tx", "rx", "rx_corrupt_dropped"}.
+        Ground truth for the obs-summary == socket-bytes assertion."""
+        with self._byte_lock:
+            return {
+                "tx": self._tx_wire_bytes,
+                "rx": self._rx_wire_bytes,
+                "rx_corrupt_dropped": self._rx_corrupt_dropped,
+            }
+
     def recv(
         self,
         src: int = ANY_SOURCE,
@@ -305,7 +557,13 @@ class SocketTransport(Transport):
         timeout: Optional[float] = None,
     ) -> Message:
         msg = self._mailbox.get(0, src, tag, timeout)
-        return Message(src=msg.src, dst=self.rank, tag=msg.tag, payload=msg.payload)
+        return Message(
+            src=msg.src,
+            dst=self.rank,
+            tag=msg.tag,
+            payload=msg.payload,
+            wire_nbytes=msg.wire_nbytes,
+        )
 
     def probe(
         self,
@@ -337,11 +595,11 @@ class SocketTransport(Transport):
 
 
 class _SendQueue:
-    """One destination's outbound frame queue + its sender thread.
+    """One destination's outbound message queue + its sender thread.
 
     FIFO by construction (single drainer), which is what lets send() and
     isend() interleave without breaking MPI's per-(src, dst, tag) order
-    guarantee. Write errors are parked on the frame's SendHandle — a sync
+    guarantee. Write errors are parked on the message's SendHandle — a sync
     send() re-raises them from wait(); a fire-and-forget isend keeps them
     inspectable instead of crashing a daemon thread."""
 
@@ -349,11 +607,11 @@ class _SendQueue:
         self._transport = transport
         self._dst = dst
         self._cond = threading.Condition()
-        # deque: the drainer pops from the front on every frame — a list's
+        # deque: the drainer pops from the front on every message — a list's
         # pop(0) is O(n) and melts under backlog (a slow peer + isend burst)
-        # items are (frame, handle, enqueue perf_counter) — the timestamp
+        # items are (msg, handle, enqueue perf_counter) — the timestamp
         # is what turns into the handle's queue_wait phase on dequeue
-        self._items: collections.deque[tuple[bytes, SendHandle, float]] = (
+        self._items: collections.deque[tuple[_OutMessage, SendHandle, float]] = (
             collections.deque()
         )
         self._stopped = False
@@ -364,14 +622,14 @@ class _SendQueue:
         )
         self._thread.start()
 
-    def enqueue(self, frame: bytes, serialize_s: float = 0.0) -> SendHandle:
+    def enqueue(self, item: _OutMessage, serialize_s: float = 0.0) -> SendHandle:
         h = SendHandle()
         h.phases = {"serialize": serialize_s}
         with self._cond:
             if self._stopped:
                 h.set_error(ConnectionError("transport closed"))
                 return h
-            self._items.append((frame, h, time.perf_counter()))
+            self._items.append((item, h, time.perf_counter()))
             self._cond.notify()
         return h
 
@@ -381,7 +639,7 @@ class _SendQueue:
             pending = self._items
             self._items = collections.deque()
             self._cond.notify()
-        for _frame, h, _enq_t in pending:
+        for _item, h, _enq_t in pending:
             h.set_error(ConnectionError("transport closed with send pending"))
 
     def _drain(self) -> None:
@@ -391,17 +649,18 @@ class _SendQueue:
                     self._cond.wait()
                 if self._stopped and not self._items:
                     return
-                frame, h, enq_t = self._items.popleft()
+                item, h, enq_t = self._items.popleft()
             # queue_wait is the socket-wait phase a sync send() spends
-            # behind earlier frames to the same dst; write is the payload
+            # behind earlier messages to the same dst; write is the payload
             # transfer into the kernel. Stamped BEFORE set_done so a
             # waiter observing done() always sees the full split.
             t_w = time.perf_counter()
             try:
-                self._transport._write_frame(self._dst, frame)
+                nbytes = self._transport._write_msg(self._dst, item)
             except BaseException as e:
                 h.set_error(e)
             else:
                 h.phases["queue_wait"] = t_w - enq_t
                 h.phases["write"] = time.perf_counter() - t_w
+                h.wire_nbytes = nbytes
                 h.set_done()

@@ -1,0 +1,15 @@
+"""Seeded MPT007 (frame-version variant): a frame writer at a marked
+wire boundary that hard-codes ``version=`` instead of naming the
+canonical ``WIRE_FORMAT_VERSION`` constant from ``transport/wire.py``.
+A literal that equals the canonical value TODAY is still drift waiting
+to happen — a bump of the constant would silently strand this site.
+This file is parsed by the linter tests, never imported or executed.
+"""
+
+from mpit_tpu.transport import wire
+
+# mpit-analysis: wire-boundary
+
+
+def frame(payload):
+    return wire.encode_frame(0, 2, payload, version=1)  # not by name

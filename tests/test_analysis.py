@@ -93,6 +93,7 @@ def test_baseline_is_not_stale():
         # rule EXACTLY ONCE — the pairing/resolution around the one seeded
         # defect has to come out clean
         ("fixture_mpt007.py", "MPT007"),
+        ("fixture_mpt007_frame.py", "MPT007"),
         ("fixture_mpt012.py", "MPT012"),
         ("fixture_mpt008", "MPT008"),
         ("fixture_mpt004_chain", "MPT004"),
@@ -392,6 +393,112 @@ def test_mpt007_config_override(tmp_path):
     )
     assert [f.rule for f in findings] == ["MPT007"]
     assert "drift" in findings[0].message
+
+
+# ------------------------------------------- MPT007 (binary frame version)
+
+_FRAMED = (
+    "# mpit-analysis: wire-boundary\n"
+    "from mpit_tpu.transport import wire\n"
+)
+
+
+def test_mpt007_frame_missing_version(tmp_path):
+    findings = _lint_source(
+        tmp_path,
+        _FRAMED + "def f(x):\n    return wire.encode_frame(0, 2, x)\n",
+    )
+    assert [f.rule for f in findings] == ["MPT007"]
+    assert "without version=" in findings[0].message
+
+
+def test_mpt007_frame_matching_literal_still_flagged(tmp_path):
+    """version=1 equals WIRE_FORMAT_VERSION today; the named constant is
+    still required — the same stranding argument as the pickle side."""
+    findings = _lint_source(
+        tmp_path,
+        _FRAMED
+        + "def f(x):\n    return wire.encode_frame(0, 2, x, version=1)\n",
+    )
+    assert [f.rule for f in findings] == ["MPT007"]
+    assert "hard-codes" in findings[0].message
+    assert "use WIRE_FORMAT_VERSION itself" in findings[0].message
+
+
+def test_mpt007_frame_drifted_literal(tmp_path):
+    findings = _lint_source(
+        tmp_path,
+        _FRAMED
+        + "def f(x):\n    return wire.encode_frame(0, 2, x, version=9)\n",
+    )
+    assert [f.rule for f in findings] == ["MPT007"]
+    assert "drift" in findings[0].message
+
+
+def test_mpt007_frame_named_constant_pin_clean(tmp_path):
+    """Every import spelling of the canonical pin comes out clean — the
+    exact shapes the transport package uses."""
+    for src in (
+        _FRAMED
+        + "WIRE_FORMAT_VERSION = 1\n"
+        "def f(x):\n"
+        "    return wire.encode_frame(0, 2, x, "
+        "version=WIRE_FORMAT_VERSION)\n",
+        _FRAMED
+        + "def f(x):\n"
+        "    return wire.encode_frame(0, 2, x, "
+        "version=wire.WIRE_FORMAT_VERSION)\n",
+        "# mpit-analysis: wire-boundary\n"
+        "from mpit_tpu.transport.wire import encode_frame\n"
+        "WIRE_FORMAT_VERSION = 1\n"
+        "def f(x):\n"
+        "    return encode_frame(0, 2, x, version=WIRE_FORMAT_VERSION)\n",
+    ):
+        findings = _lint_source(tmp_path, src)
+        assert findings == [], [f.format() for f in findings]
+
+
+def test_mpt007_frame_wrong_valued_name_is_drift(tmp_path):
+    findings = _lint_source(
+        tmp_path,
+        _FRAMED
+        + "MY_VER = 3\n"
+        "def f(x):\n"
+        "    return wire.encode_frame(0, 2, x, version=MY_VER)\n",
+    )
+    assert [f.rule for f in findings] == ["MPT007"]
+    assert "resolves to 3" in findings[0].message
+
+
+def test_mpt007_frame_config_override(tmp_path):
+    """An overridden canonical frame version re-anchors the check
+    independently of the pickle side."""
+    cfg = lint.Config(hot_all=True, wire_format_version=2)
+    findings = _lint_source(
+        tmp_path,
+        _FRAMED
+        + "def f(x):\n    return wire.encode_frame(0, 2, x, version=1)\n",
+        cfg,
+    )
+    assert [f.rule for f in findings] == ["MPT007"]
+    assert "drift" in findings[0].message
+
+
+def test_mpt007_frame_decode_and_unmarked_out_of_scope(tmp_path):
+    # readers dispatch on the preamble's version byte — nothing to pin
+    findings = _lint_source(
+        tmp_path,
+        _FRAMED + "def f(h, c, b):\n"
+        "    return wire.decode_frame(0, h, c, b)\n",
+    )
+    assert findings == []
+    # no marker, no transport/ path component: not a wire boundary
+    findings = _lint_source(
+        tmp_path,
+        "from mpit_tpu.transport import wire\n"
+        "def f(x):\n    return wire.encode_frame(0, 2, x, version=9)\n",
+    )
+    assert findings == []
 
 
 # --------------------------------------------------------- MPT012 (metrics)

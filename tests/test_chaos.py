@@ -577,3 +577,115 @@ class TestCorruptTruncate:
         # applied <= sent, and every gap is accounted for by a mangle
         assert counts["push_easgd"] <= sent
         assert sent - counts["push_easgd"] <= sum(faults.values())
+
+
+class TestFramedChaos:
+    """Chaos faults against the binary wire format (docs/WIRE.md): the
+    payload-object mangling happens above the codec, so framed messages
+    degrade through the SAME counters as pickle ones, quantized chunks
+    truncate like raw arrays, and arming quantization adds zero RNG
+    draws — old seeds replay bit-identically."""
+
+    def test_truncate_cuts_quantized_chunk_keeps_envelope(self):
+        from mpit_tpu.transport.chaos import _truncate_payload
+        from mpit_tpu.transport.wire import QuantArray, quantize
+
+        q = quantize(np.arange(10, dtype=np.float32), "int8")
+        env = (1 << 70, 3, 0, q)
+        cut = _truncate_payload(env)
+        assert cut[0] == 1 << 70 and cut[1] == 3
+        assert isinstance(cut[3], QuantArray)
+        assert cut[3].mode == "int8" and cut[3].scale == q.scale
+        assert len(cut[3].data) == 5
+        # a scalar-only QuantArray-free envelope still degrades to None
+        assert _truncate_payload((1, 2, 3)) is None
+
+    def test_truncated_quantized_push_dropped_as_malformed(self):
+        # the dequantized wrong-length chunk must fail shape validation
+        # BEFORE the dedup admit — same path as a truncated raw push
+        cfg = ChaosConfig(scripted={(1, 0, TAG_PUSH_EASGD, 0): "truncate"})
+        tps, server, thread, log = _ps_world("client", cfg)
+        client = PClient(
+            tps[1], [0], DIM, timeout=1.0, backoff_base=0.01,
+            quant="int8",
+        )
+        client.push_easgd(np.ones(DIM, np.float32))  # arrives half-length
+        client.push_easgd(np.ones(DIM, np.float32))  # clean
+        client.fetch()  # FIFO barrier
+        client.stop()
+        thread.join(timeout=5)
+        assert server.error is None
+        assert server.counts["malformed_dropped"] == 1
+        assert server.counts["push_easgd"] == 1
+        assert server.counts["dup_dropped"] == 0
+
+    def test_corrupt_param_with_quant_retries(self):
+        cfg = ChaosConfig(scripted={(0, 1, TAG_PARAM, 0): "corrupt"})
+        tps, server, thread, log = _ps_world(
+            "server", cfg, center=5.0, quant="int8"
+        )
+        client = PClient(
+            tps[1], [0], DIM, timeout=0.3, max_retries=2,
+            backoff_base=0.01, quant="int8",
+        )
+        out = client.fetch()
+        np.testing.assert_allclose(
+            out, np.full(DIM, 5.0, np.float32), rtol=1e-2
+        )
+        assert client.corrupt_params_dropped == 1
+        client.stop()
+        thread.join(timeout=5)
+        assert server.error is None
+
+    def test_quant_payloads_do_not_shift_fault_schedule(self):
+        """Replay contract: the fault schedule is a function of (seed,
+        src, dst, tag, n) only — swapping payloads from raw arrays to
+        QuantArrays (or ints) must reproduce the exact event stream."""
+        from mpit_tpu.transport.wire import quantize
+
+        cfg = ChaosConfig(
+            seed=17, drop=0.2, duplicate=0.2, corrupt=0.2, truncate=0.2,
+        )
+
+        def run(payload_of):
+            tps = Broker(2).transports()
+            chaos = ChaosTransport(tps[0], cfg)
+            for tag in (3, 5):
+                for i in range(120):
+                    try:
+                        chaos.send(1, tag, payload_of(i))
+                    except ConnectionError:
+                        pass
+            return chaos.log.events()
+
+        raw = run(lambda i: (i, np.arange(8, dtype=np.float32)))
+        quant = run(
+            lambda i: (
+                i, quantize(np.arange(8, dtype=np.float32), "int8")
+            )
+        )
+        ints = run(lambda i: i)
+        assert raw == quant == ints
+
+    def test_corrupt_over_framed_socket_delivered(self):
+        """Chaos sits above the codec: a CorruptedPayload is unencodable,
+        so the framed transport pickles it — delivery (and the receiver's
+        drop accounting) is format-independent."""
+        from mpit_tpu.transport import CorruptedPayload
+
+        base_port = 29_885
+        a = SocketTransport(0, 2, base_port=base_port, wire_format="framed")
+        b = SocketTransport(1, 2, base_port=base_port, wire_format="framed")
+        chaos = ChaosTransport(
+            a, ChaosConfig(scripted={(0, 1, 7, 0): "corrupt"})
+        )
+        try:
+            chaos.send(1, 7, (1, 2, np.ones(4, np.float32)))
+            chaos.send(1, 7, (3, 4, np.ones(4, np.float32)))
+            first = b.recv(0, 7, timeout=10)
+            assert isinstance(first.payload, CorruptedPayload)
+            second = b.recv(0, 7, timeout=10)
+            assert second.payload[0] == 3
+        finally:
+            a.close()
+            b.close()

@@ -395,6 +395,69 @@ class TestWirePhases:
             t.obs_tracer.close()
 
 
+def _wire_echo_child(rank, size, base_port, n, q):
+    try:
+        tp = SocketTransport(rank, size, base_port=base_port)
+        for _ in range(n):
+            msg = tp.recv(src=0, tag=7, timeout=30)
+            tp.send(0, 8, msg.payload)
+        tp.recv(src=0, tag=9, timeout=30)  # stop marker
+        q.put(("ok",))
+        tp.close()
+    except BaseException as e:
+        q.put(("err", repr(e)))
+
+
+class TestExactWireBytes:
+    def test_summary_bytes_equal_socket_bytes_two_process(self, tmp_path):
+        """The fast-wire byte-accounting contract (docs/WIRE.md): with a
+        real peer in ANOTHER process, the telemetry summary's per-stream
+        byte totals equal the socket layer's own tx/rx counters exactly —
+        the summary reports on-wire frame lengths (length prefix
+        included), not payload estimates."""
+        import multiprocessing
+
+        ctx = multiprocessing.get_context("spawn")
+        q = ctx.Queue()
+        base_port = 29_971
+        n = 5
+        child = ctx.Process(
+            target=_wire_echo_child,
+            args=(1, 2, base_port, n, q),
+            daemon=True,
+        )
+        child.start()
+        cfg = ObsConfig(dir=str(tmp_path))
+        raw = SocketTransport(0, 2, base_port=base_port)
+        tp = maybe_wrap(raw, cfg)
+        # mixed traffic: framed envelopes AND a pickle-fallback dict
+        envelope = (1 << 70, 3, 0, np.arange(2048, dtype=np.float32))
+        deadline = time.monotonic() + 20
+        while True:  # child may not be listening yet
+            try:
+                tp.send(1, 7, envelope)
+                break
+            except (ConnectionError, OSError):
+                if time.monotonic() > deadline:
+                    raise
+                time.sleep(0.1)
+        for _ in range(n - 2):
+            tp.send(1, 7, envelope)
+        tp.send(1, 7, {"pickle": "fallback"})
+        for _ in range(n):
+            tp.recv(1, 8, timeout=30)
+        tp.send(1, 9, None)
+        assert q.get(timeout=30)[0] == "ok"
+        child.join(timeout=10)
+        s = tp.summary()
+        counts = raw.wire_byte_counts()
+        tx = sum(v["bytes"] for v in s["send"].values())
+        rx = sum(v["bytes"] for v in s["recv"].values())
+        assert tx == counts["tx"] > 0
+        assert rx == counts["rx"] > 0
+        tp.close()
+
+
 class TestMerge:
     def _write_rank(self, tmp_path, rank, events):
         j = Journal(str(tmp_path / f"obs_rank{rank}.jsonl"), rank)

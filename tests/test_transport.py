@@ -164,6 +164,115 @@ class TestSocket:
         rx.close()
 
 
+class TestFramedSocket:
+    """The binary wire format (docs/WIRE.md): framed peers exchange
+    zero-copy frames, unencodable payloads fall back to pickle on the
+    same connection, byte accounting is exact, and a corrupted frame
+    degrades to a CorruptedPayload without desyncing the stream."""
+
+    def _pair(self, base_port, **kw):
+        a = SocketTransport(0, 2, base_port=base_port, **kw)
+        b = SocketTransport(1, 2, base_port=base_port, **kw)
+        return a, b
+
+    def test_framed_roundtrip_and_pickle_fallback(self):
+        from mpit_tpu.transport.wire import QuantArray, quantize
+
+        a, b = self._pair(29_871, wire_format="framed")
+        try:
+            arr = np.arange(512, dtype=np.float32)
+            a.send(1, 2, (1 << 70, 5, 0, arr))  # framed: PS push shape
+            got = b.recv(0, 2, timeout=10).payload
+            assert got[0] == 1 << 70
+            np.testing.assert_array_equal(got[3], arr)
+            # dicts aren't in the structural codec: same connection,
+            # pickle frame, still delivered (format detected per frame)
+            a.send(1, 3, {"k": "v"})
+            assert b.recv(0, 3, timeout=10).payload == {"k": "v"}
+            q = quantize(arr, "int8")
+            a.send(1, 4, q)
+            got = b.recv(0, 4, timeout=10).payload
+            assert isinstance(got, QuantArray) and got.mode == "int8"
+            np.testing.assert_array_equal(got.data, q.data)
+        finally:
+            a.close()
+            b.close()
+
+    def test_exact_byte_accounting_both_formats(self):
+        for fmt, port in (("framed", 29_873), ("pickle", 29_875)):
+            a, b = self._pair(port, wire_format=fmt)
+            try:
+                payload = (7, 1, 0, np.ones(1000, np.float32))
+                h = a.isend(1, 2, payload)
+                assert h.wait(10)
+                msg = b.recv(0, 2, timeout=10)
+                # sender's handle and receiver's message agree on the
+                # exact on-wire length of THIS message
+                assert h.wire_nbytes is not None
+                assert h.wire_nbytes == msg.wire_nbytes
+                b.send(0, 3, "ack")
+                a.recv(1, 3, timeout=10)
+                # and the directional totals agree socket-to-socket
+                ca, cb = a.wire_byte_counts(), b.wire_byte_counts()
+                assert ca["tx"] == cb["rx"] > 0
+                assert cb["tx"] == ca["rx"] > 0
+                assert ca["rx_corrupt_dropped"] == 0
+            finally:
+                a.close()
+                b.close()
+
+    def test_framed_smaller_than_pickle_for_arrays(self):
+        sizes = {}
+        for fmt, port in (("framed", 29_877), ("pickle", 29_879)):
+            a, b = self._pair(port, wire_format=fmt)
+            try:
+                a.send(1, 2, (1, 1, 0, np.zeros(4096, np.float32)))
+                sizes[fmt] = b.recv(0, 2, timeout=10).wire_nbytes
+            finally:
+                a.close()
+                b.close()
+        assert sizes["framed"] < sizes["pickle"]
+
+    def test_corrupt_frame_degrades_and_stream_resyncs(self):
+        """A framed body that fails decode must surface as a
+        CorruptedPayload on the right stream AND leave the connection
+        length-synced — the next frame decodes normally."""
+        import socket as skt
+        import struct
+
+        from mpit_tpu.transport import CorruptedPayload
+        from mpit_tpu.transport import wire as w
+
+        b = SocketTransport(1, 2, base_port=29_881, wire_format="framed")
+        try:
+            raw = skt.create_connection(b._addrs[1], timeout=10)
+            raw.recv(w.HELLO_SIZE)  # the receiver's hello advertisement
+            bufs = w.encode_frame(
+                0, 6, (1, 2, np.arange(16, dtype=np.float32)),
+                version=w.WIRE_FORMAT_VERSION,
+            )
+            frame = bytes(bufs[0]) + b"".join(bytes(x) for x in bufs[1:])
+            # flip one structural-header bit -> CRC check must fail
+            bad = bytearray(frame)
+            bad[w.PREAMBLE_SIZE + 2] ^= 0x10
+            raw.sendall(struct.pack(">Q", len(bad)) + bytes(bad))
+            raw.sendall(struct.pack(">Q", len(frame)) + frame)
+            first = b.recv(timeout=10)
+            assert isinstance(first.payload, CorruptedPayload)
+            second = b.recv(timeout=10)
+            np.testing.assert_array_equal(
+                second.payload[2], np.arange(16, dtype=np.float32)
+            )
+            assert b.wire_byte_counts()["rx_corrupt_dropped"] == 1
+            raw.close()
+        finally:
+            b.close()
+
+    def test_wire_format_validation(self):
+        with pytest.raises(ValueError, match="wire_format"):
+            SocketTransport(0, 1, base_port=29_883, wire_format="cbor")
+
+
 class TestProbeAndIsend:
     """mpiT L2 parity items from round-1 verdict #9: MPI_Probe blocks;
     Isend genuinely overlaps."""

@@ -1,0 +1,432 @@
+"""Fast-wire tests (docs/WIRE.md): the framed codec's structural
+roundtrips and integrity checks, quantization + error feedback, the
+coalesced scatter, and mixed-version (framed vs pickle-only) peers
+completing real EASGD exchanges over sockets."""
+
+import os
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from mpit_tpu.parallel.pclient import PClient
+from mpit_tpu.parallel.pserver import (
+    TAG_PUSH_EASGD,
+    PServer,
+    spawn_server_thread,
+)
+from mpit_tpu.transport import Broker, SocketTransport
+from mpit_tpu.transport import wire
+from mpit_tpu.transport.wire import (
+    WIRE_FORMAT_VERSION,
+    QuantArray,
+    WireDecodeError,
+    dequantize,
+    quantize,
+)
+
+DIM = 16
+
+
+def _roundtrip(payload, src=3, tag=2):
+    """encode → (simulated wire) → decode, returning (src, tag, payload).
+    Joins the zero-copy buffer list the way the socket writes it."""
+    bufs = wire.encode_frame(src, tag, payload, version=WIRE_FORMAT_VERSION)
+    assert bufs is not None
+    head = bytes(bufs[0])
+    body = b"".join(bytes(b) for b in bufs[1:])
+    version, flags, hlen, hcrc = wire.split_preamble(
+        head[: wire.PREAMBLE_SIZE]
+    )
+    assert version == WIRE_FORMAT_VERSION
+    assert hlen == len(head) - wire.PREAMBLE_SIZE
+    return wire.decode_frame(flags, hcrc, head[wire.PREAMBLE_SIZE:], body)
+
+
+class TestCodec:
+    def test_structural_roundtrip(self):
+        payload = (
+            None, True, False, 0, -17, 3.25, "τ-steps", b"\x00\xff",
+            ["a", (1, 2.0, None)], [],
+        )
+        src, tag, out = _roundtrip(payload, src=5, tag=9)
+        assert (src, tag) == (5, 9)
+        assert out == payload
+
+    def test_epoch_int_wider_than_u64(self):
+        # client epochs come from os.urandom(8) and CAN exceed a signed
+        # 64-bit slot; arbitrary-width magnitudes are part of the format
+        for v in (2 ** 63, 2 ** 80 + 13, -(2 ** 70), 2 ** 64 - 1):
+            assert _roundtrip((v, 1, 0, None))[2][0] == v
+
+    def test_ndarray_roundtrip_and_views(self):
+        arr = np.arange(24, dtype=np.float32).reshape(2, 3, 4)
+        bufs = wire.encode_frame(
+            0, 2, arr, version=WIRE_FORMAT_VERSION
+        )
+        # send side is zero-copy: the body buffer aliases the input array
+        assert isinstance(bufs[1], memoryview)
+        assert bufs[1].obj is arr.data.obj or np.shares_memory(
+            np.frombuffer(bufs[1], dtype=np.float32).reshape(arr.shape),
+            arr,
+        )
+        _, _, out = _roundtrip(arr)
+        np.testing.assert_array_equal(out, arr)
+        assert out.dtype == arr.dtype and out.shape == arr.shape
+        # recv side is zero-copy: the decoded array is a view into the
+        # body buffer, not a fresh allocation
+        assert not out.flags.owndata
+
+    def test_every_registered_dtype_roundtrips(self):
+        for dtype in (
+            np.float32, np.float64, np.float16, np.int64, np.int32,
+            np.int16, np.int8, np.uint8, np.uint16, np.uint32,
+            np.uint64, np.bool_,
+        ):
+            arr = np.zeros(5, dtype=dtype)
+            arr[1] = 1
+            _, _, out = _roundtrip(arr)
+            assert out.dtype == np.dtype(dtype)
+            np.testing.assert_array_equal(out, arr)
+
+    def test_unencodable_returns_none_for_pickle_fallback(self):
+        from mpit_tpu.transport.chaos import CorruptedPayload
+
+        for payload in (
+            object(), {"a": 1}, np.float32(1.5), CorruptedPayload(),
+            (1, 2, {3}),
+        ):
+            assert wire.encode_frame(
+                0, 1, payload, version=WIRE_FORMAT_VERSION
+            ) is None
+
+    def test_header_crc_flip_raises(self):
+        bufs = wire.encode_frame(
+            1, 2, (1, 2, np.ones(4, np.float32)),
+            version=WIRE_FORMAT_VERSION,
+        )
+        head = bytearray(bytes(bufs[0]))
+        body = b"".join(bytes(b) for b in bufs[1:])
+        head[wire.PREAMBLE_SIZE] ^= 0x40  # flip a structural header bit
+        _, flags, _, hcrc = wire.split_preamble(
+            bytes(head[: wire.PREAMBLE_SIZE])
+        )
+        with pytest.raises(WireDecodeError, match="CRC"):
+            wire.decode_frame(
+                flags, hcrc, bytes(head[wire.PREAMBLE_SIZE:]), body
+            )
+
+    def test_body_length_mismatch_carries_src_tag(self):
+        arr = np.ones(8, np.float32)
+        bufs = wire.encode_frame(
+            7, 4, (1, 2, arr), version=WIRE_FORMAT_VERSION
+        )
+        head = bytes(bufs[0])
+        body = b"".join(bytes(b) for b in bufs[1:])
+        _, flags, _, hcrc = wire.split_preamble(head[: wire.PREAMBLE_SIZE])
+        with pytest.raises(WireDecodeError) as ei:
+            wire.decode_frame(
+                flags, hcrc, head[wire.PREAMBLE_SIZE:], body[:-4]
+            )
+        # src/tag decoded before the body check: the transport can still
+        # route a corruption marker to the right (src, tag) stream
+        assert ei.value.src == 7 and ei.value.tag == 4
+        with pytest.raises(WireDecodeError, match="mismatch"):
+            wire.decode_frame(
+                flags, hcrc, head[wire.PREAMBLE_SIZE:], body + b"xx"
+            )
+
+    def test_future_version_rejected(self):
+        bufs = wire.encode_frame(
+            0, 1, None, version=WIRE_FORMAT_VERSION + 1
+        )
+        with pytest.raises(WireDecodeError, match="newer"):
+            wire.split_preamble(bytes(bufs[0])[: wire.PREAMBLE_SIZE])
+        with pytest.raises(ValueError, match="out of range"):
+            wire.encode_frame(0, 1, None, version=300)
+
+    def test_no_magic_collision_with_pickle(self):
+        # per-frame dispatch depends on it: a protocol>=2 pickle always
+        # starts 0x80, a framed body always starts b"MW"
+        import pickle
+
+        assert wire.MAGIC[0:1] != pickle.dumps(None, protocol=5)[0:1]
+        assert wire.MAGIC == b"MW"
+
+    def test_hello_roundtrip_and_rejects_garbage(self):
+        assert wire.decode_hello(wire.encode_hello()) == (
+            WIRE_FORMAT_VERSION
+        )
+        assert wire.decode_hello(b"") is None
+        assert wire.decode_hello(b"\x80\x05x") is None
+        assert wire.decode_hello(b"MWX\x01") is None
+
+    def test_frame_nbytes_counts_whole_body(self):
+        arr = np.ones(10, np.float32)
+        bufs = wire.encode_frame(
+            0, 2, arr, version=WIRE_FORMAT_VERSION
+        )
+        joined = bytes(bufs[0]) + b"".join(bytes(b) for b in bufs[1:])
+        assert wire.frame_nbytes(bufs) == len(joined)
+
+
+class TestQuantization:
+    def test_bf16_roundtrip_precision(self):
+        rng = np.random.default_rng(0)
+        a = rng.standard_normal(4096).astype(np.float32) * 100
+        out = dequantize(quantize(a, "bf16"))
+        # bf16 keeps 8 mantissa bits: relative error < 2^-8 after RNE
+        nz = np.abs(a) > 0
+        assert np.max(np.abs(out[nz] - a[nz]) / np.abs(a[nz])) < 2 ** -8
+
+    def test_int8_symmetric_absmax(self):
+        a = np.array([-4.0, -1.0, 0.0, 2.0, 4.0], np.float32)
+        q = quantize(a, "int8")
+        assert q.mode == "int8" and q.data.dtype == np.int8
+        assert q.scale == pytest.approx(4.0 / 127.0)
+        out = dequantize(q)
+        assert np.max(np.abs(out - a)) <= q.scale / 2 + 1e-7
+        # all-zero chunk must not divide by zero
+        z = quantize(np.zeros(3, np.float32), "int8")
+        np.testing.assert_array_equal(dequantize(z), np.zeros(3))
+
+    def test_quant_array_over_the_wire(self):
+        a = np.linspace(-1, 1, 64, dtype=np.float32)
+        q = quantize(a, "int8")
+        _, _, out = _roundtrip((123, 4, 0, q))
+        got = out[3]
+        assert isinstance(got, QuantArray)
+        assert got.mode == "int8" and got.scale == pytest.approx(q.scale)
+        np.testing.assert_allclose(
+            dequantize(got), a, atol=q.scale / 2 + 1e-7
+        )
+
+    def test_error_feedback_cancels_quantizer_bias(self):
+        # EF contract (docs/WIRE.md): residual carried into the next
+        # push makes the MEAN of dequantized pushes converge to the true
+        # vector far beyond one push's quantization error
+        rng = np.random.default_rng(3)
+        target = rng.standard_normal(256).astype(np.float32)
+        res = np.zeros_like(target)
+        acc = np.zeros_like(target)
+        n = 50
+        for _ in range(n):
+            comp = target + res
+            q = quantize(comp, "int8")
+            deq = dequantize(q)
+            res = comp - deq
+            acc += deq
+        one_shot = np.mean(
+            np.abs(dequantize(quantize(target, "int8")) - target)
+        )
+        ef_err = np.mean(np.abs(acc / n - target))
+        assert ef_err < one_shot / 10
+
+    def test_env_readers_validate(self, monkeypatch):
+        assert wire.wire_format_from_env({}) == "framed"
+        assert wire.quant_mode_from_env({}) == "off"
+        assert wire.negotiate_enabled_from_env({}) is True
+        assert wire.negotiate_enabled_from_env(
+            {"MPIT_WIRE_NEGOTIATE": "0"}
+        ) is False
+        assert wire.negotiate_timeout_from_env(
+            {"MPIT_WIRE_NEGOTIATE_TIMEOUT_S": "0.25"}
+        ) == 0.25
+        with pytest.raises(ValueError, match="MPIT_WIRE_FORMAT"):
+            wire.wire_format_from_env({"MPIT_WIRE_FORMAT": "msgpack"})
+        with pytest.raises(ValueError, match="MPIT_WIRE_QUANT"):
+            wire.quant_mode_from_env({"MPIT_WIRE_QUANT": "fp4"})
+        with pytest.raises(ValueError, match="quant"):
+            PClient(Broker(2).transports()[1], [0], DIM, quant="fp4")
+
+
+class TestCoalescedScatter:
+    def _world(self, center=0.0, **server_kw):
+        tps = Broker(2).transports()
+        server = PServer(
+            tps[0], np.full(DIM, center, np.float32), num_clients=1,
+            **server_kw,
+        )
+        thread = spawn_server_thread(server)
+        return tps, server, thread
+
+    def test_repeated_rank_coalesces_to_one_message(self):
+        tps, server, thread = self._world()
+        # one server owning two adjacent chunks: the classic sharded
+        # layout collapsed onto one rank — chunks must merge
+        client = PClient(tps[1], [0, 0], DIM, timeout=5)
+        assert client.ranks == [0]
+        assert client.rank_bounds == [(0, DIM)]
+        client.push_easgd(np.ones(DIM, np.float32))
+        out = client.fetch()  # FIFO barrier: the push has been applied
+        assert out.shape == (DIM,)
+        # ONE push message and ONE fetch round trip, not two of each
+        assert server.counts["push_easgd"] == 1
+        assert server.counts["fetch"] == 1
+        client.stop()
+        thread.join(timeout=5)
+        assert server.error is None
+
+    def test_non_adjacent_repeat_rejected(self):
+        tps = Broker(3).transports()
+        with pytest.raises(ValueError, match="non-adjacent"):
+            PClient(tps[2], [0, 1, 0], 12)
+
+    def test_dedup_holds_across_coalesced_envelope(self):
+        tps, server, thread = self._world()
+        client = PClient(tps[1], [0, 0], DIM, timeout=5)
+        flat = np.ones(DIM, np.float32)
+        client.push_easgd(flat)
+        # a retry re-offers the identical coalesced envelope (same epoch,
+        # same seq, the full merged chunk) — replay it verbatim
+        tps[1].send(
+            0, TAG_PUSH_EASGD, (client._epoch, 1, 0, flat)
+        )
+        client.fetch()  # FIFO barrier
+        assert server.counts["push_easgd"] == 1
+        assert server.counts["dup_dropped"] == 1
+        client.stop()
+        thread.join(timeout=5)
+        assert server.error is None
+
+    def test_multi_chunk_param_reply_concatenates(self):
+        # a sharded server may answer one coalesced FETCH with its
+        # per-shard chunks in a single message: list-of-parts replies
+        # reassemble (mixing raw and quantized parts)
+        tps = Broker(2).transports()
+        client = PClient(tps[1], [0], 12, timeout=5)
+        a = np.arange(8, dtype=np.float32)
+        b = np.arange(8, 12, dtype=np.float32)
+        whole = np.concatenate([a, b])
+        assert np.array_equal(client._chunk_ok([a, b], 12), whole)
+        got = client._chunk_ok([a, quantize(b, "bf16")], 12)
+        np.testing.assert_allclose(got, whole, rtol=2 ** -8)
+        # malformed lists are rejected, not crashed on
+        assert client._chunk_ok([], 12) is None
+        assert client._chunk_ok([a], 12) is None
+
+
+class TestQuantizedExchange:
+    def test_int8_easgd_with_ef_converges(self):
+        tps = Broker(2).transports()
+        server = PServer(
+            tps[0], np.zeros(DIM, np.float32), num_clients=1,
+            alpha=0.5, quant="int8",
+        )
+        thread = spawn_server_thread(server)
+        client = PClient(tps[1], [0], DIM, timeout=5, quant="int8")
+        rng = np.random.default_rng(11)
+        target = rng.standard_normal(DIM).astype(np.float32)
+        for _ in range(60):
+            center = client.fetch()  # quantized PARAM reply, dequantized
+            client.push_easgd(target)
+        # without EF the int8 push bias would floor the center error near
+        # the quantization step; with it the TRUE center converges well
+        # inside it (the fetch view adds one un-fed-back snapshot
+        # quantization, so it is only step-accurate)
+        snap = server.snapshot()
+        step = float(np.max(np.abs(snap))) / 127.0
+        err = float(np.max(np.abs(snap - target)))
+        assert err < step / 2, (err, step)
+        fetch_err = float(np.max(np.abs(client.fetch() - target)))
+        assert fetch_err <= err + step / 2 + 1e-6, (fetch_err, step)
+        client.stop()
+        thread.join(timeout=5)
+        assert server.error is None
+
+    def test_unversioned_fetch_never_gets_quantized_reply(self):
+        # a legacy client (no attempt id) cannot dequantize — the server
+        # must answer it with the raw snapshot even when quant is on
+        tps = Broker(2).transports()
+        server = PServer(
+            tps[0], np.full(DIM, 2.0, np.float32), num_clients=1,
+            quant="int8",
+        )
+        thread = spawn_server_thread(server)
+        from mpit_tpu.parallel.pserver import TAG_FETCH, TAG_PARAM
+
+        tps[1].send(0, TAG_FETCH, None)  # legacy un-id'd FETCH
+        msg = tps[1].recv(0, TAG_PARAM, timeout=5)
+        assert isinstance(msg.payload, np.ndarray)
+        np.testing.assert_array_equal(
+            msg.payload, np.full(DIM, 2.0, np.float32)
+        )
+        from mpit_tpu.parallel.pserver import TAG_STOP
+
+        tps[1].send(0, TAG_STOP, None)
+        thread.join(timeout=5)
+        assert server.error is None
+
+    def test_quant_validation(self):
+        tps = Broker(2).transports()
+        with pytest.raises(ValueError, match="quant"):
+            PServer(
+                tps[0], np.zeros(4, np.float32), num_clients=1,
+                quant="fp8",
+            )
+
+
+def _free_ports(n):
+    import socket as _socket
+
+    probes, addrs = [], []
+    for _ in range(n):
+        s = _socket.socket()
+        s.bind(("127.0.0.1", 0))
+        addrs.append(("127.0.0.1", s.getsockname()[1]))
+        probes.append(s)
+    for s in probes:
+        s.close()
+    return addrs
+
+
+class TestMixedVersionSocket:
+    """A framed-capable peer and a pickle-only peer (emulated with
+    MPIT_WIRE_NEGOTIATE=0 — no hello sent, none awaited, nothing framed)
+    must complete real EASGD exchanges in BOTH pairings: negotiation
+    falls the framed side back to pickle, and protocol semantics are
+    format-independent."""
+
+    @pytest.mark.parametrize("legacy_side", ["server", "client"])
+    def test_two_round_easgd_exchange(self, legacy_side, monkeypatch):
+        # keep the framed side's hello wait short: the legacy peer will
+        # never send one and the connect path eats the full timeout
+        monkeypatch.setenv("MPIT_WIRE_NEGOTIATE_TIMEOUT_S", "0.3")
+        addrs = _free_ports(2)
+
+        def build(rank, legacy):
+            if legacy:
+                monkeypatch.setenv("MPIT_WIRE_NEGOTIATE", "0")
+            else:
+                monkeypatch.delenv("MPIT_WIRE_NEGOTIATE", raising=False)
+            return SocketTransport(rank, 2, addresses=addrs)
+
+        srv_tp = build(0, legacy_side == "server")
+        cli_tp = build(1, legacy_side == "client")
+        alpha = 0.5
+        server = PServer(
+            srv_tp, np.zeros(DIM, np.float32), num_clients=1, alpha=alpha,
+        )
+        thread = spawn_server_thread(server)
+        client = PClient(cli_tp, [0], DIM, timeout=10)
+        try:
+            ones = np.ones(DIM, np.float32)
+            c0 = client.fetch()
+            np.testing.assert_array_equal(c0, np.zeros(DIM))
+            client.push_easgd(ones)  # center += alpha * (x - center)
+            c1 = client.fetch()
+            np.testing.assert_allclose(c1, alpha * ones, rtol=1e-6)
+            client.push_easgd(ones)
+            c2 = client.fetch()
+            np.testing.assert_allclose(
+                c2, (alpha + alpha * (1 - alpha)) * ones, rtol=1e-6
+            )
+            assert server.counts["push_easgd"] == 2
+            assert server.counts["fetch"] == 3
+        finally:
+            client.stop()
+            thread.join(timeout=10)
+            srv_tp.close()
+            cli_tp.close()
+        assert server.error is None
