@@ -35,5 +35,7 @@ from mpit_tpu.comm.collectives import (  # noqa: F401
     pmax,
     pmin,
     ppermute_ring,
+    quantized_allreduce,
+    quantized_psum_scatter,
     reduce_scatter,
 )

@@ -39,9 +39,12 @@ import optax
 from jax import lax
 from jax.sharding import NamedSharding, PartitionSpec as P
 
+from mpit_tpu import quant as _quant
+from mpit_tpu.comm.collectives import quantized_psum_scatter
 from mpit_tpu.comm.topology import topology as _current_topology
 from mpit_tpu.comm.topology import Topology
 from mpit_tpu.parallel import common
+from mpit_tpu.parallel.sync import dp_quant_from_env
 from mpit_tpu.utils.params import flatten_params
 
 
@@ -68,6 +71,7 @@ class ZeroDataParallelTrainer:
         donate_state: bool = True,
         accum_steps: int = 1,
         clip_norm: Optional[float] = None,
+        quant: Optional[str] = None,
     ):
         """``accum_steps``: gradient accumulation, composable with the
         state sharding — both memory knobs together (activations / accum,
@@ -76,13 +80,26 @@ class ZeroDataParallelTrainer:
         (:func:`common.clip_by_global_norm_in_mesh` — the psum over
         chunk sum-of-squares IS the full-vector norm, so this equals
         ``optax.clip_by_global_norm`` on unsharded sync DP exactly; the
-        chain form itself is rejected by the elementwise probe below)."""
+        chain form itself is rejected by the elementwise probe below).
+        ``quant`` (default: the ``MPIT_DP_QUANT`` knob): run the
+        gradient reduce-scatter through
+        :func:`comm.collectives.quantized_psum_scatter` — 1- or 2-byte
+        codes on the wire, f32 accumulate. STATELESS (no error feedback
+        — the persistent state here is deliberately 1/W-sized, and a
+        full-width residual would undo that); the rounding is one
+        bounded step per scatter, and the dynamics plane is the
+        convergence guardrail (docs/WIRE.md)."""
         self.model = model
         self.optimizer = optimizer
         common.assert_elementwise_optimizer(
             optimizer, "ZeroDataParallelTrainer"
         )
         self.clip_norm = common.check_clip_norm(clip_norm)
+        self.quant = dp_quant_from_env() if quant is None else quant
+        if self.quant not in _quant.QUANT_MODES:
+            raise ValueError(
+                f"quant={self.quant!r}: expected one of {_quant.QUANT_MODES}"
+            )
         self.topo = topo if topo is not None else _current_topology()
         self.loss_fn = (
             loss_fn
@@ -141,6 +158,14 @@ class ZeroDataParallelTrainer:
         )
 
         accum = self.accum_steps
+        quant_mode = self.quant
+
+        def _scatter(flat_g):
+            # mode "off" IS lax.psum_scatter(tiled=True) — the raw path
+            # byte-identical to the pre-quant trainer
+            return quantized_psum_scatter(
+                flat_g, axis_name=axis, mode=quant_mode
+            ) / w
 
         def scattered_grad(params, x, y):
             """Mean-gradient CHUNK for this device.
@@ -162,9 +187,7 @@ class ZeroDataParallelTrainer:
                 loss, grads = vg(params, x, y)
                 flat_g, _ = flatten_params(grads)
                 flat_g = jnp.pad(flat_g, (0, padded - n))
-                return loss, lax.psum_scatter(
-                    flat_g, axis, tiled=True
-                ) / w
+                return loss, _scatter(flat_g)
             xs = x.reshape(accum, x.shape[0] // accum, *x.shape[1:])
             ys = y.reshape(accum, y.shape[0] // accum, *y.shape[1:])
 
@@ -173,7 +196,7 @@ class ZeroDataParallelTrainer:
                 l, g = vg(params, *xy)
                 flat_g, _ = flatten_params(g)
                 flat_g = jnp.pad(flat_g, (0, padded - n))
-                gs = lax.psum_scatter(flat_g, axis, tiled=True) / w
+                gs = _scatter(flat_g)
                 return (loss_acc + l, shard_acc + gs), None
 
             (loss, shard), _ = lax.scan(

@@ -1,0 +1,172 @@
+"""quant — the shared scalar quantization kernels, host and XLA paths.
+
+One contract, two execution paths. The PS wire path
+(:mod:`mpit_tpu.transport.wire`) quantizes numpy buffers on the host
+before framing; the collective path (:mod:`mpit_tpu.comm.collectives`)
+quantizes inside a jit'd ``shard_map`` program so the bytes that cross
+the ICI/DCN links are the quantized codes, not float32. Both paths MUST
+produce bit-identical codes and scales for the same input — the error-
+feedback math (docs/WIRE.md) treats ``dequantize(quantize(x))`` as one
+deterministic function, and a host/device disagreement would make the
+residual wrong by exactly the disagreement. The equivalence is pinned in
+``tests/test_wire.py`` (numpy-vs-jnp bit-equality for both modes).
+
+Kernels (EQuARX-style, PAPERS.md arXiv:2506.17615):
+
+- ``bf16``: round-to-nearest-even high halves of the float32 bits —
+  pure bit arithmetic, scale-free, 2x byte drop;
+- ``int8``: symmetric per-block absmax scaling, codes in [-127, 127],
+  ``scale = absmax / 127`` computed in float32 on BOTH paths (a float64
+  host division would double-round against XLA's f32), 4x byte drop.
+
+This module imports numpy only at module scope; jax is imported lazily
+inside the jnp kernels so the host wire path (and the stdlib-only
+reader tools that sit behind it) never pays a jax import.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import numpy as np
+
+_F32_SIZE = 4
+
+QUANT_MODES = ("off", "bf16", "int8")
+
+# on-wire bytes per quantized element (raw float32 = 4)
+MODE_ITEMSIZE = {"off": 4, "bf16": 2, "int8": 1}
+
+
+@dataclasses.dataclass(frozen=True)
+class QuantArray:
+    """A quantized float32 chunk in transit.
+
+    ``mode`` is ``"bf16"`` (``data`` = uint16 high halves) or ``"int8"``
+    (``data`` = symmetric codes in [-127, 127], ``scale`` = absmax/127).
+    Pickles fine, so quantized exchange also works over the inproc
+    broker and with pickle-only peers — quantization is a protocol-layer
+    choice, independent of the framing."""
+
+    mode: str
+    scale: float
+    data: np.ndarray
+
+    @property
+    def nbytes(self) -> int:
+        """On-wire payload size (the telemetry byte counters read this
+        via the same ``nbytes`` duck-type as real ndarrays): quantized
+        buffer plus the header-resident scale."""
+        return int(self.data.nbytes) + _F32_SIZE
+
+
+# -- host (numpy) path ----------------------------------------------------
+
+
+def quantize(arr: np.ndarray, mode: str) -> QuantArray:
+    """Pack a float32 array into a :class:`QuantArray` (copies — the
+    quantized buffer is new; the input is never aliased)."""
+    a = np.ascontiguousarray(arr, dtype=np.float32)
+    if mode == "bf16":
+        u = a.view(np.uint32)
+        # round-to-nearest-even on the dropped mantissa half; the +
+        # carries into the exponent correctly for halfway cases
+        data = ((u + 0x7FFF + ((u >> 16) & 1)) >> 16).astype(np.uint16)
+        return QuantArray("bf16", 1.0, data)
+    if mode == "int8":
+        amax = np.float32(np.max(np.abs(a))) if a.size else np.float32(0)
+        # f32 division, not float64-then-cast: the jnp path divides in
+        # f32 and the two must agree to the bit (all-zero chunk: scale
+        # is moot, pick 1)
+        scale = amax / np.float32(127.0) if amax > 0 else np.float32(1.0)
+        data = np.clip(np.rint(a / scale), -127, 127).astype(np.int8)
+        return QuantArray("int8", float(scale), data)
+    raise ValueError(f"unknown quantization mode {mode!r}")
+
+
+def dequantize(q: QuantArray) -> np.ndarray:
+    """float32 reconstruction of a :class:`QuantArray`."""
+    if q.mode == "bf16":
+        data = np.ascontiguousarray(q.data, dtype=np.uint16)
+        return (data.astype(np.uint32) << 16).view(np.float32)
+    if q.mode == "int8":
+        data = np.asarray(q.data, dtype=np.int8)
+        return data.astype(np.float32) * np.float32(q.scale)
+    raise ValueError(f"unknown quantization mode {q.mode!r}")
+
+
+# -- device (jnp) path ----------------------------------------------------
+#
+# The jnp twins return (codes, scales) pairs instead of QuantArray —
+# inside a traced program the scale is an array, and the collective path
+# needs PER-BLOCK scales (one per destination row of the reduce-scatter)
+# that a scalar-field dataclass cannot carry. ``quantize_jnp`` is the
+# whole-array special case (scale shape ``()``); ``quantize_rows_jnp``
+# quantizes each row of a 2-D array independently (scales ``(rows, 1)``).
+
+
+def _jnp():
+    import jax.numpy as jnp
+    from jax import lax
+
+    return jnp, lax
+
+
+def quantize_jnp(x, mode: str):
+    """jit-safe twin of :func:`quantize`: ``(codes, scale)`` for one
+    array with ONE scale (f32 scalar; fixed 1.0 for bf16). Codes and
+    scale are bit-identical to the numpy path on the same input."""
+    jnp, lax = _jnp()
+    a = jnp.asarray(x, jnp.float32)
+    if mode == "bf16":
+        u = lax.bitcast_convert_type(a, jnp.uint32)
+        codes = ((u + 0x7FFF + ((u >> 16) & 1)) >> 16).astype(jnp.uint16)
+        return codes, jnp.float32(1.0)
+    if mode == "int8":
+        amax = jnp.max(jnp.abs(a)) if a.size else jnp.float32(0)
+        scale = jnp.where(amax > 0, amax / jnp.float32(127.0), 1.0)
+        scale = scale.astype(jnp.float32)
+        codes = jnp.clip(jnp.rint(a / scale), -127, 127).astype(jnp.int8)
+        return codes, scale
+    raise ValueError(f"unknown quantization mode {mode!r}")
+
+
+def dequantize_jnp(codes, scale, mode: str):
+    """float32 reconstruction of a jnp ``(codes, scale)`` pair."""
+    jnp, lax = _jnp()
+    if mode == "bf16":
+        u = codes.astype(jnp.uint32) << 16
+        return lax.bitcast_convert_type(u, jnp.float32)
+    if mode == "int8":
+        return codes.astype(jnp.float32) * jnp.asarray(scale, jnp.float32)
+    raise ValueError(f"unknown quantization mode {mode!r}")
+
+
+def quantize_rows_jnp(x, mode: str):
+    """Blockwise quantization of a 2-D array: each row gets its own
+    absmax scale (the reduce-scatter layout — row j is the block bound
+    for worker j). Returns ``(codes (B, n), scales (B, 1))``; bf16
+    scales are ones (carried for shape uniformity, never sent)."""
+    jnp, lax = _jnp()
+    a = jnp.asarray(x, jnp.float32)
+    if mode == "bf16":
+        codes, _ = quantize_jnp(a, "bf16")
+        return codes, jnp.ones((a.shape[0], 1), jnp.float32)
+    if mode == "int8":
+        amax = jnp.max(jnp.abs(a), axis=1, keepdims=True)
+        scale = jnp.where(amax > 0, amax / jnp.float32(127.0), 1.0)
+        scale = scale.astype(jnp.float32)
+        codes = jnp.clip(jnp.rint(a / scale), -127, 127).astype(jnp.int8)
+        return codes, scale
+    raise ValueError(f"unknown quantization mode {mode!r}")
+
+
+def dequantize_rows_jnp(codes, scales, mode: str):
+    """float32 reconstruction of a blockwise pair (scales broadcast
+    over rows)."""
+    jnp, _ = _jnp()
+    if mode == "bf16":
+        return dequantize_jnp(codes, None, "bf16")
+    if mode == "int8":
+        return codes.astype(jnp.float32) * jnp.asarray(scales, jnp.float32)
+    raise ValueError(f"unknown quantization mode {mode!r}")

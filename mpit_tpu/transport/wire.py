@@ -58,12 +58,14 @@ nearest-even high halves; int8 = symmetric per-chunk absmax scaling,
 scale carried in the frame header as an f32). The PS client carries the
 quantization residual into its next push (error feedback — see
 docs/WIRE.md for the math), so the *accumulated* center drift stays
-bounded while wire bytes drop ~2x (bf16) / ~4x (int8).
+bounded while wire bytes drop ~2x (bf16) / ~4x (int8). The kernels
+themselves live in :mod:`mpit_tpu.quant` (re-exported here so existing
+imports keep working) — the quantized-collective path shares them, and
+the host/device bit-equivalence contract is documented there.
 """
 
 from __future__ import annotations
 
-import dataclasses
 import os
 import struct
 import sys
@@ -71,6 +73,13 @@ import zlib
 from typing import Any, Optional
 
 import numpy as np
+
+from mpit_tpu.quant import (  # noqa: F401  (re-exports: wire API surface)
+    QUANT_MODES,
+    QuantArray,
+    dequantize,
+    quantize,
+)
 
 # The wire format's ONE version number. Readers accept any frame at or
 # below their own version; every frame WRITER must pin this constant by
@@ -124,8 +133,6 @@ _CODE_DTYPES = {v: k for k, v in _DTYPE_CODES.items()}
 _QUANT_MODE_CODES = {"bf16": 1, "int8": 2}
 _CODE_QUANT_MODES = {v: k for k, v in _QUANT_MODE_CODES.items()}
 
-QUANT_MODES = ("off", "bf16", "int8")
-
 _MAX_DIMS = 16
 # header sanity bound: the structural part of a PS message is tiny (tens
 # of bytes); a multi-megabyte header length is a corrupted preamble, not
@@ -146,57 +153,6 @@ class WireDecodeError(Exception):
         super().__init__(message)
         self.src = src
         self.tag = tag
-
-
-@dataclasses.dataclass(frozen=True)
-class QuantArray:
-    """A quantized float32 chunk in transit.
-
-    ``mode`` is ``"bf16"`` (``data`` = uint16 high halves) or ``"int8"``
-    (``data`` = symmetric codes in [-127, 127], ``scale`` = absmax/127).
-    Pickles fine, so quantized exchange also works over the inproc
-    broker and with pickle-only peers — quantization is a protocol-layer
-    choice, independent of the framing."""
-
-    mode: str
-    scale: float
-    data: np.ndarray
-
-    @property
-    def nbytes(self) -> int:
-        """On-wire payload size (the telemetry byte counters read this
-        via the same ``nbytes`` duck-type as real ndarrays): quantized
-        buffer plus the header-resident scale."""
-        return int(self.data.nbytes) + _F32.size
-
-
-def quantize(arr: np.ndarray, mode: str) -> QuantArray:
-    """Pack a float32 array into a :class:`QuantArray` (copies — the
-    quantized buffer is new; the input is never aliased)."""
-    a = np.ascontiguousarray(arr, dtype=np.float32)
-    if mode == "bf16":
-        u = a.view(np.uint32)
-        # round-to-nearest-even on the dropped mantissa half; the +
-        # carries into the exponent correctly for halfway cases
-        data = ((u + 0x7FFF + ((u >> 16) & 1)) >> 16).astype(np.uint16)
-        return QuantArray("bf16", 1.0, data)
-    if mode == "int8":
-        amax = float(np.max(np.abs(a))) if a.size else 0.0
-        scale = (amax / 127.0) or 1.0  # all-zero chunk: scale is moot
-        data = np.clip(np.rint(a / scale), -127, 127).astype(np.int8)
-        return QuantArray("int8", scale, data)
-    raise ValueError(f"unknown quantization mode {mode!r}")
-
-
-def dequantize(q: QuantArray) -> np.ndarray:
-    """float32 reconstruction of a :class:`QuantArray`."""
-    if q.mode == "bf16":
-        data = np.ascontiguousarray(q.data, dtype=np.uint16)
-        return (data.astype(np.uint32) << 16).view(np.float32)
-    if q.mode == "int8":
-        data = np.asarray(q.data, dtype=np.int8)
-        return data.astype(np.float32) * np.float32(q.scale)
-    raise ValueError(f"unknown quantization mode {q.mode!r}")
 
 
 # -- env knobs ------------------------------------------------------------

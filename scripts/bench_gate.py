@@ -27,7 +27,13 @@ pass uses the same ``--threshold`` and prints the series it scored.
 Rounds measured on different platforms (a TPU round vs a dead-tunnel
 CPU-smoke fallback, visible via ``platform``/``platform_note``) are
 reported but never flagged — a 1000x "regression" between a TPU number
-and a CPU number is a platform change, not a code change.
+and a CPU number is a platform change, not a code change. The same
+rule applies to the exchange configuration: rounds with different
+quant/bucket/overlap modes (``dp_quant``/``dp_bucket_bytes``/
+``dp_overlap`` on the collective legs, ``wire_format``/``wire_quant``
+on the PS legs) are never scored against each other — an int8 round
+"regressing" against a raw round is an A/B comparison, not a drift,
+and it belongs in the bench's own ``vs_raw`` field.
 
 Warn-only by default (exit 0 with warnings printed) because bench noise
 must not block commits — scripts/lint.sh runs it that way (with
@@ -72,6 +78,21 @@ def _platform_mode(parsed: dict) -> str:
     if parsed.get("platform_note"):
         return "cpu-smoke"
     return str(parsed.get("platform", "unknown"))
+
+
+_EXCHANGE_KEYS = (
+    # collective-exchange knobs (bench.py --dp / quantized trainers)
+    "dp_quant", "dp_bucket_bytes", "dp_overlap",
+    # PS socket-codec knobs (bench.py --preset mnist-ps)
+    "wire_format", "wire_quant",
+)
+
+
+def _exchange_mode(parsed: dict) -> str:
+    """Comparable-measurement key #2: rounds with different quant/
+    bucket/overlap (or wire codec) modes are A/B variants of each
+    other, not points on one trajectory — never score them pairwise."""
+    return "/".join(str(parsed.get(k, "-")) for k in _EXCHANGE_KEYS)
 
 
 _MS_KEY = re.compile(r"_ms$")
@@ -138,11 +159,19 @@ def comparable_series(rounds: list) -> list:
     if not rounds:
         return []
     newest = rounds[-1][2]
-    key = (newest.get("metric"), _platform_mode(newest))
+    key = (
+        newest.get("metric"),
+        _platform_mode(newest),
+        _exchange_mode(newest),
+    )
     series: list = []
     for item in reversed(rounds):
         parsed = item[2]
-        if (parsed.get("metric"), _platform_mode(parsed)) != key:
+        if (
+            parsed.get("metric"),
+            _platform_mode(parsed),
+            _exchange_mode(parsed),
+        ) != key:
             break
         series.append(item)
     series.reverse()
@@ -251,6 +280,11 @@ def main(argv=None) -> int:
         print(f"bench_gate: platform changed {om} -> {nm} "
               f"({os.path.basename(old_path)} -> "
               f"{os.path.basename(new_path)}) — not comparable")
+    elif (oe := _exchange_mode(old)) != (ne := _exchange_mode(new)):
+        print(f"bench_gate: exchange mode changed {oe} -> {ne} "
+              f"({os.path.basename(old_path)} -> "
+              f"{os.path.basename(new_path)}) — not comparable "
+              "(quant/bucket/overlap A/B, not a trajectory)")
     else:
         flags = compare(old, new, threshold)
         label = (f"{os.path.basename(old_path)} -> "

@@ -19,7 +19,10 @@
 #      (tests/fixtures/live — the `obs live --validate` contract);
 #   5. the training-dynamics gate over the checked-in dynamics golden
 #      (tests/fixtures/dynamics/good_run vs scripts/dynamics_smoke.json
-#      — the `obs dynamics --gate` contract).
+#      — the `obs dynamics --gate` contract);
+#   6. a jax-free import probe of the shared quant kernels
+#      (mpit_tpu/quant.py + the transport.wire re-exports) — the host
+#      wire path must never grow a backend dependency.
 # The whole default run is bounded to < 15 s wall-clock
 # (tests/test_lint_gate.py enforces it).
 #
@@ -55,6 +58,24 @@ if [[ $# -eq 0 ]]; then
     # the update-quality contract, gated on the same dynamics golden
     python -m mpit_tpu.obs dynamics tests/fixtures/dynamics/good_run \
         --gate scripts/dynamics_smoke.json
+    # the shared quant kernels must stay importable WITHOUT a jax
+    # backend (the host wire path depends on it; the jnp half is lazy) —
+    # and the transport re-exports the MPT007 coverage rides on must
+    # resolve from the numpy side alone
+    python - <<'EOF'
+import importlib.util, sys
+import numpy as np
+sys.modules["jax"] = None  # poison: any jax import below fails loudly
+spec = importlib.util.spec_from_file_location(
+    "quant_probe", "mpit_tpu/quant.py"
+)
+quant = importlib.util.module_from_spec(spec)
+sys.modules["quant_probe"] = quant  # dataclass machinery resolves via here
+spec.loader.exec_module(quant)  # must not touch jax (the jnp half is lazy)
+q = quant.quantize(np.ones(8, np.float32), "int8")
+out = quant.dequantize(q)
+assert out.shape == (8,) and out.dtype == np.float32
+EOF
     # warn-only: bench trajectory drift should be SEEN at lint time, but
     # bench noise must never block a commit (--strict exists for CI)
     python scripts/bench_gate.py --trend || true

@@ -29,6 +29,11 @@ run python bench.py --serve --weights-dtype bf16 > /tmp/v_serve_bf16.log 2>&1
 run python bench.py --spec > /tmp/v_spec.log 2>&1
 run python bench.py --serve --prefix-len 64 > /tmp/v_serve_prefix.log 2>&1
 run python bench.py --load > /tmp/v_serve_load.log 2>&1
+# -- sync-DP quantized/bucketed exchange A/B (each --dp run times BOTH
+#    the raw and quantized staged-exchange legs on the same bucket plan;
+#    the JSON line carries wire fraction + bytes drop + dynamics) --
+run python bench.py --dp > /tmp/v_dp_int8.log 2>&1
+run python bench.py --dp --quant bf16 > /tmp/v_dp_bf16.log 2>&1
 # -- variant axes --
 run python scripts/measure_presets.py --remat --presets resnet50-sync,ptb-transformer-seq > /tmp/v_remat.log 2>&1
 run python scripts/measure_presets.py --set algo=zero-sync --presets mnist-easgd,cifar-vgg-sync > /tmp/v_zero.log 2>&1

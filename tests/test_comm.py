@@ -125,6 +125,16 @@ class TestCollectives:
         np.testing.assert_allclose(np.asarray(out["a"]), np.full((8, 2), 8.0))
         np.testing.assert_allclose(np.asarray(out["b"]["c"]), np.full((8, 4), 16.0))
 
+    def test_reducer_table_covers_every_op(self):
+        """Every exported op constant except AVG (pmean, dispatched
+        directly in allreduce) must have a ``_REDUCERS`` entry — a new
+        constant without a reducer previously slipped through as a
+        KeyError at trace time (the PROD regression)."""
+        ops = {coll.SUM, coll.PROD, coll.MAX, coll.MIN}
+        assert set(coll._REDUCERS) == ops
+        assert coll.AVG not in coll._REDUCERS
+        assert all(callable(r) for r in coll._REDUCERS.values())
+
     def test_allreduce_unknown_op_raises(self, topo8):
         from jax.sharding import PartitionSpec as P
 

@@ -623,6 +623,42 @@ class TestBenchGate:
         assert bg.main(["--strict", str(tmp_path)]) == 0
         assert "not comparable" in capsys.readouterr().out
 
+    def test_exchange_mode_change_not_comparable(self, tmp_path, capsys):
+        """An int8 round must never be scored against a raw round (or a
+        different bucket/overlap/codec config) — that's an A/B pair, not
+        a trajectory; the trend series must also stop at the boundary."""
+        bg = _bench_gate()
+        base = {"metric": "sync_dp_exchange_throughput",
+                "platform": "cpu", "dp_bucket_bytes": 65536,
+                "dp_overlap": False}
+        _bench_round(tmp_path, 1, {**base, "value": 200.0,
+                                   "dp_quant": "off"})
+        _bench_round(tmp_path, 2, {**base, "value": 100.0,
+                                   "dp_quant": "int8"})
+        assert bg.main(["--strict", str(tmp_path)]) == 0
+        assert "not comparable" in capsys.readouterr().out
+        # wire-codec knobs gate the PS legs the same way
+        _bench_round(tmp_path, 3, {"metric": "m", "value": 100.0,
+                                   "platform": "cpu",
+                                   "wire_format": "pickle"})
+        _bench_round(tmp_path, 4, {"metric": "m", "value": 50.0,
+                                   "platform": "cpu",
+                                   "wire_format": "framed"})
+        assert bg.main(["--strict", str(tmp_path)]) == 0
+        assert "not comparable" in capsys.readouterr().out
+        # same mode on both sides still flags a real drop
+        _bench_round(tmp_path, 5, {**base, "value": 100.0,
+                                   "dp_quant": "int8"})
+        _bench_round(tmp_path, 6, {**base, "value": 50.0,
+                                   "dp_quant": "int8"})
+        assert bg.main(["--strict", str(tmp_path)]) == 1
+        assert "WARNING" in capsys.readouterr().out
+        # the trend series stops at the exchange-mode boundary: rounds
+        # 2/5/6 share int8 but round 2's predecessor is raw — series is
+        # the int8 suffix only (5,6 + 2 is non-contiguous; suffix = 5,6)
+        tflags, tlabel = bg.trend(bg._load_rounds(str(tmp_path)), 0.10)
+        assert tlabel == "" or "int8" in tlabel
+
     def test_fewer_than_two_rounds_is_clean(self, tmp_path, capsys):
         bg = _bench_gate()
         assert bg.main([str(tmp_path)]) == 0

@@ -445,6 +445,67 @@ def test_pipeline_step_compiles_clean_and_donates():
     assert tr._step._cache_size() == n0 == 1
 
 
+def test_sync_serial_fallback_bit_identical(topo8):
+    """With both exchange knobs off (no MPIT_DP_QUANT, no
+    MPIT_DP_BUCKET_BYTES) the trainer must run the pre-bucketing fused
+    program EXACTLY: params equal to the BIT after several fixed-seed
+    steps against a verbatim reimplementation of the original step.
+    Guards the ISSUE-11 contract that the serial fallback is not
+    "close", it is the same program."""
+    from jax.sharding import PartitionSpec as P
+
+    from mpit_tpu.models import MLP
+    from mpit_tpu.parallel import DataParallelTrainer
+    from mpit_tpu.parallel import common as pcommon
+
+    model = MLP(compute_dtype=jnp.float32)
+    opt = optax.sgd(0.05, momentum=0.9)
+    tr = DataParallelTrainer(model, opt, topo8, donate_state=False)
+    assert not tr.bucketed
+    x, y = _trainer_data()
+    state = tr.init_state(jax.random.key(0), x[:2])
+
+    axis = topo8.worker_axis
+    loss_fn = pcommon.default_loss_fn(model.apply)
+
+    # the pre-bucketing step, verbatim
+    def train_step(state, xb, yb):
+        loss, grads = jax.value_and_grad(loss_fn)(state.params, xb, yb)
+        grads = jax.lax.pmean(grads, axis)
+        loss = jax.lax.pmean(loss, axis)
+        updates, opt_state = opt.update(
+            grads, state.opt_state, state.params
+        )
+        params = optax.apply_updates(state.params, updates)
+        return (
+            pcommon.TrainState(
+                params=params, opt_state=opt_state, step=state.step + 1
+            ),
+            {"loss": loss},
+        )
+
+    ref_step = jax.jit(
+        jax.shard_map(
+            train_step,
+            mesh=topo8.mesh,
+            in_specs=(P(), P(axis), P(axis)),
+            out_specs=(P(), P()),
+            check_vma=False,
+        )
+    )
+    s_tr, s_ref = state, state
+    for i in range(3):
+        xb = np.roll(x, i, axis=0)[:32]
+        yb = np.roll(y, i, axis=0)[:32]
+        s_tr, _ = tr.step(s_tr, xb, yb)
+        s_ref, m_ref = ref_step(s_ref, xb, yb)
+        jax.block_until_ready(m_ref)
+    for a, b in zip(
+        jax.tree.leaves(s_tr.params), jax.tree.leaves(s_ref.params)
+    ):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
 def test_sync_step_compiles_clean_and_donates(topo8):
     """Same three guards for the sync-DP fused step (pmean inside the
     jitted program, donated TrainState)."""

@@ -1,0 +1,278 @@
+"""Quantized collectives (docs/WIRE.md "Quantized collectives"):
+known-answer exactness for the EQuARX-style int8/bf16 allreduce on 2-
+and 4-way CPU meshes, the quantized reduce-scatter hook, and the
+error-feedback convergence pin.
+
+The known-answer inputs are CONSTRUCTED to quantize exactly on both
+hops: every per-worker block holds integer values with absmax 127
+(scale = 1, codes = values), and every reduced block's absmax is an
+exact power-of-two multiple of 127 (scale = 2 or 4 exactly in f32, sums
+all divisible) — so the quantized allreduce must equal the raw sum to
+the bit, isolating wiring mistakes (row routing, scale transport,
+padding) from rounding noise."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import PartitionSpec as P
+
+import mpit_tpu
+from mpit_tpu.comm import collectives as coll
+
+
+def _mesh_fn(topo, fn, in_specs, out_specs):
+    return jax.jit(
+        jax.shard_map(
+            fn,
+            mesh=topo.mesh,
+            in_specs=in_specs,
+            out_specs=out_specs,
+            check_vma=False,
+        )
+    )
+
+
+def _topo(workers):
+    return mpit_tpu.init(num_workers=workers)
+
+
+class TestKnownAnswerAllreduce:
+    def test_int8_sum_exact_2way(self):
+        topo = _topo(2)
+        # per-worker rows (chunk size 4) each have absmax 127 → scale 1;
+        # reduced chunks have absmax 254 → scale exactly 2, sums even
+        x = np.array(
+            [
+                [127, 2, -4, 100, 127, 2, 64, -32],
+                [127, 4, -2, -90, 127, 2, -64, 32],
+            ],
+            np.float32,
+        )
+        f = _mesh_fn(
+            topo,
+            lambda s: coll.allreduce(s[0], coll.SUM, quant="int8"),
+            P("dp", None),
+            P(),
+        )
+        np.testing.assert_array_equal(np.asarray(f(x)), x.sum(axis=0))
+
+    def test_int8_avg_exact_2way(self):
+        topo = _topo(2)
+        x = np.array(
+            [
+                [127, 2, -4, 100, 127, 2, 64, -32],
+                [127, 4, -2, -90, 127, 2, -64, 32],
+            ],
+            np.float32,
+        )
+        f = _mesh_fn(
+            topo,
+            lambda s: coll.allreduce(s[0], coll.AVG, quant="int8"),
+            P("dp", None),
+            P(),
+        )
+        # mean divides BEFORE the second quantization: reduced absmax is
+        # back to 127, scale 1, integer codes — exact again
+        np.testing.assert_array_equal(np.asarray(f(x)), x.mean(axis=0))
+
+    def test_int8_sum_exact_4way(self):
+        topo = _topo(4)
+        # 4 identical workers: every 2-element block holds a ±127 →
+        # scale 1; reduced blocks are 4x → absmax 508, scale exactly 4
+        row = np.array([127, 3, -127, 5, 127, -7, -127, 9], np.float32)
+        x = np.tile(row, (4, 1))
+        f = _mesh_fn(
+            topo,
+            lambda s: coll.allreduce(s[0], coll.SUM, quant="int8"),
+            P("dp", None),
+            P(),
+        )
+        np.testing.assert_array_equal(np.asarray(f(x)), 4 * row)
+
+    def test_int8_sum_exact_with_padding(self):
+        topo = _topo(2)
+        # length 5 pads to 6 (chunk 3); the pad element quantizes to
+        # code 0 and must not leak into the truncated output
+        x = np.array(
+            [[127, 2, -4, 127, 2], [127, 4, -2, 127, 2]], np.float32
+        )
+        f = _mesh_fn(
+            topo,
+            lambda s: coll.allreduce(s[0], coll.SUM, quant="int8"),
+            P("dp", None),
+            P(),
+        )
+        np.testing.assert_array_equal(np.asarray(f(x)), x.sum(axis=0))
+
+    def test_bf16_sum_exact_2way(self):
+        topo = _topo(2)
+        # all contributions AND sums exactly representable in bf16
+        x = np.array(
+            [
+                [1, 2, 3, 4, 100, 0.5, -8, 16],
+                [5, -2, 1, 4, 28, 0.5, 8, -16],
+            ],
+            np.float32,
+        )
+        f = _mesh_fn(
+            topo,
+            lambda s: coll.allreduce(s[0], coll.SUM, quant="bf16"),
+            P("dp", None),
+            P(),
+        )
+        np.testing.assert_array_equal(np.asarray(f(x)), x.sum(axis=0))
+
+    def test_int8_random_error_bounded(self):
+        topo = _topo(4)
+        rng = np.random.default_rng(5)
+        x = rng.standard_normal((4, 256)).astype(np.float32)
+        f = _mesh_fn(
+            topo,
+            lambda s: coll.allreduce(s[0], coll.SUM, quant="int8"),
+            P("dp", None),
+            P(),
+        )
+        got = np.asarray(f(x))
+        want = x.sum(axis=0)
+        # per-hop bound: W first-hop roundings at ≤ scale1/2 each plus
+        # one second-hop rounding at ≤ scale2/2
+        s1 = np.abs(x).max() / 127.0
+        s2 = np.abs(want).max() / 127.0
+        assert np.max(np.abs(got - want)) <= 4 * s1 / 2 + s2 / 2 + 1e-6
+
+    def test_pytree_and_dtype_preserved(self):
+        topo = _topo(2)
+        # blocks are chunk-sized (leaf_size / W): every block carries a
+        # ±127 (scale 1) and reduced blocks hit exact power-of-two
+        # scales — "b" has single-element blocks, so values are
+        # 127·2^k exactly
+        tree = {
+            "a": np.array([[127, 2, -4, 127]] * 2, np.float32),
+            "b": np.array([[127, 254]] * 2, np.float32),
+        }
+        spec = {"a": P("dp", None), "b": P("dp", None)}
+        f = _mesh_fn(
+            topo,
+            lambda t: coll.allreduce(
+                {k: v[0] for k, v in t.items()}, coll.SUM, quant="int8"
+            ),
+            (spec,),
+            {"a": P(), "b": P()},
+        )
+        out = f(tree)
+        assert out["a"].dtype == jnp.float32
+        np.testing.assert_array_equal(
+            np.asarray(out["a"]), tree["a"].sum(axis=0)
+        )
+        np.testing.assert_array_equal(
+            np.asarray(out["b"]), tree["b"].sum(axis=0)
+        )
+
+    def test_quant_rejects_non_sum_ops_and_bad_modes(self):
+        topo = _topo(2)
+        x = np.ones((2, 4), np.float32)
+        with pytest.raises(ValueError, match="SUM/AVG"):
+            _mesh_fn(
+                topo,
+                lambda s: coll.allreduce(s, coll.MAX, quant="int8"),
+                P("dp", None),
+                P("dp", None),
+            )(x)
+        with pytest.raises(ValueError, match="mode"):
+            _mesh_fn(
+                topo,
+                lambda s: coll.quantized_allreduce(s, mode="fp4")[0],
+                P("dp", None),
+                P("dp", None),
+            )(x)
+
+
+class TestQuantizedPsumScatter:
+    def test_int8_exact_scatter_2way(self):
+        topo = _topo(2)
+        x = np.array(
+            [
+                [127, 2, -4, 100, 127, 2, 64, -32],
+                [127, 4, -2, -90, 127, 2, -64, 32],
+            ],
+            np.float32,
+        )
+
+        def f(s):
+            return coll.quantized_psum_scatter(s[0], mode="int8")[None]
+
+        out = _mesh_fn(topo, f, P("dp", None), P("dp", None))(x)
+        # worker k holds chunk k of the full sum — first hop only, so
+        # the f32 accumulate is exact once the codes are
+        np.testing.assert_array_equal(
+            np.asarray(out).ravel(), x.sum(axis=0)
+        )
+
+    def test_off_mode_is_raw_psum_scatter(self):
+        topo = _topo(2)
+        x = np.stack(
+            [np.arange(8, dtype=np.float32) + 10 * i for i in range(2)]
+        )
+
+        def f(s):
+            return coll.quantized_psum_scatter(s[0], mode="off")[None]
+
+        out = _mesh_fn(topo, f, P("dp", None), P("dp", None))(x)
+        np.testing.assert_allclose(np.asarray(out).ravel(), x.sum(axis=0))
+
+    def test_bad_mode_raises(self):
+        topo = _topo(2)
+        with pytest.raises(ValueError, match="psum_scatter mode"):
+            _mesh_fn(
+                topo,
+                lambda s: coll.quantized_psum_scatter(s[0], mode="fp8")[
+                    None
+                ],
+                P("dp", None),
+                P("dp", None),
+            )(np.ones((2, 4), np.float32))
+
+
+class TestErrorFeedback:
+    def test_ef_mean_converges_past_one_shot_error(self):
+        """The EF recurrence (docs/WIRE.md) applied to the quantized
+        allreduce: with BOTH residual levels threaded (contribution +
+        owned-chunk requantization), the MEAN of the reduced outputs
+        over N rounds lands far inside one round's quantization error —
+        the same contract the wire path pins in tests/test_wire.py,
+        here through the two-hop collective."""
+        topo = _topo(2)
+        rng = np.random.default_rng(13)
+        g = rng.standard_normal((2, 128)).astype(np.float32)
+        want = g.mean(axis=0)
+
+        def f(s, r, r2):
+            red, new_r, new_r2 = coll.quantized_allreduce(
+                s[0], mode="int8", mean=True,
+                residual=r[0], residual2=r2[0],
+            )
+            return red, new_r[None], new_r2[None]
+
+        step = _mesh_fn(
+            topo, f,
+            (P("dp", None), P("dp", None), P("dp", None)),
+            (P(), P("dp", None), P("dp", None)),
+        )
+        res = np.zeros_like(g)
+        res2 = np.zeros((2, g.shape[1] // 2), np.float32)
+        acc = np.zeros_like(want)
+        n = 50
+        for _ in range(n):
+            red, res, res2 = step(g, res, res2)
+            jax.block_until_ready(res)  # XLA:CPU: one in-flight program
+            acc += np.asarray(red)
+        one = _mesh_fn(
+            topo,
+            lambda s: coll.quantized_allreduce(s[0], mode="int8", mean=True)[0],
+            P("dp", None),
+            P(),
+        )
+        one_shot = np.mean(np.abs(np.asarray(one(g)) - want))
+        ef_err = np.mean(np.abs(acc / n - want))
+        assert ef_err < one_shot / 10, (ef_err, one_shot)

@@ -241,6 +241,79 @@ class TestQuantization:
             PClient(Broker(2).transports()[1], [0], DIM, quant="fp4")
 
 
+class TestHostDeviceKernelEquivalence:
+    """The factored kernels (mpit_tpu.quant) must agree BIT-FOR-BIT
+    between the numpy (wire) and jnp (collective) paths: the error-
+    feedback residual treats deq(quant(x)) as one deterministic
+    function, so any host/device disagreement becomes exactly that much
+    bias in the gradient average."""
+
+    def _vectors(self):
+        rng = np.random.default_rng(7)
+        return np.concatenate([
+            rng.standard_normal(1024).astype(np.float32) * 1e3,
+            # edge cases: signed zero, exact powers of two (bf16 RNE
+            # halfway carries), denormal-ish tiny, large
+            np.array([0.0, -0.0, 1.0, -1.0, 2.0 ** -120, 6.5e4,
+                      0.5, -3.0], np.float32),
+        ])
+
+    def test_bf16_rne_bits_match(self):
+        from mpit_tpu import quant as qk
+
+        a = self._vectors()
+        host = quantize(a, "bf16")
+        codes, scale = qk.quantize_jnp(a, "bf16")
+        np.testing.assert_array_equal(np.asarray(codes), host.data)
+        np.testing.assert_array_equal(
+            np.asarray(qk.dequantize_jnp(codes, scale, "bf16")),
+            dequantize(host),
+        )
+
+    def test_int8_absmax_bits_match(self):
+        from mpit_tpu import quant as qk
+
+        a = self._vectors()
+        host = quantize(a, "int8")
+        codes, scale = qk.quantize_jnp(a, "int8")
+        np.testing.assert_array_equal(np.asarray(codes), host.data)
+        # the scale itself is bit-equal, not approx: both paths divide
+        # in f32 (a float64 host division would double-round)
+        assert np.float32(host.scale).tobytes() == (
+            np.asarray(scale, np.float32).tobytes()
+        )
+        np.testing.assert_array_equal(
+            np.asarray(qk.dequantize_jnp(codes, scale, "int8")),
+            dequantize(host),
+        )
+        # all-zero block: scale pinned to 1 on both paths
+        z_codes, z_scale = qk.quantize_jnp(
+            np.zeros(5, np.float32), "int8"
+        )
+        assert float(z_scale) == quantize(
+            np.zeros(5, np.float32), "int8"
+        ).scale == 1.0
+
+    def test_blockwise_rows_equal_per_row_host_quantize(self):
+        from mpit_tpu import quant as qk
+
+        rng = np.random.default_rng(9)
+        a = rng.standard_normal((4, 64)).astype(np.float32) * 10
+        a[2] = 0.0  # one all-zero block
+        codes, scales = qk.quantize_rows_jnp(a, "int8")
+        for j in range(a.shape[0]):
+            host = quantize(a[j], "int8")
+            np.testing.assert_array_equal(np.asarray(codes)[j], host.data)
+            assert np.float32(host.scale).tobytes() == (
+                np.asarray(scales, np.float32)[j].tobytes()
+            )
+        np.testing.assert_array_equal(
+            np.asarray(qk.dequantize_rows_jnp(codes, scales, "int8")),
+            np.stack([dequantize(quantize(a[j], "int8"))
+                      for j in range(a.shape[0])]),
+        )
+
+
 class TestCoalescedScatter:
     def _world(self, center=0.0, **server_kw):
         tps = Broker(2).transports()

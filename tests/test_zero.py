@@ -106,6 +106,41 @@ class TestZero:
         with pytest.raises(ValueError, match="accum_steps"):
             za.step(st_z, x[:8], y[:8])  # per-worker 1 % 2 != 0
 
+    def test_quantized_scatter_tracks_raw(self, topo8):
+        """quant="int8" routes the reduce-scatter through the blockwise
+        quantized codes (stateless — docs/WIRE.md); the trajectory must
+        stay close to the raw scatter, and mode "off" must be it."""
+        model = LeNet(compute_dtype=jnp.float32)
+        opt = optax.sgd(0.1, momentum=0.9)
+        x, y = _data(n=32, seed=4)
+        results = {}
+        for mode in ("off", "int8"):
+            tr = ZeroDataParallelTrainer(
+                model, opt, topo8, donate_state=False, quant=mode
+            )
+            assert tr.quant == mode
+            st = tr.init_state(jax.random.key(0), x[:2])
+            losses = []
+            for _ in range(3):
+                st, m = tr.step(st, x, y)
+                losses.append(float(m["loss"]))
+            results[mode] = (
+                losses,
+                jax.tree.map(np.asarray, jax.device_get(st.params)),
+            )
+        assert all(np.isfinite(results["int8"][0]))
+        np.testing.assert_allclose(
+            results["int8"][0], results["off"][0], atol=2e-2
+        )
+        jax.tree.map(
+            lambda a, b: np.testing.assert_allclose(a, b, atol=5e-3),
+            results["int8"][1], results["off"][1],
+        )
+        with pytest.raises(ValueError, match="quant"):
+            ZeroDataParallelTrainer(
+                model, optax.sgd(0.1), topo8, quant="fp4"
+            )
+
     def test_cross_leaf_optimizer_rejected(self, topo8):
         """Global-norm clipping over a CHUNK would differ per device —
         the behavioral probe refuses it up front."""
