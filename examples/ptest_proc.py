@@ -98,13 +98,32 @@ def main():
     client_ranks = list(range(num_servers, world))
     bounds = partition_bounds(flat0.size, num_servers)
 
+    # elastic mode (docs/ROBUSTNESS.md): set by the supervising launcher
+    # (MPIT_ELASTIC_RESPAWN=1) — clients announce themselves with JOIN so
+    # a respawned replacement registers a fresh dedup epoch, servers
+    # snapshot their shard for kill→restore recovery, and exchange
+    # failures degrade to skipped rounds instead of killing the run.
+    elastic = os.environ.get("MPIT_ELASTIC_RESPAWN", "0") not in ("", "0")
+    ckpt_dir = os.environ.get("MPIT_ELASTIC_CKPT_DIR")
+    # elastic implies the dead-client watchdog: a restored server whose
+    # snapshot predates some client's STOP would otherwise wait forever
+    # for a rank that already exited cleanly and will never speak again
+    client_timeout = cfg.client_timeout
+    if client_timeout is None and elastic:
+        client_timeout = 15.0
+
     if rank < num_servers:
         start, end = bounds[rank]
         server = PServer(
             tp, flat0[start:end],
             num_clients=num_clients, alpha=alpha,
             client_ranks=client_ranks,
-            client_timeout=cfg.client_timeout,
+            client_timeout=client_timeout,
+            ckpt_path=(
+                os.path.join(ckpt_dir, f"shard_{rank}.msgpack")
+                if ckpt_dir else None
+            ),
+            ckpt_every=int(os.environ.get("MPIT_ELASTIC_CKPT_EVERY", "5")),
         )
         server.start()  # blocks until every client stopped (or died)
         print(
@@ -113,9 +132,13 @@ def main():
         )
     else:
         c = rank - num_servers
-        hb = cfg.client_timeout / 3 if cfg.client_timeout else None
+        hb = client_timeout / 3 if client_timeout else None
         client = PClient(
-            tp, server_ranks, flat0.size, heartbeat_interval=hb
+            tp, server_ranks, flat0.size, heartbeat_interval=hb,
+            # elastic: a killed server respawns within seconds — waiting
+            # the default 60s per attempt would stall its clients past
+            # the soak budget; short attempts + skipped rounds instead
+            timeout=15.0 if elastic else 60.0,
         )
         xs = shard_for_worker(x_tr, c, num_clients)
         ys = shard_for_worker(y_tr, c, num_clients)
@@ -127,6 +150,8 @@ def main():
             algo=cfg.resolved_algo().removeprefix("ps-")
             if cfg.algo.startswith("ps-") else "easgd",
             alpha=alpha, seed=cfg.seed + 1000 + c,
+            join=elastic,
+            max_exchange_failures=8 if elastic else None,
         )
         if c == 0:
             # final center fetch BEFORE stop (servers still serving)

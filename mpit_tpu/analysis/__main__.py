@@ -132,6 +132,12 @@ def _main_conform(argv) -> int:
         help="chaos fault log (default: faults*.jsonl inside obs_dir)",
     )
     parser.add_argument(
+        "--strict",
+        action="store_true",
+        help="ignore membership.jsonl: audit an elastic run's journals "
+        "with no churned-rank licensing (TC201/TC202 relaxations off)",
+    )
+    parser.add_argument(
         "--package",
         default=_default_scan_path(),
         help="package to extract the protocol from (default: mpit_tpu)",
@@ -152,7 +158,8 @@ def _main_conform(argv) -> int:
     bad = False
     for d in args.obs_dir:
         report = conformance.check_conformance(
-            d, project, faults_path=args.faults
+            d, project, faults_path=args.faults,
+            elastic=False if args.strict else None,
         )
         if not report.journals:
             print(
@@ -169,6 +176,7 @@ def _main_conform(argv) -> int:
                 "sends": report.sends,
                 "recvs": report.recvs,
                 "faults": report.faults,
+                "churned": report.churned,
                 "violations": [
                     {"rule": v.rule, "detail": v.detail}
                     for v in report.violations
@@ -178,11 +186,15 @@ def _main_conform(argv) -> int:
             for v in report.violations:
                 print(v)
             where = f" [{d}]" if len(args.obs_dir) > 1 else ""
+            elastic_note = (
+                f", elastic churn on rank(s) {report.churned}"
+                if report.churned else ""
+            )
             print(
                 f"{len(report.violations)} violation(s) in "
                 f"{len(report.journals)} journal(s): {report.sends} "
                 f"send(s), {report.recvs} recv(s), "
-                f"{report.faults} fault record(s)" + where
+                f"{report.faults} fault record(s)" + elastic_note + where
             )
     if args.json:
         # single-dir invocations keep the original flat document shape

@@ -83,17 +83,28 @@ class ModelSemantics:
     has_push: bool
     dedup: Optional[DedupModel]
     dedup_opaque: bool = False  # dedup exists but unmodelable: assume ok
+    #: the window is keyed per client incarnation (the ``(src, epoch)``
+    #: idiom) — a replacement client gets a fresh dedup slot
+    dedup_keyed_by_epoch: bool = False
+    #: the server's shard snapshot persists the dedup window WITH the
+    #: center/applied state (True), without it (False — the
+    #: crash-consistency bug the elastic config exists to catch), or
+    #: there is no snapshot machinery at all (None — restart schedules
+    #: still run, modeling restart-from-nothing)
+    snapshot_includes_dedup: Optional[bool] = None
 
 
 def from_protocol(sem) -> ModelSemantics:
     """ModelSemantics from a ``protocol.ProtocolSemantics``."""
     dedup = None
+    keyed = False
     if sem.dedup is not None:
         dedup = DedupModel(
             rejects_at_boundary=sem.dedup.rejects_at_boundary,
             checks_seen=sem.dedup.checks_seen,
             prunes_seen=sem.dedup.prunes_seen,
         )
+        keyed = sem.dedup.keyed_by_epoch
     return ModelSemantics(
         attempt_echoed=sem.attempt_echoed,
         attempt_checked=sem.attempt_checked,
@@ -101,6 +112,8 @@ def from_protocol(sem) -> ModelSemantics:
         has_push=bool(sem.push_tags),
         dedup=dedup,
         dedup_opaque=sem.dedup_opaque,
+        dedup_keyed_by_epoch=keyed,
+        snapshot_includes_dedup=sem.snapshot_includes_dedup,
     )
 
 
@@ -119,6 +132,11 @@ class ModelConfig:
     max_retries: int = 1
     kinds: tuple = FAULT_KINDS
     max_states: int = 500_000
+    #: elastic membership mode: clients carry an incarnation counter and
+    #: may be REPLACED mid-run (preemption + respawn from step 0, fresh
+    #: epoch), servers may snapshot and CRASH-RESTORE — a second,
+    #: independent single-fault budget on top of the network one
+    elastic: bool = False
 
     @property
     def label(self) -> str:
@@ -137,6 +155,23 @@ def default_configs(has_push: bool) -> tuple:
     return (
         ModelConfig(algo="easgd", script=("fetch", "push")),
         ModelConfig(algo="downpour", script=("push", "fetch")),
+    )
+
+
+def elastic_config() -> ModelConfig:
+    """The membership-churn configuration: 1 client whose process can be
+    replaced mid-run + 1 server that can snapshot and crash-restore.
+    One client is enough — the elastic hazards (a replacement's re-used
+    seqs vs the predecessor's window; a restored server's dedup vs its
+    restored applied set) are per-client-per-server, and the second
+    fault budget already multiplies the interleavings."""
+    return ModelConfig(
+        algo="easgd-elastic",
+        script=("fetch", "push"),
+        clients=1,
+        servers=1,
+        rounds=2,
+        elastic=True,
     )
 
 
@@ -164,6 +199,22 @@ class CheckResult:
 # msg    = (kind, src, dst, a, b, flags)
 #          REQ: a=attempt          REP: a=true_attempt, b=echo (-1 none)
 #          PUSH: a=seq             STOP: —
+#
+# elastic mode (cfg.elastic) extends every shape by one slot:
+# state  = (clients, servers, net, fault_available, elastic_available)
+# client = (stage, waiting, attempt, retries, pending, inc) — inc is the
+#          incarnation (the model's epoch); a REPLACE resets the client
+#          to stage 0 with inc+1 (a respawned process re-runs from step
+#          0) while attempt ids keep counting up (the implementation
+#          seeds them from the fresh epoch, so a replacement's ids are
+#          disjoint from its predecessor's by construction)
+# server = (stops, applied, dedup, snap) — applied keyed (c, inc, seq);
+#          dedup[c] is a TUPLE of per-inc windows when the extracted
+#          window is epoch-keyed, else a 1-tuple shared window; snap is
+#          None until the server takes its (applied, dedup-or-None)
+#          shard snapshot, after which CRASH restores from it (stops
+#          survive a crash: the membership view is in the snapshot)
+# PUSH   = (K_PUSH, c, s, seq, inc, flags) — the b slot carries inc
 
 
 def _canon(net) -> tuple:
@@ -277,6 +328,79 @@ def _apply_push(servers, s, c, seq, sem, cfg, viol):
             )
         applied = applied | {(c, seq)}
     return _set(servers, s, (stops, applied, ds))
+
+
+def _fresh_dedup(cfg) -> tuple:
+    """Elastic-mode zero dedup state: one empty window per client (the
+    keyed variant grows extra per-incarnation windows lazily)."""
+    return tuple(((0, frozenset()),) for _ in range(cfg.clients))
+
+
+def _apply_push_elastic(servers, s, c, seq, inc, sem, cfg, viol):
+    """Elastic-mode push application: the window is selected per
+    incarnation when the extracted dedup is epoch-keyed (a replacement
+    gets a fresh slot), shared otherwise — where a replacement's
+    re-used seqs collide with its predecessor's seen-set, the
+    wrongful-rejection half of MPT009."""
+    stops, applied, dedup, snap = servers[s]
+    key = (c, inc, seq)
+    keyed = sem.dedup_keyed_by_epoch
+    ds = dedup
+    if sem.dedup is not None:
+        windows = dedup[c]
+        idx = inc if keyed else 0
+        while len(windows) <= idx:
+            windows = windows + ((0, frozenset()),)
+        high, seen = windows[idx]
+        bound = high - cfg.window
+        if sem.dedup.rejects_at_boundary:
+            reject = seq <= bound
+        else:
+            reject = seq < bound
+        if not reject and sem.dedup.checks_seen and seq in seen:
+            reject = True
+        admitted = not reject
+        if admitted:
+            seen2 = seen | {seq}
+            if seq > high:
+                if sem.dedup.prunes_seen and len(seen2) > cfg.window:
+                    floor = seq - cfg.window
+                    seen2 = frozenset(x for x in seen2 if x > floor)
+                windows = _set(windows, idx, (seq, frozenset(seen2)))
+            else:
+                windows = _set(windows, idx, (high, frozenset(seen2)))
+        ds = _set(dedup, c, windows)
+    elif sem.dedup_opaque:
+        admitted = key not in applied
+    else:
+        admitted = True
+    if admitted:
+        if key in applied:
+            viol.setdefault(
+                "MPT009",
+                f"[{cfg.label}] push (client {c}, incarnation {inc}, "
+                f"seq {seq}) applied TWICE by one server: a redelivered "
+                "copy passed the dedup admit after a crash-restore lost "
+                "the window state that had recorded it",
+            )
+        applied = applied | {key}
+    elif (
+        sem.dedup is not None
+        and not keyed
+        and key not in applied
+        and any(t[0] == c and t[2] == seq and t[1] != inc for t in applied)
+    ):
+        # the window is NOT keyed by incarnation: this fresh push was
+        # swallowed because a PREVIOUS incarnation of the client used
+        # the same seq — a replacement silently loses its first pushes
+        viol.setdefault(
+            "MPT009",
+            f"[{cfg.label}] push (client {c}, incarnation {inc}, seq "
+            f"{seq}) wrongfully REJECTED: the dedup window is not keyed "
+            "by client epoch, so the replacement process's push was "
+            "mistaken for its predecessor's replay and dropped",
+        )
+    return _set(servers, s, (stops, applied, ds, snap))
 
 
 def _starved(net, c, att, pending, sem) -> bool:
@@ -415,8 +539,198 @@ def _successors(state, sem, cfg, viol, points) -> list:
     return out
 
 
+def _successors_elastic(state, sem, cfg, viol, points) -> list:
+    """Elastic-mode successor relation: the base protocol moves (with
+    incarnation-aware pushes) plus three membership transitions —
+    server SNAPSHOT (persist applied+window, once), server CRASH-RESTORE
+    (roll back to the snapshot, or to nothing; spends the elastic fault
+    budget), and client REPLACE (preempt + respawn from step 0 with a
+    fresh incarnation; spends the same budget)."""
+    clients, servers, net, avail, eavail = state
+    out = []
+    deliv = _deliverable(net)
+    steps = len(cfg.script)
+    n_stages = cfg.rounds * steps
+    all_clients = frozenset(range(cfg.clients))
+
+    # -- server deliveries (handle + reply are one atomic step)
+    for i in deliv:
+        m = net[i]
+        kind = m[0]
+        if kind == K_REP:
+            continue
+        s = m[2]
+        stops = servers[s][0]
+        if stops == all_clients:
+            continue  # server exited its loop; late messages park
+        rest = net[:i] + net[i + 1:]
+        if kind == K_REQ:
+            c, att = m[1], m[3]
+            echo = att if sem.attempt_echoed else -1
+            rep = (K_REP, s, c, att, echo, 0)
+            for added, av2 in _variants([rep], avail, cfg.kinds, points):
+                out.append((clients, servers, rest + added, av2, eavail))
+        elif kind == K_PUSH:
+            srv2 = _apply_push_elastic(
+                servers, s, m[1], m[3], m[4], sem, cfg, viol
+            )
+            out.append((clients, srv2, rest, avail, eavail))
+        else:  # STOP
+            srv2 = _set(
+                servers, s, (stops | {m[1]},) + servers[s][1:]
+            )
+            out.append((clients, srv2, rest, avail, eavail))
+
+    # -- membership transitions
+    for s, sv in enumerate(servers):
+        stops, applied, dedup, snap = sv
+        if stops == all_clients:
+            continue  # server done — nothing left to snapshot or lose
+        if snap is None and sem.snapshot_includes_dedup is not None:
+            # take THE shard snapshot (once per run keeps the state
+            # space tight; one snapshot point is enough to exhibit any
+            # snapshot-consistency bug)
+            snap2 = (
+                applied,
+                dedup if sem.snapshot_includes_dedup else None,
+            )
+            out.append((
+                clients, _set(servers, s, (stops, applied, dedup, snap2)),
+                net, avail, eavail,
+            ))
+        if eavail:
+            # crash + restore: everything since the snapshot (or since
+            # boot) rolls back TOGETHER — applied-and-unpersisted pushes
+            # disappear from `applied` because the center they mutated
+            # rolled back with them, so their redelivery re-applying is
+            # correct, not a double-apply. The membership view (stops)
+            # is in the snapshot, so it survives.
+            if snap is not None:
+                r_applied, r_dedup = snap
+                if r_dedup is None:
+                    r_dedup = _fresh_dedup(cfg)
+            else:
+                r_applied, r_dedup = frozenset(), _fresh_dedup(cfg)
+            out.append((
+                clients,
+                _set(servers, s, (stops, r_applied, r_dedup, snap)),
+                net, avail, False,
+            ))
+    if eavail:
+        for c, cl in enumerate(clients):
+            if cl[0] > n_stages:
+                continue  # already done — nothing left to preempt
+            # REPLACE: the process is killed and respawned — it re-runs
+            # from step 0 (seq numbering restarts) under a fresh
+            # incarnation; attempt ids keep counting (epoch-seeded
+            # disjointness in the implementation)
+            cl2 = (0, False, cl[2], 0, frozenset(), cl[5] + 1)
+            out.append(
+                (_set(clients, c, cl2), servers, net, avail, False)
+            )
+
+    # -- client moves
+    for c, cl in enumerate(clients):
+        stage, waiting, att, retries, pending, inc = cl
+        if stage > n_stages:
+            continue  # done
+        if waiting:
+            for i in deliv:
+                m = net[i]
+                if m[0] != K_REP or m[2] != c:
+                    continue
+                rest = net[:i] + net[i + 1:]
+                true_att, s = m[3], m[1]
+                if true_att != att:
+                    if sem.attempt_echoed and sem.attempt_checked:
+                        # stale reply detected and dropped (consumed)
+                        out.append(
+                            (clients, servers, rest, avail, eavail)
+                        )
+                        continue
+                    viol.setdefault(
+                        "MPT011",
+                        f"[{cfg.label}] client {c} assembled a reply "
+                        f"generated for attempt {true_att} into its live "
+                        f"attempt {att} — "
+                        + (
+                            "the echoed attempt id is never compared "
+                            "to the live one"
+                            if sem.attempt_echoed
+                            else "replies carry no attempt id, so stale "
+                            "ones are indistinguishable from fresh"
+                        ),
+                    )
+                pend2 = pending - {s}
+                if pend2:
+                    cl2 = (stage, True, att, retries, pend2, inc)
+                else:
+                    cl2 = (stage + 1, False, att, 0, frozenset(), inc)
+                out.append(
+                    (_set(clients, c, cl2), servers, rest, avail, eavail)
+                )
+            if sem.reply_recv_timeout and _starved(
+                net, c, att, pending, sem
+            ):
+                if retries < cfg.max_retries:
+                    att2 = att + 1
+                    reqs = [
+                        (K_REQ, c, s, att2, 0, 0) for s in sorted(pending)
+                    ]
+                    cl2 = (stage, True, att2, retries + 1, pending, inc)
+                    for added, av2 in _variants(
+                        reqs, avail, cfg.kinds, points
+                    ):
+                        out.append((
+                            _set(clients, c, cl2), servers, net + added,
+                            av2, eavail,
+                        ))
+                else:
+                    # retries exhausted: skip the round (the ps_roles
+                    # graceful-degradation path), resume next round
+                    stage2 = (stage // steps + 1) * steps
+                    cl2 = (stage2, False, att, 0, frozenset(), inc)
+                    out.append(
+                        (_set(clients, c, cl2), servers, net, avail,
+                         eavail)
+                    )
+            continue
+        if stage == n_stages:
+            msgs = tuple(
+                (K_STOP, c, s, 0, 0, 0) for s in range(cfg.servers)
+            )
+            cl2 = (stage + 1, False, att, 0, frozenset(), inc)
+            out.append(
+                (_set(clients, c, cl2), servers, net + msgs, avail,
+                 eavail)
+            )
+        elif cfg.script[stage % steps] == "fetch":
+            att2 = att + 1
+            reqs = [(K_REQ, c, s, att2, 0, 0) for s in range(cfg.servers)]
+            cl2 = (
+                stage, True, att2, 0, frozenset(range(cfg.servers)), inc
+            )
+            for added, av2 in _variants(reqs, avail, cfg.kinds, points):
+                out.append((
+                    _set(clients, c, cl2), servers, net + added, av2,
+                    eavail,
+                ))
+        else:  # push
+            seq = stage // steps + 1
+            msgs = [
+                (K_PUSH, c, s, seq, inc, 0) for s in range(cfg.servers)
+            ]
+            cl2 = (stage + 1, False, att, 0, frozenset(), inc)
+            for added, av2 in _variants(msgs, avail, cfg.kinds, points):
+                out.append((
+                    _set(clients, c, cl2), servers, net + added, av2,
+                    eavail,
+                ))
+    return out
+
+
 def _terminal(state, cfg) -> bool:
-    clients, servers, _net, _avail = state
+    clients, servers = state[0], state[1]
     n_stages = cfg.rounds * len(cfg.script)
     all_clients = frozenset(range(cfg.clients))
     return all(cl[0] > n_stages for cl in clients) and all(
@@ -425,7 +739,7 @@ def _terminal(state, cfg) -> bool:
 
 
 def _describe_stuck(state, cfg) -> str:
-    clients, servers, net, _avail = state
+    clients, servers, net = state[0], state[1], state[2]
     blocked = [
         f"client {c} waiting on server(s) {sorted(cl[4])} "
         f"(attempt {cl[2]})"
@@ -453,18 +767,30 @@ def check(sem: ModelSemantics, cfg: Optional[ModelConfig] = None
     carries its first witness; ``states`` is the visited-set size (the
     exhaustiveness receipt the CLI prints)."""
     cfg = cfg or ModelConfig()
-    clients0 = tuple(
-        (0, False, 0, 0, frozenset()) for _ in range(cfg.clients)
-    )
-    servers0 = tuple(
-        (
-            frozenset(),
-            frozenset(),
-            tuple((0, frozenset()) for _ in range(cfg.clients)),
+    if cfg.elastic:
+        clients0 = tuple(
+            (0, False, 0, 0, frozenset(), 0) for _ in range(cfg.clients)
         )
-        for _ in range(cfg.servers)
-    )
-    init = (clients0, servers0, (), True)
+        servers0 = tuple(
+            (frozenset(), frozenset(), _fresh_dedup(cfg), None)
+            for _ in range(cfg.servers)
+        )
+        init = (clients0, servers0, (), True, True)
+        succ_fn = _successors_elastic
+    else:
+        clients0 = tuple(
+            (0, False, 0, 0, frozenset()) for _ in range(cfg.clients)
+        )
+        servers0 = tuple(
+            (
+                frozenset(),
+                frozenset(),
+                tuple((0, frozenset()) for _ in range(cfg.clients)),
+            )
+            for _ in range(cfg.servers)
+        )
+        init = (clients0, servers0, (), True)
+        succ_fn = _successors
     visited = {init}
     stack = [init]
     viol: dict = {}
@@ -472,13 +798,13 @@ def check(sem: ModelSemantics, cfg: Optional[ModelConfig] = None
     truncated = False
     while stack:
         st = stack.pop()
-        succ = _successors(st, sem, cfg, viol, points)
+        succ = succ_fn(st, sem, cfg, viol, points)
         if not succ:
             if not _terminal(st, cfg):
                 viol.setdefault("MPT010", _describe_stuck(st, cfg))
             continue
         for s2 in succ:
-            s2 = (s2[0], s2[1], _canon(s2[2]), s2[3])
+            s2 = s2[:2] + (_canon(s2[2]),) + s2[3:]
             if s2 in visited:
                 continue
             if len(visited) >= cfg.max_states:
@@ -496,6 +822,16 @@ def check(sem: ModelSemantics, cfg: Optional[ModelConfig] = None
 
 
 def check_all(sem: ModelSemantics, configs=None) -> list:
-    """One CheckResult per configuration (default: the acceptance pair)."""
-    configs = configs or default_configs(sem.has_push)
+    """One CheckResult per configuration (default: the acceptance pair,
+    plus the elastic-membership configuration when the protocol has the
+    machinery it exercises — an epoch-keyed dedup window or shard
+    snapshot persistence; a bare dedup'd protocol with neither would
+    fail elastic schedules it never claims to survive)."""
+    if configs is None:
+        configs = default_configs(sem.has_push)
+        if sem.dedup is not None and (
+            sem.dedup_keyed_by_epoch
+            or sem.snapshot_includes_dedup is not None
+        ):
+            configs = tuple(configs) + (elastic_config(),)
     return [check(sem, cfg) for cfg in configs]

@@ -339,6 +339,12 @@ class DedupSemantics:
     checks_seen: bool
     prunes_seen: bool
     window_default: Optional[int]
+    #: the window key is a tuple of several identity parameters (the
+    #: ``key = (src, epoch)`` idiom) — a replacement client's fresh
+    #: epoch gets a fresh window instead of inheriting its
+    #: predecessor's seen-set; False = keyed by source only (or not
+    #: at all), where a replacement's re-used seqs would be swallowed
+    keyed_by_epoch: bool = False
 
 
 @dataclasses.dataclass(frozen=True)
@@ -358,6 +364,12 @@ class ProtocolSemantics:
     dedup_opaque: bool  # an admit exists but matches no modeled idiom
     reply_send: Optional[ProtoOp]  # anchors for findings
     reply_recv: Optional[ProtoOp]
+    #: does the server's shard snapshot persist the dedup window next
+    #: to the center+version (the crash-consistency idiom of
+    #: ``_snapshot_state``)? True/False when a snapshot dict was found
+    #: and classified; None = no snapshot machinery in the scan set
+    #: (the model checker then skips restart schedules entirely)
+    snapshot_includes_dedup: Optional[bool] = None
 
     @property
     def has_fault_machinery(self) -> bool:
@@ -585,6 +597,22 @@ def _extract_dedup(server, by_rel):
                 isinstance(sub, (ast.SetComp, ast.ListComp))
                 for sub in ast.walk(node)
             )
+            # the `key = (src, epoch)` idiom: a tuple of TWO OR MORE
+            # identity parameters (the seq param excluded) built inside
+            # admit means the window is keyed per client incarnation —
+            # the property that keeps a replacement's re-used seqs from
+            # being swallowed by its predecessor's window
+            keyed = any(
+                isinstance(sub, ast.Tuple)
+                and len(sub.elts) >= 2
+                and all(
+                    isinstance(e, ast.Name)
+                    and e.id in params
+                    and e.id != seq
+                    for e in sub.elts
+                )
+                for sub in ast.walk(node)
+            )
             return (
                 DedupSemantics(
                     rel=mod.rel,
@@ -595,10 +623,44 @@ def _extract_dedup(server, by_rel):
                     checks_seen=checks_seen,
                     prunes_seen=prunes,
                     window_default=_admit_window_default(node, mod),
+                    keyed_by_epoch=keyed,
                 ),
                 True,
             )
     return None, False
+
+
+def _extract_snapshot_dedup(server, by_rel) -> Optional[bool]:
+    """Does the server's shard-snapshot dict carry the dedup window next
+    to the center and version counter? Recognized idiom: a server-role
+    function whose name mentions ``persist`` or ``snapshot`` building a
+    dict literal with string keys including both ``"center"`` and
+    ``"version"`` — that dict IS the snapshot; the verdict is whether a
+    ``"dedup"`` key rides in it. None when no such dict exists (no
+    snapshot machinery — nothing for restart schedules to model)."""
+    for rel in server.rels:
+        mod = by_rel.get(rel)
+        if mod is None:
+            continue
+        for node in ast.walk(mod.tree):
+            if not isinstance(
+                node, (ast.FunctionDef, ast.AsyncFunctionDef)
+            ) or not (
+                "persist" in node.name or "snapshot" in node.name
+            ):
+                continue
+            for sub in ast.walk(node):
+                if not isinstance(sub, ast.Dict):
+                    continue
+                keys = {
+                    k.value
+                    for k in sub.keys
+                    if isinstance(k, ast.Constant)
+                    and isinstance(k.value, str)
+                }
+                if "center" in keys and "version" in keys:
+                    return "dedup" in keys
+    return None
 
 
 def extract_semantics(project) -> Optional[ProtocolSemantics]:
@@ -657,4 +719,5 @@ def extract_semantics(project) -> Optional[ProtocolSemantics]:
         reply_recv=_first(
             [op for op in client.concrete_recvs if op.tag == reply_tag]
         ),
+        snapshot_includes_dedup=_extract_snapshot_dedup(server, by_rel),
     )

@@ -14,17 +14,30 @@ MPI's hang-on-dead-rank, SURVEY.md §5). Output is line-prefixed with the
 rank, mpirun-style. Single-host by design — across hosts you run one
 process per host yourself and set ``MPIT_TRANSPORT_HOSTS`` to the real
 addresses (same env contract).
+
+Elastic supervision (docs/ROBUSTNESS.md): with ``MPIT_ELASTIC_RESPAWN=1``
+a rank that dies (crash OR the built-in seeded chaos killer,
+``MPIT_ELASTIC_KILL_EVERY_S``) is respawned in place — same rank, same
+port (SocketTransport sets SO_REUSEADDR; peers reconnect inside their
+connect-retry window) — up to ``MPIT_ELASTIC_MAX_RESPAWNS`` times per
+rank, with ``MPIT_RESPAWN_GEN`` exported so the child knows its restart
+generation. Every membership transition is journaled to
+``$MPIT_OBS_DIR/membership.jsonl`` so trace conformance can tell a
+preemption-severed journal from a real protocol violation.
 """
 
 from __future__ import annotations
 
 import argparse
+import json
 import os
+import random
 import signal
 import socket
 import subprocess
 import sys
 import threading
+import time
 
 
 def _reserve_ports(n: int) -> tuple[list[socket.socket], list[int]]:
@@ -129,35 +142,70 @@ def main(argv=None) -> int:
         coord_sock, coord_port = reserving.pop(), ports.pop()
     hosts = ",".join(f"127.0.0.1:{port}" for port in ports)
 
+    # elastic supervision knobs (docs/ROBUSTNESS.md "Elastic membership")
+    elastic = os.environ.get("MPIT_ELASTIC_RESPAWN", "0") not in ("", "0")
+    max_respawns = int(os.environ.get("MPIT_ELASTIC_MAX_RESPAWNS", "3"))
+    kill_every = float(os.environ.get("MPIT_ELASTIC_KILL_EVERY_S", "0") or 0)
+    kill_seed = int(os.environ.get("MPIT_ELASTIC_KILL_SEED", "0"))
+    obs_dir = os.environ.get("MPIT_OBS_DIR")
+    mem_path = (
+        os.path.join(obs_dir, "membership.jsonl")
+        if elastic and obs_dir else None
+    )
+    mem_lock = threading.Lock()
+    t0 = time.monotonic()
+
+    def _member(kind: str, rank: int, gen: int, **extra) -> None:
+        """One membership transition in the run's obs directory — the
+        ground truth conformance uses to license journal gaps on
+        churned ranks (a SIGKILLed process cannot flush its tail)."""
+        if mem_path is None:
+            return
+        rec = {
+            "ev": "membership", "kind": kind, "rank": rank, "gen": gen,
+            "t": round(time.monotonic() - t0, 3), **extra,
+        }
+        with mem_lock:
+            os.makedirs(os.path.dirname(mem_path), exist_ok=True)
+            with open(mem_path, "a") as f:
+                f.write(json.dumps(rec) + "\n")
+
     procs: list[subprocess.Popen] = []
     streams: list[threading.Thread] = []
+
+    def _spawn(rank: int, gen: int) -> subprocess.Popen:
+        env = dict(os.environ)
+        env["MPIT_RANK"] = str(rank)
+        env["MPIT_WORLD_SIZE"] = str(ns.n)
+        env["MPIT_TRANSPORT_HOSTS"] = hosts
+        if coord_port is not None:
+            env["MPIT_DISTRIBUTED"] = "1"
+            env["JAX_COORDINATOR_ADDRESS"] = f"127.0.0.1:{coord_port}"
+        if elastic:
+            env["MPIT_RESPAWN_GEN"] = str(gen)
+        proc = subprocess.Popen(
+            [sys.executable, ns.script, *ns.args],
+            env=env,
+            stdout=subprocess.PIPE,
+            stderr=subprocess.STDOUT,
+        )
+        t = threading.Thread(
+            target=_stream, args=(rank, proc.stdout, sys.stdout.buffer),
+            daemon=True,
+        )
+        t.start()
+        streams.append(t)
+        _member("respawn" if gen else "spawn", rank, gen)
+        return proc
+
     try:
         for rank in range(ns.n):
-            env = dict(os.environ)
-            env["MPIT_RANK"] = str(rank)
-            env["MPIT_WORLD_SIZE"] = str(ns.n)
-            env["MPIT_TRANSPORT_HOSTS"] = hosts
-            if coord_port is not None:
-                env["MPIT_DISTRIBUTED"] = "1"
-                env["JAX_COORDINATOR_ADDRESS"] = f"127.0.0.1:{coord_port}"
             # release this rank's port only now, right before its process
             # exists (and the coordinator port with rank 0, which binds it)
             if rank == 0 and coord_sock is not None:
                 coord_sock.close()
             reserving[rank].close()
-            proc = subprocess.Popen(
-                [sys.executable, ns.script, *ns.args],
-                env=env,
-                stdout=subprocess.PIPE,
-                stderr=subprocess.STDOUT,
-            )
-            procs.append(proc)
-            t = threading.Thread(
-                target=_stream, args=(rank, proc.stdout, sys.stdout.buffer),
-                daemon=True,
-            )
-            t.start()
-            streams.append(t)
+            procs.append(_spawn(rank, 0))
     except BaseException:
         # a failed spawn mid-loop must not strand reservations (they'd stay
         # bound for the launcher's lifetime) or leave earlier ranks spinning
@@ -170,24 +218,79 @@ def main(argv=None) -> int:
             proc.terminate()
         raise
 
+    # seeded chaos killer: SIGKILL a random respawnable rank on a timer —
+    # the soak harness's preemption source (never the last rank standing,
+    # never a rank whose respawn budget is spent)
+    gens = [0] * ns.n
+    budget = [max_respawns if elastic else 0] * ns.n
+    procs_lock = threading.Lock()
+    killer_stop = threading.Event()
+    if elastic and kill_every > 0:
+        rng_k = random.Random(kill_seed)
+
+        def _killer() -> None:
+            while not killer_stop.wait(kill_every):
+                with procs_lock:
+                    alive = [
+                        r for r in range(ns.n) if procs[r].poll() is None
+                    ]
+                    victims = [r for r in alive if budget[r] > 0]
+                    if len(alive) <= 1 or not victims:
+                        continue
+                    r = rng_k.choice(victims)
+                    try:
+                        procs[r].kill()
+                    except (ProcessLookupError, OSError):
+                        continue
+                    _member("kill", r, gens[r])
+
+        threading.Thread(
+            target=_killer, daemon=True, name="mpit-elastic-killer"
+        ).start()
+
     rc = 0
     try:
         remaining = set(range(ns.n))
+        world_down = False
         while remaining:
             for r in sorted(remaining):
                 code = procs[r].poll()
                 if code is None:
                     continue
-                remaining.discard(r)
-                if code != 0 and rc == 0:
-                    rc = code
+                if code == 0:
+                    remaining.discard(r)
+                    _member("done", r, gens[r])
+                    continue
+                if world_down:
+                    remaining.discard(r)
+                    continue
+                _member("exit", r, gens[r], code=code)
+                if budget[r] > 0:
+                    # elastic: the rank died with budget left — respawn it
+                    # in place (same rank/port, next generation) instead
+                    # of taking the world down
+                    budget[r] -= 1
+                    gens[r] += 1
+                    with procs_lock:
+                        procs[r] = _spawn(r, gens[r])
                     print(
                         f"[launch] rank {r} exited with {code}; "
-                        "terminating the world",
+                        f"respawned as gen {gens[r]} "
+                        f"({budget[r]} respawn(s) left)",
                         file=sys.stderr,
                     )
-                    for other in sorted(remaining):
-                        procs[other].terminate()
+                    continue
+                remaining.discard(r)
+                if rc == 0:
+                    rc = code
+                print(
+                    f"[launch] rank {r} exited with {code}; "
+                    "terminating the world",
+                    file=sys.stderr,
+                )
+                world_down = True
+                for other in sorted(remaining):
+                    procs[other].terminate()
             if remaining:
                 try:
                     procs[min(remaining)].wait(timeout=0.2)
@@ -197,6 +300,8 @@ def main(argv=None) -> int:
         for proc in procs:
             proc.send_signal(signal.SIGINT)
         rc = 130
+    finally:
+        killer_stop.set()
     for proc in procs:
         proc.wait()
     for t in streams:

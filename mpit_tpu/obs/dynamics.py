@@ -104,6 +104,7 @@ def aggregate_dynamics(journal_paths: Iterable[str]) -> dict:
     trajectories: dict[int, list] = {}
     staleness: dict[int, dict] = {}
     servers: dict[int, dict] = {}
+    last_gv: dict[int, tuple] = {}  # per-server (gen, version) high-water
 
     for path in expand_journal_paths(journal_paths):
         for rec in read_journal(path):
@@ -142,13 +143,25 @@ def aggregate_dynamics(journal_paths: Iterable[str]) -> dict:
                 v = rec.get("version")
                 if not isinstance(v, int):
                     continue
+                # restart generation (elastic runs): a restored server
+                # resumes from its last snapshot, so versions may step
+                # back across a gen bump — monotonicity is per (gen,
+                # version) lexicographic order, mirroring TC204
+                g = rec.get("gen", 0)
+                if not isinstance(g, int):
+                    g = 0
                 srv = servers.setdefault(rank, {
                     "param_replies": 0, "first_version": v,
                     "final_version": v, "monotonic": True,
+                    "restores": 0,
                 })
                 srv["param_replies"] += 1
-                if v < srv["final_version"]:
+                pg, pv = last_gv.get(rank, (g, v))
+                if (g, v) < (pg, pv):
                     srv["monotonic"] = False
+                if g > pg:
+                    srv["restores"] += g - pg
+                last_gv[rank] = max(last_gv.get(rank, (g, v)), (g, v))
                 srv["final_version"] = max(srv["final_version"], v)
 
     for rank, traj in trajectories.items():

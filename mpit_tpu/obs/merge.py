@@ -57,6 +57,8 @@ TAG_NAMES = {
     4: "PARAM",
     5: "STOP",
     6: "HEARTBEAT",
+    7: "JOIN",
+    8: "LEAVE",
 }
 
 

@@ -14,6 +14,11 @@ constant), so it folds into the kernel.
 `interpret=True` runs the same kernel on CPU (tests); the public wrapper
 falls back to plain XLA elementwise ops when pallas is unusable.
 
+Naming: "elastic" here is EASGD's elastic *force* — the update math.
+Elastic *membership* (ranks joining/leaving/preempted mid-run) is
+:mod:`mpit_tpu.parallel.elastic`, which shares nothing with this kernel
+but the paper's adjective.
+
 Measured (single v5e chip, 25M-element f32 operands, 2026-07): bit-exact
 equality with the XLA path; XLA's own fusion was ~2.7x faster per call than
 this kernel (grid/dispatch overhead dominates a pure-bandwidth op), which is

@@ -43,6 +43,8 @@ import numpy as np
 from mpit_tpu.parallel.pserver import (
     TAG_FETCH,
     TAG_HEARTBEAT,
+    TAG_JOIN,
+    TAG_LEAVE,
     TAG_PARAM,
     TAG_PUSH_DELTA,
     TAG_PUSH_EASGD,
@@ -140,7 +142,11 @@ class PClient:
         # identity for the server-side dedup window: a replacement client
         # on a reused rank must not look like replays of its predecessor
         self._epoch = int.from_bytes(os.urandom(8), "big")
-        self._attempt_ids = itertools.count(1)
+        # attempt ids are seeded from the epoch so a replacement process
+        # on a reused rank can never match a PARAM reply parked in the
+        # transport for its predecessor's attempt — same disjointness
+        # the epoch gives the push dedup window, applied to fetches
+        self._attempt_ids = itertools.count(((self._epoch & 0xFFFFFF) << 24) + 1)
         self._push_seq = itertools.count(1)
         self.push_sent: dict[int, int] = {r: 0 for r in self.server_ranks}
         # center version last seen per server (stamped into attempt-id'd
@@ -197,6 +203,11 @@ class PClient:
         self.transport.send(rank, TAG_FETCH, attempt_id)
         return attempt_id
 
+    def _send_join(self, rank: int) -> int:
+        attempt_id = next(self._attempt_ids)
+        self.transport.send(rank, TAG_JOIN, (attempt_id, self._epoch))
+        return attempt_id
+
     def _chunk_ok(self, chunk, expected: int) -> Optional[np.ndarray]:
         """float32 view of a PARAM chunk, or None when the reply is
         malformed (chaos ``corrupt`` replaced the frame, ``truncate`` cut
@@ -227,7 +238,8 @@ class PClient:
         return arr
 
     def _await_param(
-        self, rank: int, attempt_id: Optional[int], expected: int
+        self, rank: int, attempt_id: Optional[int], expected: int,
+        resend=None,
     ) -> np.ndarray:
         """Collect one server's PARAM chunk, retrying the whole
         FETCH→PARAM attempt on timeout or send failure. Replies tagged
@@ -237,13 +249,15 @@ class PClient:
         likewise discarded — the wait continues and the per-attempt
         timeout re-issues the FETCH, so a mangled reply is a retriable
         failure, never a crash or a junk-assembled vector."""
+        if resend is None:
+            resend = self._send_fetch
         last_exc: Optional[BaseException] = None
         for attempt in range(self.max_retries + 1):
             if attempt > 0:
                 self._backoff(attempt - 1)
-            if attempt_id is None:  # (re)issue this attempt's FETCH
+            if attempt_id is None:  # (re)issue this attempt's request
                 try:
-                    attempt_id = self._send_fetch(rank)
+                    attempt_id = resend(rank)
                 except (ConnectionError, OSError) as e:
                     last_exc = e
                     continue
@@ -334,6 +348,29 @@ class PClient:
             )
         return out
 
+    def join(self) -> np.ndarray:
+        """Announce this client's (rank, epoch) to every server and
+        gather the full flat center — the elastic-membership entry
+        point (docs/ROBUSTNESS.md). Same fan-out/retry/attempt-id shape
+        as :meth:`fetch`, but the JOIN envelope also registers this
+        process's push-identity epoch with the server's membership
+        view: a fresh process on a reused rank is recorded as a
+        "replace" (clean dedup slot, dead flag cleared), a reconnecting
+        preempted one as a "rejoin" — instead of being mistaken for a
+        replay of its predecessor."""
+        attempts: dict[int, Optional[int]] = {}
+        for rank in self.ranks:
+            try:
+                attempts[rank] = self._send_join(rank)
+            except (ConnectionError, OSError):
+                attempts[rank] = None  # the retry path re-sends
+        out = np.empty(self.param_size, np.float32)
+        for rank, (start, end) in zip(self.ranks, self.rank_bounds):
+            out[start:end] = self._await_param(
+                rank, attempts[rank], end - start, resend=self._send_join
+            )
+        return out
+
     def push_easgd(self, flat_params: np.ndarray) -> None:
         """Push local params; each server does its elastic center move."""
         self._scatter(TAG_PUSH_EASGD, flat_params)
@@ -349,18 +386,37 @@ class PClient:
         rest would leave healthy servers waiting for a STOP that never
         comes (until their watchdog fires). Errors are collected and
         re-raised as one aggregate at the end."""
+        self._shutdown_heartbeat()
+        self._detach_all(TAG_STOP, "STOP")
+
+    def leave(self) -> None:
+        """Planned departure (preemption notice): tell every server this
+        rank is going away WITHOUT counting as a normal STOP — the
+        membership view moves it to ``left`` immediately instead of
+        waiting for the watchdog to declare it dead. Same all-servers /
+        aggregate-errors contract as :meth:`stop`."""
+        self._shutdown_heartbeat()
+        self._detach_all(TAG_LEAVE, "LEAVE")
+
+    def _shutdown_heartbeat(self) -> None:
+        """Signal and join the heartbeat timer thread; idempotent so
+        stop()/leave() can be called more than once (or after each
+        other) without a second join on a dead thread."""
         self._hb_stop.set()
         if self._hb_thread is not None:
             self._hb_thread.join(timeout=5)
+            self._hb_thread = None
+
+    def _detach_all(self, tag: int, what: str) -> None:
         errors: list[tuple[int, BaseException]] = []
         for rank in self.server_ranks:
             try:
-                self._send_with_retry(rank, TAG_STOP, None)
+                self._send_with_retry(rank, tag, None)
             except Exception as e:
                 errors.append((rank, e))
         if errors:
             raise RuntimeError(
-                "STOP failed for server rank(s) "
+                f"{what} failed for server rank(s) "
                 f"{[r for r, _ in errors]}: "
                 f"{'; '.join(repr(e) for _, e in errors)}"
             ) from errors[0][1]

@@ -146,6 +146,7 @@ def client_train_loop(
     seed: int,
     max_exchange_failures: Optional[int] = None,
     exchange_stats: Optional[dict] = None,
+    join: bool = False,
 ) -> list[float]:
     """The pclient side of SURVEY.md §3(b): τ jit-compiled local steps, then
     push/pull per ``algo`` ("easgd" or "downpour"). Returns per-step losses.
@@ -160,6 +161,12 @@ def client_train_loop(
     (any success resets the count). ``None`` keeps fail-fast semantics.
     ``exchange_stats`` (when provided) is filled with
     ``{"skipped_rounds", "exchange_failures"}`` totals.
+
+    ``join``: announce this client via the elastic-membership JOIN
+    envelope for its initial pull instead of a plain fetch — required
+    for elastic runs (a respawned replacement process must register its
+    fresh push-identity epoch with the server; docs/ROBUSTNESS.md).
+    Off by default: non-elastic runs keep their exact fetch counts.
 
     Loss scalars stay ON DEVICE between exchanges and are host-fetched in
     one batched transfer at each τ boundary (where the param flatten
@@ -187,7 +194,8 @@ def client_train_loop(
     # (docs/OBSERVABILITY.md) — each span groups one exchange's wire
     # traffic under a single trace on the merged timeline
     with obs_span(client.transport, "initial_fetch"):
-        params = unflatten_params(spec, jnp.asarray(client.fetch()))
+        initial = client.join() if join else client.fetch()
+        params = unflatten_params(spec, jnp.asarray(initial))
     opt_state = optimizer.init(params)
     last_pull = np.asarray(flatten_params(params)[0])
     # training-dynamics plane: armed iff the transport is obs-wrapped —

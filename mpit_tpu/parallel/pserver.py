@@ -21,6 +21,13 @@ Protocol tags (client → server unless noted):
   PARAM       ((attempt_id, version, chunk) | chunk)  server → client reply
   STOP        ()                 client detaches; server exits when all did
   HEARTBEAT   ()                 liveness only (refreshes the watchdog)
+  JOIN        ((attempt_id, epoch))  membership handshake; server registers
+                                 the (rank, epoch) pair in its elastic
+                                 membership view and replies PARAM exactly
+                                 like a FETCH would
+  LEAVE       ()                 planned departure (preemption notice) —
+                                 the rank stops counting toward teardown
+                                 without waiting for the watchdog
 
 Fault-tolerant envelopes (docs/ROBUSTNESS.md): a FETCH carrying an
 ``attempt_id`` gets it echoed in the PARAM reply, so a client whose
@@ -55,6 +62,19 @@ than the timeout is declared dead and no longer blocks teardown. Any
 message — including the zero-cost HEARTBEAT a PClient can emit from a timer
 thread during long local compute — refreshes liveness, and a late message
 from a declared-dead client revives it.
+
+Elastic membership + checkpointed recovery (docs/ROBUSTNESS.md "Elastic
+membership"): JOIN/REJOIN/LEAVE envelopes drive the
+:class:`~mpit_tpu.parallel.elastic.ElasticMembership` view, so a
+replacement process on a killed rank re-enters the run mid-flight
+instead of staying in ``dead_clients`` forever. With a non-``.npy``
+``ckpt_path``, :meth:`persist` writes a full shard snapshot (center +
+version + restart generation + dedup window + membership, one atomic
+msgpack file via ``utils/checkpoint.save_shard_state``) instead of the
+legacy bare-center ``np.save``; a restarted server restores all of it,
+so acked pushes are never double-applied across the restart (the dedup
+window rolls back exactly as far as the center does) and the PARAM
+version counter resumes monotone within the bumped generation ``gen``.
 """
 
 from __future__ import annotations
@@ -68,6 +88,7 @@ import numpy as np
 
 from mpit_tpu.analysis.runtime import make_lock
 from mpit_tpu.obs.live import M_STALENESS, live_registry
+from mpit_tpu.parallel.elastic import ElasticMembership
 from mpit_tpu.transport import (
     ANY_SOURCE,
     ANY_TAG,
@@ -92,6 +113,8 @@ TAG_PUSH_DELTA = 3
 TAG_PARAM = 4
 TAG_STOP = 5
 TAG_HEARTBEAT = 6
+TAG_JOIN = 7
+TAG_LEAVE = 8
 
 
 class _DedupWindow:
@@ -125,6 +148,25 @@ class _DedupWindow:
                 floor = seq - self.size
                 self._seen[key] = {s for s in seen if s > floor}
         return True
+
+    def state(self) -> list:
+        """Snapshot as plain msgpack-friendly lists: one
+        ``[src, epoch, high, sorted(seen)]`` entry per (src, epoch)."""
+        return [
+            [src, epoch, self._high.get((src, epoch), 0), sorted(seen)]
+            for (src, epoch), seen in sorted(self._seen.items())
+        ]
+
+    def load_state(self, entries) -> None:
+        """Restore from :meth:`state` output (int casts: msgpack hands
+        back whatever width it stored)."""
+        self._high.clear()
+        self._seen.clear()
+        # msgpack ints, not device scalars: cold restore path
+        for src, epoch, high, seen in entries:
+            key = (int(src), int(epoch))  # mpit-analysis: ignore[MPT005]
+            self._high[key] = int(high)  # mpit-analysis: ignore[MPT005]
+            self._seen[key] = {int(s) for s in seen}  # mpit-analysis: ignore[MPT005]
 
 
 def partition_bounds(total: int, num_servers: int) -> list[tuple[int, int]]:
@@ -201,19 +243,28 @@ class PServer:
             raise ValueError(f"quant must be off|bf16|int8, got {quant!r}")
         self.quant = quant
         self.counts = {"fetch": 0, "push_easgd": 0, "push_delta": 0,
-                       "heartbeat": 0, "dup_dropped": 0,
-                       "malformed_dropped": 0}
+                       "heartbeat": 0, "join": 0, "leave": 0,
+                       "dup_dropped": 0, "malformed_dropped": 0}
         # training-dynamics plane (docs/OBSERVABILITY.md "dynamics"):
         # monotonic center-update version — bumped per applied push,
         # stamped into attempt-id'd PARAM replies, echoed back by
         # clients as the fetch basis of their push envelopes
         self.version = 0
+        # restart generation: bumped on every snapshot restore; stamped
+        # into param_version journal records so `obs dynamics` and TC204
+        # judge version monotonicity within a generation (a restore may
+        # legitimately roll the counter back to the persisted value)
+        self.gen = 0
         # per-src staleness accounting {src: {pushes, sum, max}} for
         # versioned pushes only (legacy envelopes carry no basis)
         self.staleness_by_src: dict[int, dict[str, int]] = {}
         self._dedup = _DedupWindow(dedup_window)
-        self.dead_clients: set[int] = set()
-        self._stopped: set[int] = set()
+        self._membership = ElasticMembership(num_clients, client_ranks)
+        # aliases into the membership view: the watchdog, the STOP
+        # branch, trainers, and tests all mutate/read these sets
+        # directly, and membership keeps owning the same objects
+        self.dead_clients = self._membership.dead
+        self._stopped = self._membership.stopped
         self.error: Optional[BaseException] = None
         self._lock = make_lock("PServer._lock")
         if ckpt_every is not None and ckpt_every < 1:
@@ -226,16 +277,50 @@ class PServer:
         self.restored = False
         if ckpt_path is not None and os.path.exists(ckpt_path):
             with open(ckpt_path, "rb") as f:
-                saved = np.load(f)
-            if saved.shape != self.center.shape:
-                raise ValueError(
-                    f"persisted center chunk {ckpt_path!r} has shape "
-                    f"{saved.shape}, this server owns {self.center.shape} "
-                    "— resuming across a model/server-count change is not "
-                    "supported"
-                )
-            self.center = saved.astype(np.float32, copy=True)
+                magic = f.read(6)
+            if magic == b"\x93NUMPY":
+                # legacy bare-center snapshot (ps_trainer's center_<r>.npy)
+                with open(ckpt_path, "rb") as f:
+                    saved = np.load(f)
+                if saved.shape != self.center.shape:
+                    raise ValueError(
+                        f"persisted center chunk {ckpt_path!r} has shape "
+                        f"{saved.shape}, this server owns "
+                        f"{self.center.shape} — resuming across a "
+                        "model/server-count change is not supported"
+                    )
+                self.center = saved.astype(np.float32, copy=True)
+            else:
+                self._restore_shard(ckpt_path)
             self.restored = True
+
+    def _restore_shard(self, ckpt_path: str) -> None:
+        """Restore a full shard snapshot (elastic recovery format): the
+        center + version + dedup window + membership come back as one
+        consistent cut, so an acked push either survives with the center
+        it mutated or rolls back with it — never half."""
+        from mpit_tpu.utils.checkpoint import load_shard_state
+
+        state = load_shard_state(ckpt_path)
+        saved = np.asarray(state["center"], dtype=np.float32)
+        if saved.shape != self.center.shape:
+            raise ValueError(
+                f"persisted shard snapshot {ckpt_path!r} has shape "
+                f"{saved.shape}, this server owns {self.center.shape} "
+                "— resuming across a model/server-count change is not "
+                "supported"
+            )
+        self.center = saved.copy()
+        self.version = int(state.get("version", 0))
+        # a restore is a new generation: PARAM version records after the
+        # restart carry gen+1 so monotonicity is judged per generation
+        self.gen = int(state.get("gen", 0)) + 1
+        dedup = state.get("dedup")
+        if dedup is not None:
+            self._dedup.load_state(dedup)
+        membership = state.get("membership")
+        if membership is not None:
+            self._membership.load_state(membership)
 
     def start(self) -> None:
         """Recv loop; stores any exception in ``self.error`` (a daemon
@@ -255,7 +340,11 @@ class PServer:
             last_seen = {r: now for r in self.client_ranks}
         poll = self.client_timeout / 4 if watchdog else None
 
-        while len(self._stopped | self.dead_clients) < self.num_clients:
+        # teardown when every expected rank is accounted for (stopped,
+        # dead, or left) — equal to the seed's `len(stopped | dead) <
+        # num_clients` loop when membership never changes, but correct
+        # when ranks JOIN/LEAVE mid-run
+        while not self._membership.teardown_complete():
             try:
                 msg = self.transport.recv(ANY_SOURCE, ANY_TAG, timeout=poll)
             except RecvTimeout:
@@ -295,7 +384,8 @@ class PServer:
                 else:
                     reply = (msg.payload, version, snapshot)
                 self._journal_dynamics(
-                    "param_version", dst=msg.src, version=version
+                    "param_version", dst=msg.src, version=version,
+                    gen=self.gen,
                 )
                 self.transport.send(msg.src, TAG_PARAM, reply)
             elif msg.tag == TAG_PUSH_EASGD:
@@ -324,6 +414,46 @@ class PServer:
             elif msg.tag == TAG_HEARTBEAT:
                 with self._lock:
                     self.counts["heartbeat"] += 1
+            elif msg.tag == TAG_JOIN:
+                # membership handshake: register the (rank, epoch) pair
+                # and answer with the same versioned PARAM a FETCH gets —
+                # one reply tag keeps the wire protocol's single
+                # request/reply shape (and the extracted model) intact
+                parsed = self._parse_join(msg.payload)
+                if parsed is None:
+                    with self._lock:
+                        self.counts["malformed_dropped"] += 1
+                else:
+                    attempt, client_epoch = parsed
+                    kind = self._membership.register(msg.src, client_epoch)
+                    with self._lock:
+                        snapshot = self.center.copy()
+                        version = self.version
+                        self.counts["join"] += 1
+                    if watchdog and msg.src not in last_seen:
+                        # a brand-new rank: arm its watchdog slot
+                        last_seen[msg.src] = time.monotonic()
+                    if self.quant != "off":
+                        reply = (attempt, version, quantize(snapshot, self.quant))
+                    else:
+                        reply = (attempt, version, snapshot)
+                    self._journal_dynamics(
+                        "membership", src=msg.src, kind=kind,
+                        view=self._membership.view_epoch, gen=self.gen,
+                    )
+                    self._journal_dynamics(
+                        "param_version", dst=msg.src, version=version,
+                        gen=self.gen,
+                    )
+                    self.transport.send(msg.src, TAG_PARAM, reply)
+            elif msg.tag == TAG_LEAVE:
+                self._membership.leave(msg.src)
+                with self._lock:
+                    self.counts["leave"] += 1
+                self._journal_dynamics(
+                    "membership", src=msg.src, kind="leave",
+                    view=self._membership.view_epoch, gen=self.gen,
+                )
             elif msg.tag == TAG_STOP:
                 self._stopped.add(msg.src)
             else:
@@ -331,6 +461,19 @@ class PServer:
             if watchdog:
                 self._expire(last_seen)
         self.persist()  # clean teardown: the final center is never lost
+
+    def _parse_join(self, payload) -> Optional[tuple]:
+        """``(attempt_id, epoch)`` from a JOIN envelope, or None for a
+        malformed one (a chaos-mangled JOIN is dropped like any other
+        unparseable frame; the client's join retry re-offers it)."""
+        if (
+            isinstance(payload, tuple)
+            and len(payload) == 2
+            and isinstance(payload[0], int)
+            and isinstance(payload[1], int)
+        ):
+            return payload
+        return None
 
     def _admit_push(self, msg) -> bool:
         """Unwrap a push envelope, validate the chunk, and run the
@@ -461,19 +604,46 @@ class PServer:
             return
         self.persist()
 
+    def _snapshot_state(self) -> dict:
+        """One consistent cut of everything a restarted server needs:
+        the keys below are the shard snapshot format — center, version,
+        gen, dedup, and membership are persisted TOGETHER so a push that
+        was applied but not yet persisted rolls back *with* the center
+        it mutated (its redelivery then re-applies exactly once relative
+        to the restored state)."""
+        with self._lock:
+            state = {
+                "center": self.center.copy(),
+                "version": int(self.version),
+                "gen": int(self.gen),
+                "dedup": self._dedup.state(),
+                "membership": self._membership.state(),
+            }
+            self._updates_since_save = 0
+        return state
+
     def persist(self) -> None:
-        """Atomically write the center chunk (tmp + rename — a server
-        killed mid-write leaves the previous snapshot intact). Opened
-        file handles keep ``np.save`` from appending its own ``.npy``."""
+        """Atomically write the persistent snapshot (tmp + rename — a
+        server killed mid-write leaves the previous snapshot intact).
+        A ``.npy`` path keeps the legacy bare-center ``np.save`` format
+        (ps_trainer's ``center_<rank>.npy`` resume contract); any other
+        path gets the full shard snapshot, which is what elastic
+        recovery restores from. Opened file handles keep ``np.save``
+        from appending its own ``.npy``."""
         if self.ckpt_path is None:
             return
-        with self._lock:
-            snap = self.center.copy()
-            self._updates_since_save = 0
-        tmp = self.ckpt_path + ".tmp"
-        with open(tmp, "wb") as f:
-            np.save(f, snap)
-        os.replace(tmp, self.ckpt_path)
+        if self.ckpt_path.endswith(".npy"):
+            with self._lock:
+                snap = self.center.copy()
+                self._updates_since_save = 0
+            tmp = self.ckpt_path + ".tmp"
+            with open(tmp, "wb") as f:
+                np.save(f, snap)
+            os.replace(tmp, self.ckpt_path)
+            return
+        from mpit_tpu.utils.checkpoint import save_shard_state
+
+        save_shard_state(self.ckpt_path, self._snapshot_state())
 
     def _expire(self, last_seen: dict) -> None:
         now = time.monotonic()

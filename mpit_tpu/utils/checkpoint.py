@@ -129,6 +129,53 @@ def save_checkpoint(
     return path
 
 
+def save_shard_state(path: str, state: dict) -> str:
+    """Atomically write one PServer shard snapshot (msgpack dict).
+
+    The elastic-membership recovery format (docs/ROBUSTNESS.md): the
+    center array, the per-shard version counter, the ``(src, epoch)``
+    dedup window, and the membership view are serialized TOGETHER, so a
+    restore can never observe a center that disagrees with its dedup
+    window — an applied-but-unpersisted push rolls back *with* the
+    center it mutated, and its redelivery re-applies exactly once
+    relative to the restored state. Same tmp+rename discipline as
+    :func:`save_checkpoint`; no multi-host gating — each shard server
+    is a single process writing its own file.
+
+    Serialized with ``msgpack_serialize`` (not ``to_bytes``): the
+    restore side is template-free ``msgpack_restore``, and ``to_bytes``
+    first runs ``to_state_dict``, which rewrites nested lists into
+    ``{"0": ...}`` index dicts that only a templated ``from_bytes``
+    undoes — the dedup/membership entry lists must round-trip as lists.
+    """
+    payload = serialization.msgpack_serialize(state)
+    directory = os.path.dirname(os.path.abspath(path))
+    os.makedirs(directory, exist_ok=True)
+    fd, tmp = tempfile.mkstemp(dir=directory, suffix=".tmp")
+    try:
+        with os.fdopen(fd, "wb") as f:
+            f.write(payload)
+        os.replace(tmp, path)  # atomic: never torn at `path`
+    except BaseException:
+        if os.path.exists(tmp):
+            os.unlink(tmp)
+        raise
+    return path
+
+
+def load_shard_state(path: str) -> dict:
+    """Read a shard snapshot written by :func:`save_shard_state`."""
+    with open(path, "rb") as f:
+        payload = f.read()
+    state = serialization.msgpack_restore(payload)
+    if not isinstance(state, dict):
+        raise ValueError(
+            f"shard snapshot {path} is not a state dict "
+            f"(got {type(state).__name__})"
+        )
+    return state
+
+
 def restore_checkpoint(
     directory: str,
     template: Any,

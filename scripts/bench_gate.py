@@ -33,7 +33,12 @@ quant/bucket/overlap modes (``dp_quant``/``dp_bucket_bytes``/
 ``dp_overlap`` on the collective legs, ``wire_format``/``wire_quant``
 on the PS legs) are never scored against each other — an int8 round
 "regressing" against a raw round is an A/B comparison, not a drift,
-and it belongs in the bench's own ``vs_raw`` field.
+and it belongs in the bench's own ``vs_raw`` field. Membership-churn
+runs (``elastic_churn`` truthy: ranks killed and respawned mid-run by
+the elastic supervisor) are likewise their own comparability mode —
+a soak that loses a rank every few seconds measures recovery cost,
+not steady-state throughput, and must never be trended against a
+stable-membership round.
 
 Warn-only by default (exit 0 with warnings printed) because bench noise
 must not block commits — scripts/lint.sh runs it that way (with
@@ -85,6 +90,9 @@ _EXCHANGE_KEYS = (
     "dp_quant", "dp_bucket_bytes", "dp_overlap",
     # PS socket-codec knobs (bench.py --preset mnist-ps)
     "wire_format", "wire_quant",
+    # elastic-membership churn (scripts/elastic_soak.sh legs): a run
+    # that kills/respawns ranks measures recovery, not steady state
+    "elastic_churn",
 )
 
 

@@ -1,0 +1,77 @@
+#!/usr/bin/env bash
+# Elastic-membership chaos soak: run a 3-rank process-mode EASGD job with
+# the elastic supervisor armed, killing a random rank every few seconds
+# and respawning it (clients re-enter via JOIN, a killed server restores
+# from its shard snapshot), then gate the survivors' journals:
+#
+#   scripts/elastic_soak.sh [MAX_SECONDS] [KILL_SEED]
+#
+# - `obs dynamics --gate`: no divergence, bounded staleness;
+# - a versions-monotonic check over the (gen, version) order — a restored
+#   server stepping its center version backwards within a generation is
+#   exactly the double-apply/lost-snapshot failure the shard checkpoint
+#   exists to prevent;
+# - `analysis conform`: TC201-TC204 over the run's journals with
+#   membership.jsonl licensing the churned ranks' truncated tails;
+# - at least one kill must actually have landed (a soak that never
+#   churned proved nothing — fail loudly rather than pass vacuously).
+#
+# The kill schedule is seeded (MPIT_ELASTIC_KILL_SEED) so a failure
+# replays: rerun with the same seed and the same victims die at the same
+# cadence. Wall-clock is bounded by MAX_SECONDS (default 180) via
+# timeout(1); the killer only picks victims that still have respawn
+# budget, so the supervisor cannot run the world out of respawns itself.
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+MAX_SECONDS="${1:-180}"
+KILL_SEED="${2:-1234}"
+OUT="$(mktemp -d)"
+CKPT="$(mktemp -d)"
+trap 'rm -rf "$OUT" "$CKPT"' EXIT
+
+GATE="$OUT/dynamics_gate.json"
+printf '{"staleness_p99_max": 256, "allow_diverging": false}\n' > "$GATE"
+
+echo "=== elastic soak: 3-rank churn run (seed ${KILL_SEED}, budget ${MAX_SECONDS}s) ===" >&2
+env JAX_PLATFORMS=cpu \
+    MPIT_OBS_DIR="$OUT" \
+    MPIT_ELASTIC_RESPAWN=1 \
+    MPIT_ELASTIC_CKPT_DIR="$CKPT" \
+    MPIT_ELASTIC_CKPT_EVERY=3 \
+    MPIT_ELASTIC_KILL_EVERY_S=3 \
+    MPIT_ELASTIC_KILL_SEED="$KILL_SEED" \
+    MPIT_ELASTIC_MAX_RESPAWNS=4 \
+    timeout -k 10 "$MAX_SECONDS" \
+    python -m mpit_tpu.launch -n 3 examples/ptest_proc.py \
+    --model mlp --steps 48 --train-size 256 --algo ps-easgd
+
+echo "=== elastic soak: dynamics gate ===" >&2
+python -m mpit_tpu.obs dynamics "$OUT" --gate "$GATE" --json \
+    > "$OUT/dynamics.json"
+python - "$OUT" <<'EOF'
+import json, sys
+out = sys.argv[1]
+report = json.load(open(f"{out}/dynamics.json"))
+run = report["run"]
+if run["versions_monotonic"] is False:
+    sys.exit("elastic_soak: center version stepped backwards within a "
+             "generation — snapshot restore lost state")
+members = [json.loads(line)
+           for line in open(f"{out}/membership.jsonl")]
+kills = [m for m in members if m.get("kind") == "kill"]
+respawns = [m for m in members if m.get("kind") == "respawn"]
+if not kills:
+    sys.exit("elastic_soak: no rank was ever killed — the soak proved "
+             "nothing (machine too fast? raise --steps)")
+if not respawns:
+    sys.exit("elastic_soak: kills landed but nothing respawned — the "
+             "supervisor is not replacing ranks")
+restores = sum(s.get("restores", 0) for s in report["servers"].values())
+print(f"elastic_soak: {len(kills)} kill(s), {len(respawns)} respawn(s), "
+      f"{restores} server restore(s), versions monotonic, gate green")
+EOF
+
+echo "=== elastic soak: conformance replay ===" >&2
+python -m mpit_tpu.analysis conform "$OUT"
+echo "elastic_soak: OK"

@@ -42,4 +42,8 @@ run python scripts/measure_presets.py --set attn_impl=flash --presets ptb-transf
 run python scripts/measure_presets.py --presets ptb-transformer-pp --set pp_schedule=1f1b > /tmp/v_1f1b.log 2>&1
 run python scripts/measure_presets.py --stem space_to_depth --presets resnet50-sync > /tmp/v_s2d_r50.log 2>&1
 run python scripts/sweep_lenet.py > /tmp/v_sweep_lenet.log 2>&1
+# -- elastic-membership churn soak (seeded kill/respawn every ~3s;
+#    gates on obs dynamics + conformance with churn licensing; its
+#    numbers are their own comparability mode — see bench_gate.py) --
+run bash scripts/elastic_soak.sh 300 > /tmp/v_elastic_soak.log 2>&1
 echo "DONE failed=$failed" > /tmp/tpu_backlog.done

@@ -705,7 +705,8 @@ def test_mpt008_repo_roles_pair_up():
     roles = protocol_mod.extract_roles(project)
     assert set(roles) == {"client", "server"}
     client, server = roles["client"], roles["server"]
-    assert client.sent_tags == {1, 2, 3, 5, 6}  # FETCH/PUSH*/STOP/HEARTBEAT
+    # FETCH/PUSH*/STOP/HEARTBEAT/JOIN/LEAVE
+    assert client.sent_tags == {1, 2, 3, 5, 6, 7, 8}
     assert client.sent_tags <= server.dispatch_tags
     assert server.sent_tags == {4}  # TAG_PARAM
     assert {op.tag for op in client.concrete_recvs} == {4}

@@ -1,0 +1,367 @@
+"""Elastic membership: JOIN/REJOIN/REPLACE/LEAVE + shard snapshot recovery.
+
+The membership layer (`parallel/elastic.py`, docs/ROBUSTNESS.md "Elastic
+membership") replaces the seed-era "declared dead stays dead forever"
+model: a replacement client announces itself with JOIN and gets a fresh
+fetch plus a fresh-epoch dedup slot, a preempted client can rejoin
+without being mistaken for a replay, and a killed server restores its
+shard snapshot (center + version + dedup + membership as one consistent
+cut) so acked pushes are never double-applied across a restart. These
+tests pin each transition at the unit level, over the wire, and through
+the save → kill → restore round trip; the process-level churn soak is
+`scripts/elastic_soak.sh`."""
+
+import shutil
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from mpit_tpu.parallel.elastic import ElasticMembership
+from mpit_tpu.parallel.pclient import PClient
+from mpit_tpu.parallel.pserver import (
+    TAG_HEARTBEAT,
+    TAG_PUSH_EASGD,
+    TAG_STOP,
+    PServer,
+    spawn_server_thread,
+)
+from mpit_tpu.transport import Broker, ChaosConfig, ChaosTransport
+
+DIM = 16
+
+
+# ------------------------------------------------------- membership unit
+
+
+class TestMembershipView:
+    def test_register_kinds(self):
+        m = ElasticMembership(2, [1, 2])
+        assert m.register(1, epoch=111) == "join"
+        assert m.register(1, epoch=111) == "rejoin"  # same identity
+        assert m.register(1, epoch=222) == "replace"  # fresh process
+        assert m.epochs[1] == 222
+
+    def test_replace_clears_every_terminal_state(self):
+        """A rank that was declared dead (or even stopped — a respawn
+        after a clean exit) owes a fresh STOP once it re-registers."""
+        m = ElasticMembership(2, [1, 2])
+        m.register(1, epoch=1)
+        m.dead.add(1)
+        m.stopped.add(2)
+        assert m.register(1, epoch=2) == "replace"
+        assert 1 not in m.dead
+        m.register(2, epoch=3)
+        assert 2 not in m.stopped
+        assert not m.teardown_complete()
+
+    def test_teardown_accounting(self):
+        m = ElasticMembership(2, [1, 2])
+        assert not m.teardown_complete()
+        m.stopped.add(1)
+        assert not m.teardown_complete()
+        m.dead.add(2)
+        assert m.teardown_complete()  # stopped|dead|left covers expected
+
+    def test_leave_counts_toward_teardown(self):
+        m = ElasticMembership(2, [1, 2])
+        m.stopped.add(1)
+        m.leave(2)
+        assert m.teardown_complete()
+
+    def test_unknown_rank_join_raises_the_bar(self):
+        """A mid-run joiner becomes *expected*: teardown must now wait
+        for its STOP too, never complete without it."""
+        m = ElasticMembership(1, [1])
+        m.stopped.add(1)
+        assert m.teardown_complete()
+        m.register(7, epoch=9)
+        assert 7 in m.expected
+        assert not m.teardown_complete()
+        m.leave(7)
+        assert m.teardown_complete()
+
+    def test_view_epoch_bumps_on_every_change(self):
+        m = ElasticMembership(1, [1])
+        v0 = m.view_epoch
+        m.register(1, epoch=4)
+        m.leave(1)
+        assert m.view_epoch == v0 + 2
+
+    def test_state_round_trip_preserves_set_identity(self):
+        """load_state mutates in place: the server aliases
+        ``dead_clients``/``_stopped`` to these sets, so a restore must
+        never rebind them. 64-bit epochs (the client identity is 8
+        random bytes) must survive the trip."""
+        big = int.from_bytes(b"\xff" * 8, "big")
+        src = ElasticMembership(2, [1, 2])
+        src.register(1, epoch=big)
+        src.dead.add(2)
+        src.leave(1)
+
+        dst = ElasticMembership(1, [1])
+        dead_alias, stopped_alias = dst.dead, dst.stopped
+        dst.load_state(src.state())
+        assert dst.dead is dead_alias and dst.stopped is stopped_alias
+        assert dst.state() == src.state()
+        assert dst.epochs[1] == big
+
+
+# ----------------------------------------------------- JOIN over the wire
+
+
+def _world(num_clients: int, client_timeout=None, **server_kw):
+    broker = Broker(1 + num_clients)
+    tps = broker.transports()
+    server = PServer(
+        tps[0],
+        np.zeros(DIM, np.float32),
+        num_clients=num_clients,
+        alpha=0.5,
+        client_ranks=list(range(1, 1 + num_clients)),
+        client_timeout=client_timeout,
+        **server_kw,
+    )
+    thread = spawn_server_thread(server)
+    return tps, server, thread
+
+
+class TestJoinProtocol:
+    def test_join_returns_fresh_fetch(self):
+        tps, server, thread = _world(1)
+        client = PClient(tps[1], [0], DIM)
+        center = client.join()
+        np.testing.assert_array_equal(center, np.zeros(DIM, np.float32))
+        assert server.counts["join"] == 1
+        assert server._membership.epochs[1] == client._epoch
+        client.stop()
+        thread.join(timeout=5)
+        assert not thread.is_alive() and server.error is None
+
+    def test_replacement_epoch_gets_fresh_dedup_slot(self):
+        """The exactly-once half of membership: the predecessor consumed
+        seq 1 under its epoch; the replacement's seq 1 (fresh epoch) must
+        APPLY, while a replay under the predecessor's epoch must not."""
+        tps, server, thread = _world(1)
+        first = PClient(tps[1], [0], DIM)
+        first.join()
+        first.push_easgd(np.ones(DIM, np.float32))
+
+        # replacement process on the same rank: new PClient = new epoch
+        second = PClient(tps[1], [0], DIM)
+        assert second._epoch != first._epoch
+        second.join()
+        assert server._membership.epochs[1] == second._epoch
+        second.push_easgd(np.ones(DIM, np.float32))  # seq 1 again
+
+        # a chaos-style replay of the PREDECESSOR's push: same (epoch, 1)
+        tps[1].send(
+            0, TAG_PUSH_EASGD, (first._epoch, 1, np.ones(DIM, np.float32))
+        )
+        second.stop()
+        thread.join(timeout=5)
+        assert not thread.is_alive() and server.error is None
+        assert server.counts["push_easgd"] == 2  # both seq-1 pushes landed
+        assert server.counts["dup_dropped"] == 1  # the replay did not
+        assert server.counts["join"] == 2
+
+    def test_leave_releases_teardown_without_stop(self):
+        tps, server, thread = _world(2)
+        a = PClient(tps[1], [0], DIM)
+        b = PClient(tps[2], [0], DIM)
+        a.join()
+        b.join()
+        a.stop()
+        b.leave()  # planned departure: no STOP ever sent
+        thread.join(timeout=5)
+        assert not thread.is_alive() and server.error is None
+        assert server.counts["leave"] == 1
+        assert server._membership.left == {2}
+
+    def test_rejoined_client_keeps_its_dedup_window(self):
+        """Same epoch re-registering (a preempted client whose process
+        survived): its already-admitted seqs must STAY admitted — a
+        retransmit from before the partition is still a replay."""
+        tps, server, thread = _world(1)
+        client = PClient(tps[1], [0], DIM)
+        client.join()
+        client.push_easgd(np.ones(DIM, np.float32))
+        client.join()  # rejoin: same object, same epoch
+        tps[1].send(
+            0, TAG_PUSH_EASGD, (client._epoch, 1, np.ones(DIM, np.float32))
+        )
+        client.stop()
+        thread.join(timeout=5)
+        assert not thread.is_alive() and server.error is None
+        assert server.counts["push_easgd"] == 1
+        assert server.counts["dup_dropped"] == 1
+
+
+# ------------------------------------------------- shard snapshot recovery
+
+
+class TestShardSnapshot:
+    def test_kill_restore_round_trip_preserves_exactly_once(self, tmp_path):
+        """Save under load, 'kill' the server, restore a new one on the
+        same path: version counter continues, gen bumps, and a replayed
+        (epoch, seq) push from before the kill is still rejected —
+        the dedup window rode the snapshot with the center."""
+        path = str(tmp_path / "shard_0.msgpack")
+        killed = str(tmp_path / "shard_0.killed.msgpack")
+        tps, server, thread = _world(1, ckpt_path=path, ckpt_every=1)
+        client = PClient(tps[1], [0], DIM)
+        client.join()
+        client.push_easgd(np.ones(DIM, np.float32))
+        client.push_easgd(np.full(DIM, 2.0, np.float32))
+        deadline = time.monotonic() + 5
+        while server.version < 2 and time.monotonic() < deadline:
+            time.sleep(0.01)
+        assert server.version == 2
+        want_center = server.snapshot()
+        # "kill": freeze the snapshot as persisted after push 2, BEFORE
+        # the clean stop below rewrites it with rank 1 marked stopped —
+        # a preempted server never got to record that stop
+        shutil.copy(path, killed)
+        client.stop()
+        thread.join(timeout=5)
+        assert not thread.is_alive() and server.error is None
+
+        # the restored server is a NEW process: fresh transports too
+        tps2, revived, thread2 = _world(1, ckpt_path=killed, ckpt_every=1)
+        assert revived.restored
+        assert revived.version == 2  # counter continuity
+        assert revived.gen == 1  # restore = new generation
+        np.testing.assert_array_equal(revived.snapshot(), want_center)
+
+        # replay an acked pre-kill push: must be a dup, not a re-apply
+        tps2[1].send(
+            0, TAG_PUSH_EASGD,
+            (client._epoch, 2, np.full(DIM, 2.0, np.float32)),
+        )
+        tps2[1].send(0, TAG_STOP, None)
+        thread2.join(timeout=5)
+        assert not thread2.is_alive() and revived.error is None
+        assert revived.counts["dup_dropped"] == 1
+        assert revived.counts["push_easgd"] == 0
+        assert revived.version == 2  # untouched by the replay
+        np.testing.assert_array_equal(revived.snapshot(), want_center)
+
+    def test_restored_membership_remembers_stopped_ranks(self, tmp_path):
+        """A server killed AFTER a client stopped must not wait for that
+        client again on restore — its STOP rode the snapshot."""
+        path = str(tmp_path / "shard_0.msgpack")
+        tps, server, thread = _world(2, ckpt_path=path, ckpt_every=1)
+        a = PClient(tps[1], [0], DIM)
+        a.join()
+        a.push_easgd(np.ones(DIM, np.float32))  # triggers a snapshot...
+        a.stop()
+        deadline = time.monotonic() + 5
+        while 1 not in server._stopped and time.monotonic() < deadline:
+            time.sleep(0.01)
+        a.push_easgd(np.ones(DIM, np.float32))  # ...and this one persists
+        # the stop (stop() keeps the dedup epoch, so seq 2 still admits)
+        deadline = time.monotonic() + 5
+        while server.counts["push_easgd"] < 2 and (
+            time.monotonic() < deadline
+        ):
+            time.sleep(0.01)
+
+        tps2, revived, thread2 = _world(2, ckpt_path=path, ckpt_every=1)
+        assert revived.restored
+        assert revived._membership.stopped == {1}
+        tps2[2].send(0, TAG_STOP, None)  # only rank 2 still owes a stop
+        thread2.join(timeout=5)
+        assert not thread2.is_alive() and revived.error is None
+
+    def test_shape_mismatch_fails_loudly(self, tmp_path):
+        path = str(tmp_path / "shard_0.msgpack")
+        tps, server, thread = _world(1, ckpt_path=path, ckpt_every=1)
+        client = PClient(tps[1], [0], DIM)
+        client.push_easgd(np.ones(DIM, np.float32))
+        client.stop()
+        thread.join(timeout=5)
+        assert not thread.is_alive()
+        with pytest.raises(ValueError, match="shape"):
+            PServer(
+                Broker(2).transports()[0],
+                np.zeros(DIM + 1, np.float32),
+                num_clients=1,
+                ckpt_path=path,
+            )
+
+
+# --------------------------------------- revival + heartbeat thread hygiene
+
+
+class TestRevivalUnderChaos:
+    def test_blackholed_heartbeats_then_release_revives(self):
+        """Scripted drops swallow the client's first heartbeats (a grey
+        link), the watchdog declares it dead, the hole ends, the next
+        heartbeat revives it, and its push still applies — recovery, not
+        just detection."""
+        hole = 40  # 40 * 0.05 s = 2 s of dropped heartbeats vs 0.5 s timeout
+        broker = Broker(3)
+        tps = broker.transports()
+        # a second, healthy client keeps the run alive: with a lone
+        # client, declaring it dead would complete teardown and end the
+        # serve loop before any revival could happen
+        server = PServer(
+            tps[0], np.zeros(DIM, np.float32), num_clients=2, alpha=0.5,
+            client_ranks=[1, 2], client_timeout=0.5,
+        )
+        thread = spawn_server_thread(server)
+        chaos = ChaosTransport(
+            tps[1],
+            ChaosConfig(
+                seed=0,
+                scripted={
+                    (1, 0, TAG_HEARTBEAT, n): "drop" for n in range(hole)
+                },
+            ),
+        )
+        grey = PClient(chaos, [0], DIM, heartbeat_interval=0.05)
+        healthy = PClient(tps[2], [0], DIM, heartbeat_interval=0.05)
+        deadline = time.monotonic() + 10
+        while 1 not in server.dead_clients and time.monotonic() < deadline:
+            time.sleep(0.02)
+        assert 1 in server.dead_clients  # the hole outlasted the watchdog
+        deadline = time.monotonic() + 10
+        while 1 in server.dead_clients and time.monotonic() < deadline:
+            time.sleep(0.02)
+        assert 1 not in server.dead_clients  # first delivered beat revived
+        grey.push_easgd(np.ones(DIM, np.float32))
+        grey.stop()
+        healthy.stop()
+        thread.join(timeout=5)
+        assert not thread.is_alive() and server.error is None
+        assert server.counts["push_easgd"] == 1
+        assert server.dead_clients == set()
+
+
+class TestHeartbeatShutdown:
+    @staticmethod
+    def _hb_threads():
+        return [
+            t for t in threading.enumerate()
+            if t.name == "mpit-pclient-heartbeat" and t.is_alive()
+        ]
+
+    def test_stop_joins_heartbeat_thread(self):
+        tps, server, thread = _world(1)
+        before = len(self._hb_threads())
+        client = PClient(tps[1], [0], DIM, heartbeat_interval=0.05)
+        assert len(self._hb_threads()) == before + 1
+        client.stop()
+        assert client._hb_thread is None
+        assert len(self._hb_threads()) == before  # joined, not leaked
+        thread.join(timeout=5)
+        assert not thread.is_alive() and server.error is None
+
+    def test_double_stop_is_idempotent(self):
+        tps, server, thread = _world(1)
+        client = PClient(tps[1], [0], DIM, heartbeat_interval=0.05)
+        client.stop()
+        client.stop()  # second stop: no error, no hang
+        thread.join(timeout=5)
+        assert not thread.is_alive() and server.error is None

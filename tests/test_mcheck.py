@@ -109,6 +109,8 @@ def test_shipped_semantics_extracted_exactly(shipped_sem):
     assert sem.dedup.checks_seen and sem.dedup.prunes_seen
     assert sem.dedup.window_default == 1024
     assert sem.dedup.symbol == "_DedupWindow.admit"
+    assert sem.dedup.keyed_by_epoch  # (src, epoch) key, not src alone
+    assert sem.snapshot_includes_dedup is True  # shard snapshot carries it
     assert sem.reply_send.rel.endswith("parallel/pserver.py")
     assert sem.reply_recv.rel.endswith("parallel/pclient.py")
 
@@ -121,7 +123,9 @@ def test_shipped_protocol_is_clean_and_exhaustive(shipped_sem):
     fixpoint, no violations, a real state count reported, and every
     fault kind contributing schedules."""
     results = mcheck.check_all(mcheck.from_protocol(shipped_sem))
-    assert [r.config.algo for r in results] == ["easgd", "downpour"]
+    assert [r.config.algo for r in results] == [
+        "easgd", "downpour", "easgd-elastic"
+    ]
     for r in results:
         assert r.ok, (r.config.algo, r.violations)
         assert not r.truncated
@@ -150,6 +154,12 @@ def _mutate(sem, **kw):
         ({"attempt_checked": False}, "MPT011"),
         # no attempt id on the wire at all
         ({"attempt_echoed": False, "attempt_checked": False}, "MPT011"),
+        # dedup window keyed by src alone: a replacement client's fresh
+        # seq stream is mistaken for its predecessor's replays
+        ({"dedup_keyed_by_epoch": False}, "MPT009"),
+        # shard snapshot persists the center but not the dedup window:
+        # crash-restore re-applies an already-acked push
+        ({"snapshot_includes_dedup": False}, "MPT009"),
     ],
 )
 def test_single_bit_mutations_each_caught(shipped_sem, mutation, rule):
@@ -254,7 +264,7 @@ def test_mcheck_cli_reports_state_counts():
     proc = _cli("mcheck", "--json")
     assert proc.returncode == 0, proc.stdout + proc.stderr
     doc = json.loads(proc.stdout)
-    assert len(doc) == 2
+    assert len(doc) == 3  # easgd, downpour, easgd-elastic
     for entry in doc:
         assert entry["violations"] == {}
         assert entry["states"] > 10_000
