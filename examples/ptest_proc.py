@@ -94,6 +94,24 @@ def main():
     # OUTERMOST over chaos so its stream index stays in lockstep with
     # the chaos schedule (the fault-overlay join key).
     tp = wrap_from_env(base)
+    if fault_log is not None:
+        # ride the chaos schedule along every black-box dump: the
+        # post-mortem then sees the injected faults inside the same
+        # file as the final exchange rounds they explain
+        from mpit_tpu.obs import box_for
+
+        box = box_for(tp)
+        if box is not None:
+            box.add_source(
+                "faults",
+                lambda: [
+                    {
+                        "ev": "fault", "kind": e.kind, "src": e.src,
+                        "dst": e.dst, "tag": e.tag, "n": e.n,
+                    }
+                    for e in fault_log.events()
+                ],
+            )
     server_ranks = list(range(num_servers))
     client_ranks = list(range(num_servers, world))
     bounds = partition_bounds(flat0.size, num_servers)

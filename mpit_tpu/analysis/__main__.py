@@ -177,6 +177,7 @@ def _main_conform(argv) -> int:
                 "recvs": report.recvs,
                 "faults": report.faults,
                 "churned": report.churned,
+                "truncated": report.truncated,
                 "violations": [
                     {"rule": v.rule, "detail": v.detail}
                     for v in report.violations
@@ -190,11 +191,16 @@ def _main_conform(argv) -> int:
                 f", elastic churn on rank(s) {report.churned}"
                 if report.churned else ""
             )
+            trunc_note = (
+                f", truncated journal(s) on rank(s) {report.truncated}"
+                if report.truncated else ""
+            )
             print(
                 f"{len(report.violations)} violation(s) in "
                 f"{len(report.journals)} journal(s): {report.sends} "
                 f"send(s), {report.recvs} recv(s), "
-                f"{report.faults} fault record(s)" + elastic_note + where
+                f"{report.faults} fault record(s)"
+                + elastic_note + trunc_note + where
             )
     if args.json:
         # single-dir invocations keep the original flat document shape

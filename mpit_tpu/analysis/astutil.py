@@ -24,13 +24,23 @@ def iter_comments(source_lines: list) -> Iterator[tuple]:
         return
 
 
-def build_parents(tree: ast.AST) -> dict:
-    """child node -> parent node, for upward walks (enclosing fn, loops)."""
+def walk_and_parents(tree: ast.AST) -> tuple:
+    """(flat node list in ``ast.walk`` order, child -> parent map), both in
+    ONE traversal. Loaded once per module: a dozen rules each re-walking
+    every tree is the dominant cost of the whole-package scan, so rules
+    iterate ``mod.nodes`` instead."""
+    nodes = [tree]
     parents: dict = {}
-    for node in ast.walk(tree):
+    for node in nodes:  # appending while indexing = the same BFS as walk
         for child in ast.iter_child_nodes(node):
             parents[child] = node
-    return parents
+            nodes.append(child)
+    return nodes, parents
+
+
+def build_parents(tree: ast.AST) -> dict:
+    """child node -> parent node, for upward walks (enclosing fn, loops)."""
+    return walk_and_parents(tree)[1]
 
 
 def enclosing_symbol(node: ast.AST, parents: dict) -> str:

@@ -59,6 +59,15 @@ the version counter may legitimately step back across a ``gen`` bump
 (the PARAM journal records carry ``gen``); within a generation it must
 still never decrease.
 
+Truncated journals: a journal may also declare ITSELF incomplete via
+its ``journal_cap`` footer — cap mode drops the tail once
+``MPIT_OBS_MAX_RECORDS`` is hit, ring mode (``MPIT_OBS_RING``) evicts
+the head to keep the newest window. Ranks whose footer shows non-zero
+drops/evictions get the same scoped licensing as churned ranks (a recv
+may name an evicted send, streams touching them may not balance);
+see :func:`truncated_ranks`. A footer with zero drops declares the
+journal complete and licenses nothing.
+
 Like the rest of the analysis package this module imports neither jax
 nor the transport stack — journals are just files.
 """
@@ -96,6 +105,7 @@ class ConformanceReport:
     faults: int
     violations: list
     churned: list = dataclasses.field(default_factory=list)
+    truncated: list = dataclasses.field(default_factory=list)
 
     @property
     def ok(self) -> bool:
@@ -130,10 +140,32 @@ def _load(obs_dir: str, faults_path: Optional[str]):
     for p in paths:
         records.extend(
             r for r in merge.read_journal(p) if r.get("ev") in
-            ("send", "isend", "recv", "param_version")
+            ("send", "isend", "recv", "param_version", "journal_cap")
         )
     faults = merge.read_fault_log(faults_path or obs_dir)
     return paths, records, faults
+
+
+def truncated_ranks(records: list) -> frozenset:
+    """Ranks whose own journal declares itself incomplete via a
+    ``journal_cap`` footer (written incrementally, so it survives even
+    a SIGKILL): cap mode dropped the stream's TAIL
+    (``dropped_records > 0``), ring mode evicted its HEAD
+    (``evicted_records > 0``). Either way the rank's record set is an
+    honest subset — license it exactly like a churned rank. A footer
+    with zero drops/evictions declares the journal COMPLETE and earns
+    no license. Unlike membership licensing this is never disabled by
+    ``--strict``/``elastic=False``: the evidence is in the journal
+    itself, not in a side file."""
+    out = set()
+    for r in records:
+        if r.get("ev") != "journal_cap":
+            continue
+        if r.get("dropped_records", 0) or r.get("evicted_records", 0):
+            rank = merge._rec_rank(r)
+            if isinstance(rank, int):
+                out.add(rank)
+    return frozenset(out)
 
 
 def _tc201_causality(
@@ -348,10 +380,12 @@ def check_conformance(
     paths, records, faults = _load(obs_dir, faults_path)
     membership = load_membership(obs_dir) if elastic is not False else []
     churned = churned_ranks(membership)
-    roles = protocol.extract_roles(project)
+    truncated = truncated_ranks(records)
+    licensed = churned | truncated
+    roles = project.roles
     sem = protocol.extract_semantics(project)
-    violations = list(_tc201_causality(records, churned))
-    violations.extend(_tc202_conservation(records, faults, sem, churned))
+    violations = list(_tc201_causality(records, licensed))
+    violations.extend(_tc202_conservation(records, faults, sem, licensed))
     violations.extend(_tc203_roles(records, roles))
     violations.extend(_tc204_version_monotonic(records))
     return ConformanceReport(
@@ -362,4 +396,5 @@ def check_conformance(
         faults=len(faults),
         violations=violations,
         churned=sorted(churned),
+        truncated=sorted(truncated),
     )

@@ -82,6 +82,7 @@ class ModuleCtx:
     tree: ast.Module
     source_lines: list
     parents: dict
+    nodes: list  # flat ast.walk order — rules iterate this, never re-walk
     ignores: dict  # line -> set of rule ids, or {"*"}
     barrier_defs: set  # function names marked host-sync-barrier
 
@@ -113,6 +114,9 @@ class Project:
     # lazily-built cross-module name-resolution index (analysis/graph.py);
     # per-file rules never touch it, cross-module rules share one build
     _graph: object = dataclasses.field(default=None, repr=False)
+    # lazily-extracted role models (analysis/protocol.py) — the protocol
+    # rules, the model check, and conformance all need the same extraction
+    _roles: object = dataclasses.field(default=None, repr=False)
 
     @property
     def graph(self):
@@ -121,6 +125,14 @@ class Project:
 
             self._graph = graph_mod.ModuleGraph(self.modules)
         return self._graph
+
+    @property
+    def roles(self):
+        if self._roles is None:
+            from mpit_tpu.analysis import protocol
+
+            self._roles = protocol.extract_roles(self)
+        return self._roles
 
 
 def _parse_ignores(source_lines: list) -> dict:
@@ -136,11 +148,11 @@ def _parse_ignores(source_lines: list) -> dict:
     return out
 
 
-def _parse_barriers(tree: ast.Module, source_lines: list) -> set:
+def _parse_barriers(nodes: list, source_lines: list) -> set:
     """Function names whose def line (or the line above it) carries the
     host-sync-barrier marker."""
     out = set()
-    for node in ast.walk(tree):
+    for node in nodes:
         if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
             continue
         for ln in (node.lineno, node.lineno - 1):
@@ -159,14 +171,16 @@ def load_module(path: Path, rel: str) -> Optional[ModuleCtx]:
     except (OSError, SyntaxError):
         return None  # unreadable / non-parse files are out of scope
     lines = source.splitlines()
+    nodes, parents = astutil.walk_and_parents(tree)
     return ModuleCtx(
         path=path,
         rel=rel,
         tree=tree,
         source_lines=lines,
-        parents=astutil.build_parents(tree),
+        parents=parents,
+        nodes=nodes,
         ignores=_parse_ignores(lines),
-        barrier_defs=_parse_barriers(tree, lines),
+        barrier_defs=_parse_barriers(nodes, lines),
     )
 
 

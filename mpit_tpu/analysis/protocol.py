@@ -238,7 +238,7 @@ def extract_module_ops(mod, graph) -> list:
     ops: list = []
     saw_wildcard_recv = False
     dispatch_candidates: list = []
-    for node in ast.walk(mod.tree):
+    for node in mod.nodes:
         if isinstance(node, ast.Compare):
             for cand, dotted in _dispatch_tag_nodes(node):
                 val = graph.resolve_constant(info, dotted)
@@ -409,7 +409,7 @@ def _classify_dispatch(server, by_rel, graph, reply_tag):
         if mod is None:
             continue
         info = graph.module_for_rel(rel)
-        for node in ast.walk(mod.tree):
+        for node in mod.nodes:
             if not isinstance(node, ast.If) or not isinstance(
                 node.test, ast.Compare
             ):
@@ -460,7 +460,7 @@ def _reply_is_echoed(server, by_rel, graph, reply_tag) -> bool:
         if mod is None:
             continue
         info = graph.module_for_rel(rel)
-        for node in ast.walk(mod.tree):
+        for node in mod.nodes:
             if not (
                 isinstance(node, ast.Call) and _is_transport_send(node)
             ):
@@ -492,7 +492,7 @@ def _client_reply_handling(client, by_rel, graph, reply_tag):
         if mod is None:
             continue
         info = graph.module_for_rel(rel)
-        for node in ast.walk(mod.tree):
+        for node in mod.nodes:
             if not isinstance(node, ast.Call):
                 continue
             if astutil.call_last_name(node) not in _RECV_NAMES:
@@ -547,7 +547,7 @@ def _extract_dedup(server, by_rel):
         mod = by_rel.get(rel)
         if mod is None:
             continue
-        for node in ast.walk(mod.tree):
+        for node in mod.nodes:
             if (
                 not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef))
                 or node.name != "admit"
@@ -642,7 +642,7 @@ def _extract_snapshot_dedup(server, by_rel) -> Optional[bool]:
         mod = by_rel.get(rel)
         if mod is None:
             continue
-        for node in ast.walk(mod.tree):
+        for node in mod.nodes:
             if not isinstance(
                 node, (ast.FunctionDef, ast.AsyncFunctionDef)
             ) or not (
@@ -667,7 +667,7 @@ def extract_semantics(project) -> Optional[ProtocolSemantics]:
     """The modeled client/server pair's fault semantics, or None when the
     scan set has no recognizable request/reply protocol (no role pair, no
     unique reply tag, or no dispatch branch answering a request)."""
-    roles = extract_roles(project)
+    roles = project.roles
     client = server = None
     for name in sorted(roles):
         cand = roles[name]

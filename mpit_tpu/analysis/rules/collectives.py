@@ -97,7 +97,7 @@ def run(project) -> Iterable:
     for mod in project.modules:
         bound = _bound_axes(mod.tree)
         bare_ok = _lax_imports(mod.tree)
-        for node in ast.walk(mod.tree):
+        for node in mod.nodes:
             if not isinstance(node, ast.Call):
                 continue
             dotted = astutil.dotted_name(node.func)

@@ -104,7 +104,7 @@ def run(project) -> Iterable:
         if not mod.is_hot(project.config):
             continue
         np_names = _numpy_names(mod.tree)
-        for node in ast.walk(mod.tree):
+        for node in mod.nodes:
             if not isinstance(node, ast.Call):
                 continue
             name = astutil.call_last_name(node)

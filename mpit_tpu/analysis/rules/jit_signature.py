@@ -211,10 +211,10 @@ def run(project) -> Iterable:
         # for jit-assignment targets the graph's module-level view misses
         local_defs = {
             n.name: n
-            for n in ast.walk(mod.tree)
+            for n in mod.nodes
             if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))
         }
-        for node in ast.walk(mod.tree):
+        for node in mod.nodes:
             if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
                 target = CallableInfo(fn=node, module=info)
                 for dec in node.decorator_list:

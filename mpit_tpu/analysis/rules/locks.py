@@ -71,7 +71,7 @@ def _lockish_name(expr: ast.AST) -> Optional[str]:
 
 def run(project) -> Iterable:
     for mod in project.modules:
-        for node in ast.walk(mod.tree):
+        for node in mod.nodes:
             if not isinstance(node, (ast.With, ast.AsyncWith)):
                 continue
             guards = [

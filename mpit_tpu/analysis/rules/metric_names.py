@@ -179,7 +179,7 @@ def run(project) -> Iterable:
         if not _imports_live(mod.tree):
             continue
         info = graph.module_for_rel(mod.rel)
-        for node in ast.walk(mod.tree):
+        for node in mod.nodes:
             if not isinstance(node, ast.Call):
                 continue
             if not isinstance(node.func, ast.Attribute):

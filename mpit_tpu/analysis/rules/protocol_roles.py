@@ -133,7 +133,7 @@ def _cross_waits(role, cp, by_rel) -> Iterable:
 
 
 def run(project) -> Iterable:
-    roles = protocol.extract_roles(project)
+    roles = project.roles
     by_rel = {m.rel: m for m in project.modules}
     for role in roles.values():
         cp = roles.get(role.counterpart)

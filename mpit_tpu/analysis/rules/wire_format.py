@@ -330,7 +330,7 @@ def run(project) -> Iterable:
         if not (p_mods or p_fns or f_mods or f_fns):
             continue
         info = graph.module_for_rel(mod.rel)
-        for node in ast.walk(mod.tree):
+        for node in mod.nodes:
             if not isinstance(node, ast.Call):
                 continue
             if pkl is not None and _is_dumps_call(node, p_mods, p_fns):

@@ -158,17 +158,54 @@ def main(argv=None) -> int:
     def _member(kind: str, rank: int, gen: int, **extra) -> None:
         """One membership transition in the run's obs directory — the
         ground truth conformance uses to license journal gaps on
-        churned ranks (a SIGKILLed process cannot flush its tail)."""
+        churned ranks (a SIGKILLed process cannot flush its tail).
+        ``t`` is run-relative (monotonic since launch); ``wt`` is the
+        wall clock, the join key ``obs postmortem`` uses to place a
+        kill/exit on the black-box dump timeline."""
         if mem_path is None:
             return
         rec = {
             "ev": "membership", "kind": kind, "rank": rank, "gen": gen,
-            "t": round(time.monotonic() - t0, 3), **extra,
+            "t": round(time.monotonic() - t0, 3),
+            "wt": round(time.time(), 3), **extra,
         }
         with mem_lock:
             os.makedirs(os.path.dirname(mem_path), exist_ok=True)
             with open(mem_path, "a") as f:
                 f.write(json.dumps(rec) + "\n")
+
+    def _request_blackbox(kind: str, rank: int, gen: int) -> None:
+        """Freeze the incident window fleet-wide: ask every surviving
+        rank's flight recorder to dump (the dead rank can't — the
+        survivors' windows are what still show its final exchanges)."""
+        if obs_dir is None:
+            return
+        try:
+            from mpit_tpu.obs.blackbox import request_dump
+
+            request_dump(
+                obs_dir, f"launch:{kind}", f"{kind}-rank{rank}-gen{gen}"
+            )
+        except Exception:
+            pass  # forensics must never take the supervisor down
+
+    def _archive_blackbox(rank: int, gen: int) -> None:
+        """Before respawning a rank, park its dump file under a
+        per-generation name so the next generation's dumps don't
+        interleave with the dead one's."""
+        if obs_dir is None:
+            return
+        path = os.path.join(obs_dir, "blackbox", f"rank_{rank}.jsonl")
+        try:
+            if os.path.exists(path):
+                os.replace(
+                    path,
+                    os.path.join(
+                        obs_dir, "blackbox", f"rank_{rank}.gen{gen}.jsonl"
+                    ),
+                )
+        except OSError:
+            pass
 
     procs: list[subprocess.Popen] = []
     streams: list[threading.Thread] = []
@@ -242,7 +279,8 @@ def main(argv=None) -> int:
                         procs[r].kill()
                     except (ProcessLookupError, OSError):
                         continue
-                    _member("kill", r, gens[r])
+                    _member("kill", r, gens[r], signal="SIGKILL")
+                    _request_blackbox("kill", r, gens[r])
 
         threading.Thread(
             target=_killer, daemon=True, name="mpit-elastic-killer"
@@ -264,13 +302,23 @@ def main(argv=None) -> int:
                 if world_down:
                     remaining.discard(r)
                     continue
-                _member("exit", r, gens[r], code=code)
+                # a negative returncode is death-by-signal: name it, so
+                # the post-mortem can cite "exit by SIGKILL" not "-9"
+                cause = {"code": code}
+                if code < 0:
+                    try:
+                        cause["signal"] = signal.Signals(-code).name
+                    except ValueError:
+                        pass
+                _member("exit", r, gens[r], **cause)
+                _request_blackbox("exit", r, gens[r])
                 if budget[r] > 0:
                     # elastic: the rank died with budget left — respawn it
                     # in place (same rank/port, next generation) instead
                     # of taking the world down
                     budget[r] -= 1
                     gens[r] += 1
+                    _archive_blackbox(r, gens[r] - 1)
                     with procs_lock:
                         procs[r] = _spawn(r, gens[r])
                     print(

@@ -100,9 +100,20 @@ class _ServeObs:
                 "record request lifecycles into)"
             )
         os.makedirs(config.dir, exist_ok=True)
+        box = None
+        if getattr(config, "blackbox", False):
+            from mpit_tpu.obs.blackbox import BlackBox
+
+            box = BlackBox(
+                config.dir, 0,
+                max_records=getattr(config, "blackbox_records", 2048),
+                max_seconds=getattr(config, "blackbox_seconds", 30.0),
+            )
         self.journal = Journal(
             os.path.join(config.dir, "obs_rank0.jsonl"), 0,
             max_records=getattr(config, "max_records", None),
+            mode="ring" if getattr(config, "ring", False) else "cap",
+            blackbox=box,
         )
         self.clock = LogicalClock()
         self.registry = None
@@ -119,36 +130,56 @@ class _ServeObs:
             )
 
     def event(self, ev: str, **fields) -> None:
+        self._lifecycle(ev, fields)
         self.journal.event(ev, self.clock.tick(), **fields)
         if self.registry is not None:
             self._publish(ev, fields)
 
-    def _publish(self, ev: str, fields: dict) -> None:
-        """Fold one journal event into the live registry. Latencies are
-        measured here (monotonic, enqueue → first token / finish) rather
-        than re-deriving them from journal timestamps — the live plane
-        must not depend on the journal surviving or being re-read."""
-        reg = self.registry
+    def _lifecycle(self, ev: str, fields: dict) -> None:
+        """Tag lifecycle records with the latencies this recorder already
+        measures (monotonic, enqueue → first token / finish):
+        ``req_first_token`` gains ``ttft_ms``, ``req_finish`` gains
+        ``e2e_ms`` + ``slo_miss`` (vs the request's own ``slo_ms``). The
+        tags land in the JOURNAL record itself — a black-box dump or a
+        capped journal is then post-mortem-able on its face, without
+        replaying the whole request stream to re-derive latencies."""
         now = time.monotonic()
         if ev == "req_enqueue":
-            reg.inc(M_REQ_SUBMITTED)
             self._open_reqs[fields.get("rid")] = (now, fields.get("slo_ms"))
         elif ev == "req_first_token":
             open_rec = self._open_reqs.get(fields.get("rid"))
             if open_rec is not None:
-                reg.observe(M_TTFT, now - open_rec[0])
+                fields["ttft_ms"] = round((now - open_rec[0]) * 1e3, 3)
         elif ev == "req_finish":
             open_rec = self._open_reqs.pop(fields.get("rid"), None)
-            reg.inc(M_REQ_FINISHED)
-            reg.inc(M_TOKENS, float(fields.get("gen", 0)))
             if open_rec is not None:
-                e2e = now - open_rec[0]
-                reg.observe(M_E2E, e2e)
+                e2e_ms = (now - open_rec[0]) * 1e3
+                fields["e2e_ms"] = round(e2e_ms, 3)
                 slo_ms = open_rec[1]
-                if slo_ms is not None and e2e * 1e3 > slo_ms:
-                    reg.inc(M_SLO_MISSES)
+                if slo_ms is not None:
+                    fields["slo_miss"] = bool(e2e_ms > slo_ms)
         elif ev == "req_cancel":
             self._open_reqs.pop(fields.get("rid"), None)
+
+    def _publish(self, ev: str, fields: dict) -> None:
+        """Fold one journal event into the live registry, reusing the
+        latencies :meth:`_lifecycle` already stamped into the record —
+        the live plane must not depend on the journal surviving or
+        being re-read."""
+        reg = self.registry
+        if ev == "req_enqueue":
+            reg.inc(M_REQ_SUBMITTED)
+        elif ev == "req_first_token":
+            if "ttft_ms" in fields:
+                reg.observe(M_TTFT, fields["ttft_ms"] / 1e3)
+        elif ev == "req_finish":
+            reg.inc(M_REQ_FINISHED)
+            reg.inc(M_TOKENS, float(fields.get("gen", 0)))
+            if "e2e_ms" in fields:
+                reg.observe(M_E2E, fields["e2e_ms"] / 1e3)
+                if fields.get("slo_miss"):
+                    reg.inc(M_SLO_MISSES)
+        elif ev == "req_cancel":
             reg.inc(M_REQ_CANCELLED)
         elif ev == "segment":
             reg.inc(M_SEGMENTS)

@@ -21,12 +21,26 @@ journals to update-quality evidence — per-source push staleness,
 per-client elastic-distance trajectories with a divergence verdict,
 update/param norm ratios — via ``python -m mpit_tpu.obs dynamics
 <dir> [--gate dynamics.json]``.
+
+The black box (:mod:`mpit_tpu.obs.blackbox`, on by default whenever a
+journal dir is armed) keeps a bounded ring of each rank's last records
+and dumps it to ``<dir>/blackbox/rank_<r>.jsonl`` on SIGTERM/atexit/
+alert/supervisor request; ``python -m mpit_tpu.obs postmortem <dir>``
+(:mod:`mpit_tpu.obs.postmortem`) assembles the dumps into a cross-rank
+incident report — first-mover, final exchange rounds acked/dropped,
+staleness/elastic/wire-phase overlays.
 """
 
 from mpit_tpu.obs.alerts import (  # noqa: F401
     AlertConfig,
     AlertEngine,
     read_alerts,
+)
+from mpit_tpu.obs.blackbox import (  # noqa: F401
+    BlackBox,
+    arm_process_triggers,
+    box_for,
+    request_dump,
 )
 from mpit_tpu.obs.core import (  # noqa: F401
     Journal,
@@ -63,6 +77,11 @@ from mpit_tpu.obs.merge import (  # noqa: F401
     roofline,
     summarize,
     trace_ids_by_rank,
+)
+from mpit_tpu.obs.postmortem import (  # noqa: F401
+    analyze as analyze_postmortem,
+    format_report as format_postmortem,
+    load_dumps,
 )
 from mpit_tpu.obs.telemetry import (  # noqa: F401
     TelemetryTransport,

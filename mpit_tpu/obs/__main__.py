@@ -7,6 +7,7 @@
     python -m mpit_tpu.obs slo RUN_DIR [--gate slo.json] [--json]
     python -m mpit_tpu.obs dynamics RUN_DIR [--gate dynamics.json] [--json]
     python -m mpit_tpu.obs live RUN_DIR [--once] [--json] [--validate]
+    python -m mpit_tpu.obs postmortem RUN_DIR [--json] [--perfetto t.json]
 
 ``RUN_DIR`` is the ``MPIT_OBS_DIR`` of the run (or explicit journal
 files). ``merge`` writes Chrome-trace JSON — open it at
@@ -31,9 +32,15 @@ the run roll-up (``staleness_p99_max``, ``elastic_dist_final_max``,
 ``live`` reads the in-run snapshots a ``MPIT_OBS_LIVE=1`` run exports
 (``live/rank_<r>.json``), renders a refreshing cross-rank dashboard
 (``--once --json`` for scripting), and runs the online alert engine
-(dead-rank, straggler, SLO burn) appending ``live/alerts.jsonl``.
-Exit codes: 0 ok, 1 gate violation / new alerts / invalid snapshot,
-2 usage/empty.
+(dead-rank, straggler, SLO burn) appending ``live/alerts.jsonl`` —
+each firing also requests a black-box dump on every rank of the run
+(``--no-dump`` to observe without touching the run dir).
+``postmortem`` assembles the cross-rank incident report from the
+black-box dumps (``blackbox/rank_*.jsonl``): first-mover, last
+exchange rounds acked/dropped, staleness/elastic/wire-phase overlays,
+membership + chaos churn — see docs/OBSERVABILITY.md "Black box".
+Exit codes: 0 ok, 1 gate violation / new alerts / invalid snapshot /
+incident found, 2 usage/empty.
 """
 
 from __future__ import annotations
@@ -263,9 +270,25 @@ def _cmd_live(ns) -> int:
                 ("slo_target", ns.slo_target),
             ) if v is not None
         }
+        on_fire = None
+        if not ns.no_dump:
+            from mpit_tpu.obs import blackbox as blackbox_mod
+
+            # live_dir is <run dir>/live — the dump request goes in the
+            # run dir, where every rank's watcher thread polls for it
+            run_dir = os.path.dirname(os.path.abspath(live_dir))
+
+            def on_fire(rec):
+                blackbox_mod.request_dump(
+                    run_dir,
+                    f"alert:{rec.get('kind')}",
+                    f"{rec.get('kind')}-rank{rec.get('rank')}",
+                )
+
         engine = alerts_mod.AlertEngine(
             os.path.join(live_dir, "alerts.jsonl"),
             alerts_mod.AlertConfig(**kwargs),
+            on_fire=on_fire,
         )
 
     deadline = (
@@ -302,6 +325,42 @@ def _cmd_live(ns) -> int:
             _time.sleep(ns.refresh)
     except KeyboardInterrupt:
         return 0
+
+
+def _cmd_postmortem(ns) -> int:
+    from mpit_tpu.obs import postmortem as pm
+
+    report = pm.analyze(ns.path, k_rounds=ns.rounds)
+    if report is None:
+        print(f"no black-box dumps under {ns.path} (expected "
+              "blackbox/rank_*.jsonl — did any trigger fire?)",
+              file=sys.stderr)
+        return 2
+    if ns.json:
+        json.dump(report, sys.stdout)
+        print()
+    else:
+        print(pm.format_report(report))
+    if ns.perfetto is not None:
+        faults = None
+        if glob.glob(os.path.join(ns.path, "faults*.jsonl")):
+            faults = ns.path
+        alerts = None
+        for cand in (
+            os.path.join(ns.path, "live", "alerts.jsonl"),
+            os.path.join(ns.path, "alerts.jsonl"),
+        ):
+            if os.path.exists(cand):
+                alerts = cand
+                break
+        trace = merge_to_chrome_trace(
+            pm.dump_paths(ns.path), faults_path=faults, alerts_path=alerts
+        )
+        with open(ns.perfetto, "w") as f:
+            json.dump(trace, f)
+        print(f"wrote {ns.perfetto}: {len(trace['traceEvents'])} "
+              "incident-window events", file=sys.stderr)
+    return 1 if report["verdict"] == "incident" else 0
 
 
 def main(argv=None) -> int:
@@ -393,6 +452,9 @@ def main(argv=None) -> int:
     vp.add_argument("--no-alerts", action="store_true",
                     help="display only: skip the alert engine (nothing "
                          "appended to alerts.jsonl)")
+    vp.add_argument("--no-dump", action="store_true",
+                    help="alerts fire without requesting black-box dumps "
+                         "(observe without writing into the run dir)")
     vp.add_argument("--staleness-factor", type=float, default=None,
                     help="dead-rank threshold as a multiple of each "
                          "rank's export interval (default 3)")
@@ -410,12 +472,31 @@ def main(argv=None) -> int:
                          "versioned schema and exit (the lint.sh golden "
                          "gate)")
 
+    pp = sub.add_parser(
+        "postmortem",
+        help="cross-rank incident report from black-box dumps",
+    )
+    pp.add_argument("path",
+                    help="run dir (MPIT_OBS_DIR) holding "
+                         "blackbox/rank_*.jsonl, or a dump dir itself")
+    pp.add_argument("--json", action="store_true",
+                    help="emit the full report as JSON")
+    pp.add_argument("--rounds", type=int, default=5,
+                    help="exchange rounds reconstructed per rank "
+                         "(default 5)")
+    pp.add_argument("--perfetto", default=None, metavar="OUT",
+                    help="also write an incident-window Chrome trace of "
+                         "the dumps (open at https://ui.perfetto.dev)")
+
     ns = p.parse_args(argv)
 
-    # live reads rank_*.json snapshots, not obs_rank*.jsonl journals —
-    # dispatch it before the journal-expansion gate below
+    # live and postmortem read their own layouts (rank_*.json snapshots
+    # / blackbox dumps), not obs_rank*.jsonl journals — dispatch them
+    # before the journal-expansion gate below
     if ns.cmd == "live":
         return _cmd_live(ns)
+    if ns.cmd == "postmortem":
+        return _cmd_postmortem(ns)
 
     if ns.cmd == "summary" and ns.diff:
         if len(ns.paths) != 2:

@@ -115,11 +115,24 @@ class AlertEngine:
     ``path=None`` keeps the engine in-memory (tests, dashboards that
     only display). ``evaluate`` returns the records that fired *this*
     pass; an alert stays suppressed while its condition persists and
-    re-arms once the condition clears."""
+    re-arms once the condition clears.
 
-    def __init__(self, path: Optional[str], config: AlertConfig = AlertConfig()):
+    ``on_fire`` (optional) is called once per newly-fired record —
+    the black-box trigger hook: ``obs live`` wires it to
+    :func:`mpit_tpu.obs.blackbox.request_dump` so a dead_rank /
+    straggler / slo_burn / divergence firing freezes the incident
+    window on every rank of the fleet. A callback that raises never
+    takes the alert loop down."""
+
+    def __init__(
+        self,
+        path: Optional[str],
+        config: AlertConfig = AlertConfig(),
+        on_fire=None,
+    ):
         self.path = path
         self.config = config
+        self.on_fire = on_fire
         self._active: set = set()  # (kind, rank) currently firing
         # dynamics histories: rank -> [(seq, value), ...] capped at
         # _HISTORY_CAP; advanced once per NEW snapshot seq (see
@@ -307,6 +320,12 @@ class AlertEngine:
             with open(self.path, "a") as f:
                 for rec in fired:
                     f.write(json.dumps(rec) + "\n")
+        if fired and self.on_fire is not None:
+            for rec in fired:
+                try:
+                    self.on_fire(rec)
+                except Exception:
+                    pass
         return fired
 
 

@@ -37,9 +37,31 @@ obs must never arm implicitly — only recognized ``MPIT_OBS_*`` knobs count.
   MPIT_OBS_SAMPLE       int  journal every Nth wire event per stream
                              (default 1 = all; counters always see all)
   MPIT_OBS_MAX_RECORDS  int  per-journal record cap: writes past it are
-                             dropped and counted, and close() appends a
-                             ``journal_cap`` footer carrying
-                             ``dropped_records`` (default: unbounded)
+                             dropped and counted, and a ``journal_cap``
+                             footer carrying ``dropped_records`` is kept
+                             current on disk (default: unbounded)
+  MPIT_OBS_RING         0|1  ring journal mode: keep the LAST
+                             ``max_records`` (default 4096) instead of
+                             the first — a long soak preserves its crash,
+                             not its boring start; the evicted head is
+                             counted in the ``journal_cap`` footer
+                             (``mode: "ring"``) and conformance licenses
+                             it like a churned tail (default 0)
+  MPIT_OBS_BLACKBOX     0|1  flight recorder (docs/OBSERVABILITY.md
+                             "Black box"): every journal also tees into
+                             a bounded in-memory ring that dumps to
+                             ``<dir>/blackbox/rank_<r>.jsonl`` on
+                             SIGTERM/atexit/close/alert/dump-request
+                             (default 1 — armed whenever a dir is set)
+  MPIT_OBS_BLACKBOX_RECORDS
+                        int  black-box ring capacity, records (2048)
+  MPIT_OBS_BLACKBOX_SECONDS
+                        sec  black-box ring horizon: records older than
+                             this are evicted regardless of count (30)
+  MPIT_OBS_BLACKBOX_DUMP_SIGNAL
+                        str  extra dump trigger: a signal name/number
+                             (e.g. ``USR1``) that dumps the ring and
+                             continues running (default: unset)
   MPIT_OBS_LIVE         0|1  live telemetry plane: per-rank metrics
                              registry + background snapshot exporter
                              writing ``<dir>/live/rank_<r>.json``
@@ -124,24 +146,60 @@ class Journal:
 
     ``max_records`` caps journal growth (a million-request load run must
     not fill the disk silently): writes past the cap are dropped and
-    counted, and :meth:`close` appends one ``journal_cap`` footer record
-    carrying the ``dropped_records`` total — readers see the loss
-    explicitly instead of inferring it from absence."""
+    counted into a ``journal_cap`` footer record carrying the
+    ``dropped_records`` total — readers see the loss explicitly instead
+    of inferring it from absence. The footer is kept current on disk
+    *incrementally* (appended on the first drop, rewritten in place
+    every ``_FOOTER_EVERY`` drops and at close), so a SIGKILLed rank's
+    journal still confesses its truncation to within ``_FOOTER_EVERY``
+    drops — ``obs slo`` and conformance must not need a clean exit to
+    learn that records are missing.
+
+    ``mode="ring"`` inverts the cap: the journal buffers the LAST
+    ``max_records`` in memory (evicting the oldest, counted as
+    ``evicted_records``) and flushes the survivors at :meth:`close` —
+    a week-long soak keeps its crash window, not its boring start. The
+    flushed journal ends with the same ``journal_cap`` footer plus
+    ``mode: "ring"`` so readers (and TC202's licensing) can tell an
+    evicted head from lost messages. The memory-buffered tail is the
+    honest cost: a SIGKILLed ring journal writes nothing — which is
+    exactly the gap the black-box dump triggers exist to cover
+    (:mod:`mpit_tpu.obs.blackbox`).
+
+    ``blackbox`` tees every record (including ones the cap drops) into
+    the rank's in-memory flight recorder; the tee is a deque append —
+    its cost on the journal hot path is pinned by
+    tests/test_blackbox.py."""
+
+    #: rewrite the on-disk footer every this-many drops (kill-safety
+    #: granularity vs. one extra seek+write per drop)
+    _FOOTER_EVERY = 64
+    _RING_DEFAULT_RECORDS = 4096
 
     def __init__(
-        self, path: str, rank: int, max_records: Optional[int] = None
+        self, path: str, rank: int, max_records: Optional[int] = None,
+        mode: str = "cap", blackbox: Optional[Any] = None,
     ):
         from mpit_tpu.utils.metrics import MetricsLogger
 
         if max_records is not None and max_records < 1:
             raise ValueError("max_records must be >= 1")
+        if mode not in ("cap", "ring"):
+            raise ValueError("mode must be 'cap' or 'ring'")
+        if mode == "ring" and max_records is None:
+            max_records = self._RING_DEFAULT_RECORDS
         self.path = path
         self.rank = rank
+        self.mode = mode
         self.max_records = max_records
         self.dropped_records = 0
+        self.evicted_records = 0
+        self.blackbox = blackbox
         self._written = 0
         self._closed = False
+        self._footer_off: Optional[int] = None
         self._lock = make_lock("obs.Journal._lock")
+        self._ring: Optional[list] = [] if mode == "ring" else None
         self._m = MetricsLogger(
             path, tag="obs", echo=False, all_processes=True
         )
@@ -154,33 +212,90 @@ class Journal:
         for k in self._RESERVED:
             if k in fields:
                 fields[f"x_{k}"] = fields.pop(k)
+        t = time.time()
         with self._lock:
             if self._closed:
+                return
+            if self.blackbox is not None:
+                # the tee sees EVERY record — including ones the cap is
+                # about to drop; that inversion (cap keeps the head, the
+                # flight recorder keeps the tail) is the black box's job
+                self.blackbox.record(t, clk, ev, fields)
+            if self._ring is not None:
+                self._ring.append((t, clk, ev, fields))
+                if len(self._ring) > self.max_records:
+                    del self._ring[0]
+                    self.evicted_records += 1
                 return
             if (
                 self.max_records is not None
                 and self._written >= self.max_records
             ):
                 self.dropped_records += 1
+                if (
+                    self.dropped_records == 1
+                    or self.dropped_records % self._FOOTER_EVERY == 0
+                ):
+                    self._write_footer_locked()
                 return
             self._written += 1
-            self._m.log(clk, rank=self.rank, ev=ev, t=time.time(), **fields)
+            self._m.log(clk, rank=self.rank, ev=ev, t=t, **fields)
+
+    def _write_footer_locked(self) -> None:
+        """Append-or-rewrite the ``journal_cap`` footer as the journal's
+        last line. The stream is opened in append mode, so a rewrite is
+        truncate-to-remembered-offset + append — after the cap no
+        regular record ever follows the footer, so the offset stays
+        valid for the journal's lifetime. Never raises: drop accounting
+        must not kill the run it describes."""
+        f = getattr(self._m, "_f", None)
+        if f is None:
+            return
+        try:
+            f.flush()
+            if self._footer_off is None:
+                self._footer_off = f.tell()
+            else:
+                f.truncate(self._footer_off)
+            extra = {}
+            if self.mode == "ring":
+                extra["mode"] = "ring"
+                extra["evicted_records"] = self.evicted_records
+            self._m.log(
+                self._written, rank=self.rank, ev="journal_cap",
+                t=time.time(), cap=self.max_records,
+                dropped_records=self.dropped_records, **extra,
+            )
+        except (OSError, ValueError):
+            pass
 
     def close(self) -> None:
         with self._lock:
             if self._closed:
                 return
             self._closed = True
+            if self._ring is not None:
+                # flush the survivors in arrival order; their original
+                # ``t`` stamps keep the per-rank monotonicity contract
+                # (the footer's close-time t is >= all of them)
+                for t, clk, ev, fields in self._ring:
+                    self._written += 1
+                    self._m.log(
+                        clk, rank=self.rank, ev=ev, t=t, **fields
+                    )
+                self._ring = None
             if self.max_records is not None:
                 # the footer rides OUTSIDE the cap (one fixed record),
                 # and is written even at zero drops — "0 dropped" is an
                 # assertion, absence is just a journal without a cap
-                self._m.log(
-                    self._written, rank=self.rank, ev="journal_cap",
-                    t=time.time(), cap=self.max_records,
-                    dropped_records=self.dropped_records,
-                )
+                self._write_footer_locked()
             self._m.close()
+        if self.blackbox is not None:
+            # a cleanly-closed rank leaves its final window next to its
+            # journal — post-mortems then cover the whole fleet, not
+            # just the ranks something went wrong on
+            self.blackbox.dump("close")
+            self.blackbox.close()
 
 
 @dataclasses.dataclass(frozen=True)
@@ -203,7 +318,15 @@ class ObsConfig:
     :func:`faulthandler.dump_traceback_later` timer at that interval in
     seconds, dumping all threads' stacks to ``<dir>/stacks_<label>.txt``
     (stderr when ``dir`` is None) so a wedged rank leaves evidence next
-    to its journal instead of nothing (0.0 = off)."""
+    to its journal instead of nothing (0.0 = off);
+    ``ring=True`` flips each journal to last-``max_records`` ring mode
+    (see :class:`Journal` — a soak keeps its crash, not its start);
+    ``blackbox`` (default True) arms the per-rank flight recorder
+    whenever ``dir`` is set — a bounded in-memory ring of the last
+    ``blackbox_records`` records / ``blackbox_seconds`` seconds, dumped
+    to ``<dir>/blackbox/rank_<r>.jsonl`` on SIGTERM, atexit, clean
+    close, an alert-driven dump request, or the explicit
+    ``blackbox_dump_signal`` (:mod:`mpit_tpu.obs.blackbox`)."""
 
     dir: Optional[str] = None
     trace: bool = True
@@ -213,6 +336,11 @@ class ObsConfig:
     live: bool = False
     live_interval: float = 1.0
     faulthandler: float = 0.0
+    ring: bool = False
+    blackbox: bool = True
+    blackbox_records: int = 2048
+    blackbox_seconds: float = 30.0
+    blackbox_dump_signal: Optional[str] = None
 
     def __post_init__(self):
         if self.sample < 1:
@@ -223,13 +351,19 @@ class ObsConfig:
             raise ValueError("live_interval must be > 0")
         if self.faulthandler < 0:
             raise ValueError("faulthandler must be >= 0 (0 = off)")
+        if self.blackbox_records < 1:
+            raise ValueError("blackbox_records must be >= 1")
+        if self.blackbox_seconds <= 0:
+            raise ValueError("blackbox_seconds must be > 0")
 
 
 _ENV_KNOBS = frozenset(
     "MPIT_OBS_" + k
     for k in (
         "DIR", "TRACE", "TELEMETRY", "SAMPLE", "MAX_RECORDS",
-        "LIVE", "LIVE_INTERVAL", "FAULTHANDLER",
+        "LIVE", "LIVE_INTERVAL", "FAULTHANDLER", "RING",
+        "BLACKBOX", "BLACKBOX_RECORDS", "BLACKBOX_SECONDS",
+        "BLACKBOX_DUMP_SIGNAL",
     )
 )
 
@@ -265,6 +399,12 @@ def config_from_env(
         live=env.get("MPIT_OBS_LIVE", "0") not in ("", "0"),
         live_interval=float(env.get("MPIT_OBS_LIVE_INTERVAL", 1.0)),
         faulthandler=_parse_faulthandler(env.get("MPIT_OBS_FAULTHANDLER")),
+        ring=env.get("MPIT_OBS_RING", "0") not in ("", "0"),
+        blackbox=env.get("MPIT_OBS_BLACKBOX", "1") != "0",
+        blackbox_records=int(env.get("MPIT_OBS_BLACKBOX_RECORDS", 2048)),
+        blackbox_seconds=float(env.get("MPIT_OBS_BLACKBOX_SECONDS", 30.0)),
+        blackbox_dump_signal=env.get("MPIT_OBS_BLACKBOX_DUMP_SIGNAL")
+        or None,
     )
 
 

@@ -597,6 +597,17 @@ def aggregate(snapshots: Mapping[int, dict]) -> dict:
                 "param_norm": _gauge(snap, M_PARAM_NORM),
                 "norm_ratio": _gauge(snap, M_NORM_RATIO),
             }
+        # flight-recorder health (collector fragment from the rank's
+        # BlackBox): ring occupancy + dump count, so an operator can see
+        # the recorder is armed — and that an incident already dumped —
+        # without touching the run dir
+        bb = snap.get("collect", {}).get("blackbox")
+        if isinstance(bb, dict) and "records" in bb:
+            row["blackbox"] = {
+                "records": bb.get("records"),
+                "dumps": bb.get("dumps"),
+                "last_trigger": bb.get("last_trigger"),
+            }
         if snap.get("role") == "serve":
             finished = _counter(snap, M_REQ_FINISHED)
             misses = _counter(snap, M_SLO_MISSES)

@@ -68,8 +68,12 @@ def _tag_name(tag) -> str:
 
 def read_journal(path: str) -> list[dict]:
     """Records of one JSONL journal (malformed lines are skipped — a
-    journal truncated by a killed rank must not sink the whole merge)."""
+    journal truncated by a killed rank must not sink the whole merge;
+    a directory — e.g. the ``blackbox/`` or ``live/`` subdir a listing
+    of the run dir sweeps up — reads as empty)."""
     out = []
+    if os.path.isdir(path):
+        return out
     with open(path) as f:
         for line in f:
             line = line.strip()
@@ -299,6 +303,36 @@ def merge_to_chrome_trace(
                     "cat": "dynamics", "pid": rank, "tid": 0,
                     "ts": us(t),
                     "args": {"value": rec.get("staleness", 0)},
+                })
+            elif ev == "journal_cap":
+                # truncation evidence (cap footer, written incrementally):
+                # where the journal stopped/evicted is itself a clue
+                events.append({
+                    "ph": "i", "s": "p", "name": "journal truncated",
+                    "cat": "obs", "pid": rank, "tid": 0, "ts": us(t),
+                    "args": {
+                        k: rec[k]
+                        for k in (
+                            "cap", "dropped_records", "mode",
+                            "evicted_records",
+                        ) if k in rec
+                    },
+                })
+            elif ev == "blackbox":
+                # flight-recorder dump header — marks where a window was
+                # frozen and why (merging dump files gives the incident
+                # trace the postmortem --perfetto flag asks for)
+                events.append({
+                    "ph": "i", "s": "p",
+                    "name": f"blackbox dump ({rec.get('trigger', '?')})",
+                    "cat": "obs", "pid": rank, "tid": 0, "ts": us(t),
+                    "args": {
+                        k: rec[k]
+                        for k in (
+                            "trigger", "incident", "records", "evicted",
+                            "gen", "t_first", "t_last",
+                        ) if k in rec
+                    },
                 })
 
     if faults_path is not None:

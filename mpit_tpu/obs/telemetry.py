@@ -36,6 +36,7 @@ import time
 from typing import Any, Optional, Sequence
 
 from mpit_tpu.analysis.runtime import make_lock
+from mpit_tpu.obs.blackbox import BlackBox, arm_process_triggers
 from mpit_tpu.obs.live import LiveExporter, MetricsRegistry
 from mpit_tpu.obs.core import (
     _ENVELOPE_MARK,
@@ -162,6 +163,10 @@ class TelemetryTransport(Transport):
         if config.live:
             self.obs_registry = MetricsRegistry(inner.rank)
             self.obs_registry.add_collector("wire", self._live_wire_fragment)
+            if journal is not None and journal.blackbox is not None:
+                self.obs_registry.add_collector(
+                    "blackbox", journal.blackbox.stats
+                )
             if config.dir is not None:
                 self._live_exporter = LiveExporter(
                     self.obs_registry,
@@ -311,6 +316,7 @@ class TelemetryTransport(Transport):
         wait = time.perf_counter() - t0
         payload = msg.payload
         ctx: Optional[SpanContext] = None
+        remote_clk: Optional[int] = None
         if (
             type(payload) is tuple
             and len(payload) == 5
@@ -349,6 +355,11 @@ class TelemetryTransport(Transport):
             if ctx is not None:
                 fields["trace"] = ctx.trace_id
                 fields["from_span"] = ctx.span_id
+            if remote_clk is not None:
+                # the sender's Lamport stamp: the post-mortem analyzer's
+                # cross-rank alignment key (pairs this recv with the
+                # sender's journal record carrying the same clock)
+                fields["rclk"] = remote_clk
             self.journal.event("recv", clk, **fields)
         return msg
 
@@ -445,9 +456,19 @@ def _journal_for(config: ObsConfig, rank: int) -> Optional[Journal]:
         return None
     import os
 
+    box = None
+    if config.blackbox:
+        box = BlackBox(
+            config.dir, rank,
+            max_records=config.blackbox_records,
+            max_seconds=config.blackbox_seconds,
+            gen=int(os.environ.get("MPIT_RESPAWN_GEN", "0") or 0),
+        )
     return Journal(
         os.path.join(config.dir, f"obs_rank{rank}.jsonl"), rank,
         max_records=config.max_records,
+        mode="ring" if config.ring else "cap",
+        blackbox=box,
     )
 
 
@@ -484,4 +505,9 @@ def wrap_from_env(transport: Transport) -> Transport:
     config = config_from_env()
     if config is not None:
         arm_faulthandler(config, f"rank{transport.rank}")
+        if config.blackbox:
+            # process mode owns its main thread: install the SIGTERM /
+            # dump-signal triggers here (thread-mode worlds rely on the
+            # atexit + dump-request triggers instead)
+            arm_process_triggers(config.blackbox_dump_signal)
     return maybe_wrap(transport, config)

@@ -4,7 +4,7 @@
 # and respawning it (clients re-enter via JOIN, a killed server restores
 # from its shard snapshot), then gate the survivors' journals:
 #
-#   scripts/elastic_soak.sh [MAX_SECONDS] [KILL_SEED]
+#   scripts/elastic_soak.sh [MAX_SECONDS] [KILL_SEED] [REPORT_DIR]
 #
 # - `obs dynamics --gate`: no divergence, bounded staleness;
 # - a versions-monotonic check over the (gen, version) order — a restored
@@ -14,7 +14,13 @@
 # - `analysis conform`: TC201-TC204 over the run's journals with
 #   membership.jsonl licensing the churned ranks' truncated tails;
 # - at least one kill must actually have landed (a soak that never
-#   churned proved nothing — fail loudly rather than pass vacuously).
+#   churned proved nothing — fail loudly rather than pass vacuously);
+# - `obs postmortem`: the black-box dumps the kills triggered must
+#   assemble into a cross-rank report naming a killed rank as
+#   first-mover with reconstructed final exchange rounds. The report
+#   (human + JSON + the raw dumps) is ARCHIVED to REPORT_DIR (default
+#   ./soak_reports/<timestamp>) — the working dirs are temp-dirs wiped
+#   on exit, and a soak that discards its own forensics is pointless.
 #
 # The kill schedule is seeded (MPIT_ELASTIC_KILL_SEED) so a failure
 # replays: rerun with the same seed and the same victims die at the same
@@ -26,6 +32,7 @@ cd "$(dirname "$0")/.."
 
 MAX_SECONDS="${1:-180}"
 KILL_SEED="${2:-1234}"
+REPORT_DIR="${3:-soak_reports/$(date +%Y%m%d-%H%M%S)}"
 OUT="$(mktemp -d)"
 CKPT="$(mktemp -d)"
 trap 'rm -rf "$OUT" "$CKPT"' EXIT
@@ -74,4 +81,45 @@ EOF
 
 echo "=== elastic soak: conformance replay ===" >&2
 python -m mpit_tpu.analysis conform "$OUT"
+
+echo "=== elastic soak: cross-rank post-mortem ===" >&2
+# the kills above asked every survivor's flight recorder to dump; the
+# post-mortem must now assemble those windows into a non-empty incident
+# report naming a killed rank as first-mover (exit 1 = incident found,
+# which for a chaos soak is the EXPECTED outcome)
+rc=0
+python -m mpit_tpu.obs postmortem "$OUT" --json \
+    > "$OUT/postmortem.json" || rc=$?
+if [[ $rc -ne 1 ]]; then
+    echo "elastic_soak: postmortem exited $rc (want 1 = incident):" \
+         "kills landed but no cross-rank incident was assembled" >&2
+    exit 1
+fi
+rc=0
+python -m mpit_tpu.obs postmortem "$OUT" > "$OUT/postmortem.txt" || rc=$?
+python - "$OUT" <<'EOF'
+import json, sys
+out = sys.argv[1]
+rep = json.load(open(f"{out}/postmortem.json"))
+members = [json.loads(line) for line in open(f"{out}/membership.jsonl")]
+killed = {m["rank"] for m in members if m.get("kind") == "kill"}
+mover = rep["first_mover"].get("rank")
+if mover not in killed:
+    sys.exit(f"elastic_soak: postmortem named rank {mover} as "
+             f"first-mover but the killer's victims were {sorted(killed)}")
+rounds = sum(len(e["pushes"]) for e in rep["exchanges"].values())
+if rounds == 0:
+    sys.exit("elastic_soak: postmortem reconstructed no exchange rounds "
+             "— the dump windows are empty")
+print(f"elastic_soak: postmortem names rank {mover} (killed) as "
+      f"first-mover, {rounds} exchange round(s) reconstructed across "
+      f"{len(rep['ranks'])} dumped window(s)")
+EOF
+
+# archive the evidence before the EXIT trap wipes the working dirs
+mkdir -p "$REPORT_DIR"
+cp "$OUT/postmortem.json" "$OUT/postmortem.txt" "$REPORT_DIR/"
+cp "$OUT/membership.jsonl" "$REPORT_DIR/" 2>/dev/null || true
+cp -r "$OUT/blackbox" "$REPORT_DIR/blackbox" 2>/dev/null || true
+echo "elastic_soak: post-mortem archived to $REPORT_DIR" >&2
 echo "elastic_soak: OK"

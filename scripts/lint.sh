@@ -22,7 +22,11 @@
 #      — the `obs dynamics --gate` contract);
 #   6. a jax-free import probe of the shared quant kernels
 #      (mpit_tpu/quant.py + the transport.wire re-exports) — the host
-#      wire path must never grow a backend dependency.
+#      wire path must never grow a backend dependency;
+#   7. the black-box post-mortem contract over the checked-in golden
+#      (tests/fixtures/blackbox: 3-rank run, rank 2 SIGKILLed) — exit
+#      codes pinned: the incident fixture must exit 1 naming rank 2 as
+#      first-mover, an empty dir must exit 2.
 # The whole default run is bounded to < 15 s wall-clock
 # (tests/test_lint_gate.py enforces it).
 #
@@ -75,6 +79,43 @@ spec.loader.exec_module(quant)  # must not touch jax (the jnp half is lazy)
 q = quant.quantize(np.ones(8, np.float32), "int8")
 out = quant.dequantize(q)
 assert out.shape == (8,) and out.dtype == np.float32
+EOF
+    # the post-mortem contract, gated on the checked-in incident golden
+    # (exit codes are part of the CLI contract: 1 = incident found,
+    # 2 = no dumps; one python process drives obs_main for both runs).
+    # The package __init__s are stubbed out: like gate 6, this doubles
+    # as a probe that the post-mortem path stays stdlib-only — an
+    # incident box must never need a jax backend to read the black box
+    python - <<'EOF'
+import importlib, io, json, os, sys, tempfile, types
+from contextlib import redirect_stderr, redirect_stdout
+
+for name, path in (("mpit_tpu", "mpit_tpu"), ("mpit_tpu.obs", "mpit_tpu/obs")):
+    stub = types.ModuleType(name)
+    stub.__path__ = [path]
+    sys.modules[name] = stub
+obs_main = importlib.import_module("mpit_tpu.obs.__main__").main
+
+buf = io.StringIO()
+with redirect_stdout(buf):
+    rc = obs_main(["postmortem", "tests/fixtures/blackbox", "--json"])
+assert rc == 1, f"postmortem gate: incident fixture exited {rc} (want 1)"
+rep = json.loads(buf.getvalue())
+assert rep["verdict"] == "incident", rep["verdict"]
+assert rep["first_mover"]["rank"] == 2, rep["first_mover"]
+assert "2" in rep["exchanges"], sorted(rep["exchanges"])
+empty = tempfile.mkdtemp()
+try:
+    with redirect_stderr(io.StringIO()):
+        rc = obs_main(["postmortem", empty, "--json"])
+finally:
+    os.rmdir(empty)
+assert rc == 2, f"postmortem gate: empty dir exited {rc} (want 2)"
+print(
+    "postmortem gate: first-mover rank 2, "
+    f"{len(rep['exchanges']['2']['pushes'])} reconstructed round(s), "
+    "exit codes 1/2 pinned — ok"
+)
 EOF
     # warn-only: bench trajectory drift should be SEEN at lint time, but
     # bench noise must never block a commit (--strict exists for CI)
