@@ -24,6 +24,13 @@ Subcommands:
     Replay an observability run (``obs_rank*.jsonl`` + ``faults*.jsonl``)
     against the extracted protocol; report TC201–TC203 violations.
 
+``python -m mpit_tpu.analysis threads [--package PATH] [--owner X]``
+    Print the whole-program concurrency model behind MPT013–015: every
+    thread root, the state shared across roots, and the lockset each
+    root holds at each access. ``--owner PServer`` narrows to one
+    class/module's state (shared or not); ``--json`` emits the
+    machine-readable form the threading-model doc is generated from.
+
 Exit codes (every mode, regardless of output format): 0 clean (vs
 baseline), 1 new findings / violations, 2 usage or input error.
 """
@@ -208,6 +215,102 @@ def _main_conform(argv) -> int:
     return 1 if bad else 0
 
 
+def _fmt_locksets(locksets) -> str:
+    return " | ".join(
+        "{" + ", ".join(ls) + "}" if ls else "{}" for ls in locksets
+    )
+
+
+def _main_threads(argv) -> int:
+    parser = argparse.ArgumentParser(
+        prog="python -m mpit_tpu.analysis threads",
+        description="Dump the whole-program concurrency model "
+        "(thread roots, cross-root shared state, per-access locksets) "
+        "that rules MPT013-MPT015 consume.",
+    )
+    parser.add_argument(
+        "--package",
+        default=_default_scan_path(),
+        help="package to analyze (default: mpit_tpu)",
+    )
+    parser.add_argument(
+        "--owner",
+        metavar="SUFFIX",
+        help="list ALL tracked state of one owner (class or module "
+        "dotted-name suffix, e.g. PServer), shared across roots or not",
+    )
+    parser.add_argument(
+        "--json", action="store_true", help="machine-readable output"
+    )
+    args = parser.parse_args(argv)
+    if not Path(args.package).exists():
+        print(f"error: no such path: {args.package}", file=sys.stderr)
+        return 2
+    model = _load_project(args.package).threads
+
+    def _root_block(per_root):
+        out = {}
+        for root, e in sorted(per_root.items()):
+            out[root] = {
+                "reads": e["reads"],
+                "writes": e["writes"],
+                "locksets": sorted(
+                    sorted(l.short() for l in ls) for ls in e["locksets"]
+                ),
+            }
+        return out
+
+    if args.owner:
+        states = model.owner_state(args.owner)
+        doc = {
+            "owner": args.owner,
+            "state": [
+                {
+                    "state": s.label(),
+                    "kind": s.kind,
+                    "shared": len(per_root) >= 2,
+                    "roots": _root_block(per_root),
+                }
+                for s, per_root in sorted(
+                    states.items(), key=lambda kv: kv[0].label()
+                )
+            ],
+        }
+        if args.json:
+            print(json.dumps(doc, indent=2))
+        else:
+            for ent in doc["state"]:
+                mark = "shared" if ent["shared"] else "single-root"
+                print(f"{ent['state']}  [{mark}]")
+                for root, e in ent["roots"].items():
+                    print(
+                        f"    {root}: {e['reads']}r/{e['writes']}w  "
+                        f"{_fmt_locksets(e['locksets'])}"
+                    )
+        return 0
+
+    doc = model.to_json()
+    if args.json:
+        print(json.dumps(doc, indent=2))
+        return 0
+    print(f"{len(doc['roots'])} thread root(s):")
+    for r in doc["roots"]:
+        note = "" if r["resolved"] else "  [unresolved target]"
+        print(f"  {r['name']}  <- {r['target']} @ {r['spawned_at']}{note}")
+    print(f"\n{len(doc['shared_state'])} cross-root shared state(s):")
+    for ent in doc["shared_state"]:
+        print(f"  {ent['state']}")
+        for root, e in ent["roots"].items():
+            print(
+                f"    {root}: {e['reads']}r/{e['writes']}w  "
+                f"{_fmt_locksets(e['locksets'])}"
+            )
+    print(f"\n{len(doc['lock_edges'])} lock-order edge(s):")
+    for edge in doc["lock_edges"]:
+        print(f"  {edge}")
+    return 0
+
+
 def main(argv=None) -> int:
     if argv is None:
         argv = sys.argv[1:]
@@ -217,6 +320,8 @@ def main(argv=None) -> int:
         return _main_mcheck(argv[1:])
     if argv and argv[0] == "conform":
         return _main_conform(argv[1:])
+    if argv and argv[0] == "threads":
+        return _main_threads(argv[1:])
     parser = argparse.ArgumentParser(
         prog="python -m mpit_tpu.analysis",
         description="Distributed-correctness linter (rules MPT001-MPT008).",
@@ -267,6 +372,13 @@ def main(argv=None) -> int:
         help="rewrite fixable MPT002 sites (known literal tag -> TAG_* "
         "constant + import) in place before linting",
     )
+    parser.add_argument(
+        "--only",
+        metavar="RULES",
+        help="run only these comma-separated rule ids (e.g. "
+        "--only MPT013,MPT014) — rule modules owning none of them are "
+        "skipped entirely, so one rule iterates without the full pass",
+    )
     args = parser.parse_args(argv)
 
     if args.list_rules:
@@ -301,7 +413,22 @@ def main(argv=None) -> int:
         if had_error:
             return 2
 
-    all_findings = lint.run_lint(paths)
+    config = None
+    if args.only:
+        only = [r.strip() for r in args.only.split(",") if r.strip()]
+        from mpit_tpu.analysis.rules import RULE_DOCS
+
+        unknown = [r for r in only if r not in RULE_DOCS]
+        if unknown:
+            print(
+                f"error: unknown rule id(s): {', '.join(unknown)} "
+                "(see --list-rules)",
+                file=sys.stderr,
+            )
+            return 2
+        config = lint.Config(only_rules=only)
+
+    all_findings = lint.run_lint(paths, config)
 
     baseline_path = None
     if not args.no_baseline:

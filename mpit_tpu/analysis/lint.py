@@ -73,6 +73,10 @@ class Config:
     # as fallback; never imported)
     wire_version_name: str = "WIRE_FORMAT_VERSION"
     wire_format_version: Optional[int] = None
+    # restrict the run to these rule ids (``--only MPT013,MPT014``); None
+    # runs everything. Rule modules owning no selected id are skipped
+    # entirely, so one rule can be iterated without the full-pass cost
+    only_rules: Optional[Sequence[str]] = None
 
 
 @dataclasses.dataclass
@@ -117,6 +121,9 @@ class Project:
     # lazily-extracted role models (analysis/protocol.py) — the protocol
     # rules, the model check, and conformance all need the same extraction
     _roles: object = dataclasses.field(default=None, repr=False)
+    # lazily-built whole-program concurrency model (analysis/threads.py) —
+    # the MPT013-015 rules and the `threads` CLI share one build
+    _threads: object = dataclasses.field(default=None, repr=False)
 
     @property
     def graph(self):
@@ -133,6 +140,14 @@ class Project:
 
             self._roles = protocol.extract_roles(self)
         return self._roles
+
+    @property
+    def threads(self):
+        if self._threads is None:
+            from mpit_tpu.analysis import threads as threads_mod
+
+            self._threads = threads_mod.build_model(self)
+        return self._threads
 
 
 def _parse_ignores(source_lines: list) -> dict:
@@ -222,13 +237,17 @@ def run_lint(
         if ctx is not None:
             modules.append(ctx)
     project = Project(modules=modules, config=config)
+    only = set(config.only_rules) if config.only_rules else None
     findings = []
     for rule_mod in rules.RULE_MODULES:
+        if only is not None and not only & set(rule_mod.RULES):
+            continue
         findings.extend(rule_mod.run(project))
     findings = [
         f
         for f in findings
         if not _suppressed(f, {m.rel: m for m in modules})
+        and (only is None or f.rule in only)
     ]
     findings.sort(key=lambda f: (f.path, f.line, f.col, f.rule))
     return findings

@@ -10,6 +10,7 @@ from __future__ import annotations
 
 from mpit_tpu.analysis.rules import (
     collectives,
+    concurrency,
     host_sync,
     jit_signature,
     locks,
@@ -30,6 +31,7 @@ RULE_MODULES = (
     protocol_roles,
     model_check,
     metric_names,
+    concurrency,
 )
 
 # rule id -> (title, one-line rationale); the CLI's --list-rules output and

@@ -19,6 +19,15 @@ of which short-circuit on the module-level ``_ACTIVE`` being None):
   racing for one tag: whichever recv matches first steals the other role's
   message. (Wildcard-tag waiters are exempt: ``recv(ANY_TAG)`` is the
   single-threaded dispatcher pattern, e.g. the pserver loop.)
+- **RT103 happens-before races** (opt-in on top of a checker: ``race=True``
+  or ``MPIT_RT_RACE=1``). Every tracked lock/condition carries a vector
+  clock: release publishes the holder's clock into the lock and advances
+  the holder; acquire joins the lock's clock into the acquirer. Annotated
+  shared structures (PServer center/version/counts, Broker mailboxes —
+  via :func:`note`) record per-variable last-write/read epochs; an access
+  not ordered after the previous conflicting access by that clock algebra
+  is a data race REGARDLESS of how the schedule happened to interleave —
+  the dynamic complement of static MPT013, reported with both stacks.
 
 Usage::
 
@@ -37,7 +46,10 @@ from __future__ import annotations
 import contextlib
 import dataclasses
 import itertools
+import os
+import sys
 import threading
+import traceback
 from typing import Iterator, Optional
 
 ANY = -1  # mirrors transport.ANY_SOURCE/ANY_TAG without importing transport
@@ -45,7 +57,7 @@ ANY = -1  # mirrors transport.ANY_SOURCE/ANY_TAG without importing transport
 
 @dataclasses.dataclass(frozen=True)
 class RuntimeFinding:
-    rule: str  # "RT101" | "RT102"
+    rule: str  # "RT101" | "RT102" | "RT103"
     message: str
 
     def format(self) -> str:
@@ -81,7 +93,7 @@ class RuntimeChecker:
     :func:`checking` (or :func:`enable`/:func:`disable` for long-lived
     diagnostics sessions)."""
 
-    def __init__(self):
+    def __init__(self, race: bool = False):
         self._mu = threading.Lock()
         self.findings: list = []
         # lock-order graph over lock INSTANCES (ids) — names alias freely
@@ -93,6 +105,13 @@ class RuntimeChecker:
         self._waiters: dict = {}  # token -> _Waiter
         self._token_counter = itertools.count(1)
         self._reported_tags: set = set()
+        # -- RT103 vector-clock state (race=True only) --
+        self.race = race
+        self._race_tids = threading.local()  # small stable per-thread ids
+        self._race_tid_counter = itertools.count(1)
+        self._clocks: dict = {}  # tid -> {tid: clk}
+        self._vars: dict = {}  # key -> {"w": epoch|None, "r": {tid: epoch}}
+        self._reported_races: set = set()
 
     # -- lock-order graph -------------------------------------------------
 
@@ -205,6 +224,100 @@ class RuntimeChecker:
         with self._mu:
             self._waiters.pop(token, None)
 
+    # -- RT103 happens-before races ---------------------------------------
+    #
+    # Djit+-style vector clocks. Each thread t keeps C_t; each tracked
+    # lock keeps the clock its last releaser published. release(m):
+    # m.vc = C_t; C_t[t] += 1. acquire(m): C_t = join(C_t, m.vc). An
+    # access epoch (u, c) happens-before the current thread iff
+    # c <= C_t[u] — i.e. some lock hand-off chain carried u's work here.
+    # Per variable we keep the last write epoch and the reads since: a
+    # write must be ordered after ALL of them, a read after the write.
+
+    def _race_tid(self) -> int:
+        tid = getattr(self._race_tids, "id", None)
+        if tid is None:
+            # NOT threading.get_ident(): the OS reuses those when threads
+            # die, which would merge two distinct threads' clocks
+            tid = self._race_tids.id = next(self._race_tid_counter)
+        return tid
+
+    def _clock(self, tid: int) -> dict:
+        """Caller holds self._mu."""
+        clock = self._clocks.get(tid)
+        if clock is None:
+            clock = self._clocks[tid] = {tid: 1}
+        return clock
+
+    def on_acquired(self, lock) -> None:
+        """After the underlying acquire succeeded: join the lock's clock
+        into the acquiring thread's."""
+        if not self.race:
+            return
+        tid = self._race_tid()
+        with self._mu:
+            clock = self._clock(tid)
+            for t, c in lock._vc.items():
+                if clock.get(t, 0) < c:
+                    clock[t] = c
+
+    def on_before_release(self, lock) -> None:
+        """Just before the underlying release: publish the holder's clock
+        into the lock and advance the holder's own component."""
+        if not self.race:
+            return
+        tid = self._race_tid()
+        with self._mu:
+            clock = self._clock(tid)
+            lock._vc = dict(clock)
+            clock[tid] = clock.get(tid, 1) + 1
+
+    def on_var_access(self, key: str, write: bool) -> None:
+        """An annotated shared-structure access (see module-level
+        :func:`note`). Reports at most one race per key."""
+        tid = self._race_tid()
+        tname = threading.current_thread().name
+        # drop the note()/on_var_access frames; keep the caller's tail
+        stack = "".join(
+            traceback.format_list(traceback.extract_stack()[-8:-2])
+        )
+        with self._mu:
+            clock = self._clock(tid)
+            st = self._vars.setdefault(key, {"w": None, "r": {}})
+
+            def _ordered(epoch) -> bool:
+                e_tid, e_clk, _, _ = epoch
+                return e_clk <= clock.get(e_tid, 0) or e_tid == tid
+
+            race, kind = None, None
+            if st["w"] is not None and not _ordered(st["w"]):
+                race = st["w"]
+                kind = "write-write" if write else "read-write"
+            if write and race is None:
+                for prev in st["r"].values():
+                    if not _ordered(prev):
+                        race, kind = prev, "read-write"
+                        break
+            if race is not None and key not in self._reported_races:
+                self._reported_races.add(key)
+                o_tid, _, o_name, o_stack = race
+                self.findings.append(
+                    RuntimeFinding(
+                        "RT103",
+                        f"{kind} race on {key}: no happens-before edge "
+                        f"between thread {o_name!r} (t{o_tid}) at:\n"
+                        f"{o_stack}  and thread {tname!r} (t{tid}) at:\n"
+                        f"{stack}  — the accesses can interleave; guard "
+                        "both with one tracked lock",
+                    )
+                )
+            me = (tid, clock.get(tid, 1), tname, stack)
+            if write:
+                st["w"] = me
+                st["r"] = {}
+            else:
+                st["r"][tid] = me
+
 
 class _TrackedLock:
     """threading.Lock wrapper reporting acquisition order to a checker.
@@ -217,15 +330,19 @@ class _TrackedLock:
         self._lock = threading.Lock()
         self.name = name
         self._checker = checker
+        self._vc: dict = {}  # RT103: last releaser's vector clock
 
     def acquire(self, blocking: bool = True, timeout: float = -1) -> bool:
         self._checker.on_acquire(self)
         got = self._lock.acquire(blocking, timeout)
         if not got:
             self._checker.on_release(self)
+        else:
+            self._checker.on_acquired(self)
         return got
 
     def release(self) -> None:
+        self._checker.on_before_release(self)
         self._lock.release()
         self._checker.on_release(self)
 
@@ -237,6 +354,75 @@ class _TrackedLock:
 
     def __exit__(self, *exc) -> None:
         self.release()
+
+
+class _TrackedCondition:
+    """threading.Condition wrapper with the same RT101/RT103 hooks as
+    :class:`_TrackedLock` — ``with cond:`` IS a lock acquisition, and
+    ``wait()`` is a release/reacquire pair for the clock algebra (the
+    hand-off from ``notify``'s releaser to the woken waiter flows through
+    the publish-on-release / join-on-acquire edges)."""
+
+    def __init__(self, name: str, checker: RuntimeChecker):
+        self._cond = threading.Condition()
+        self.name = name
+        self._checker = checker
+        self._vc: dict = {}
+
+    def acquire(self, *args) -> bool:
+        self._checker.on_acquire(self)
+        got = self._cond.acquire(*args)
+        if not got:
+            self._checker.on_release(self)
+        else:
+            self._checker.on_acquired(self)
+        return got
+
+    def release(self) -> None:
+        self._checker.on_before_release(self)
+        self._cond.release()
+        self._checker.on_release(self)
+
+    def __enter__(self) -> bool:
+        return self.acquire()
+
+    def __exit__(self, *exc) -> None:
+        self.release()
+
+    def wait(self, timeout: Optional[float] = None) -> bool:
+        self._checker.on_before_release(self)
+        self._checker.on_release(self)
+        try:
+            return self._cond.wait(timeout)
+        finally:
+            self._checker.on_acquire(self)
+            self._checker.on_acquired(self)
+
+    def wait_for(self, predicate, timeout: Optional[float] = None):
+        # threading.Condition.wait_for's loop, routed through our wait()
+        # so every park/wake keeps the clock algebra consistent
+        import time as _time
+
+        endtime = None
+        result = predicate()
+        while not result:
+            if timeout is not None:
+                if endtime is None:
+                    endtime = _time.monotonic() + timeout
+                waittime = endtime - _time.monotonic()
+                if waittime <= 0:
+                    break
+                self.wait(waittime)
+            else:
+                self.wait()
+            result = predicate()
+        return result
+
+    def notify(self, n: int = 1) -> None:
+        self._cond.notify(n)
+
+    def notify_all(self) -> None:
+        self._cond.notify_all()
 
 
 _ACTIVE: Optional[RuntimeChecker] = None
@@ -256,9 +442,29 @@ def make_lock(name: str):
     return _TrackedLock(name, checker)
 
 
-def enable(checker: Optional[RuntimeChecker] = None) -> RuntimeChecker:
+def make_condition(name: str):
+    """Sibling factory for condition variables (Broker mailboxes, send
+    queues): plain ``threading.Condition`` when no checker is active."""
+    checker = _ACTIVE
+    if checker is None:
+        return threading.Condition()
+    return _TrackedCondition(name, checker)
+
+
+def note(key: str, write: bool) -> None:
+    """Annotate one access to a shared structure for RT103. Free when no
+    race-mode checker is active — the instrumented hot paths pay one
+    global read and one attribute check."""
+    checker = _ACTIVE
+    if checker is not None and checker.race:
+        checker.on_var_access(key, write)
+
+
+def enable(
+    checker: Optional[RuntimeChecker] = None, race: bool = False
+) -> RuntimeChecker:
     global _ACTIVE
-    _ACTIVE = checker or RuntimeChecker()
+    _ACTIVE = checker or RuntimeChecker(race=race)
     return _ACTIVE
 
 
@@ -268,11 +474,37 @@ def disable() -> None:
 
 
 @contextlib.contextmanager
-def checking() -> Iterator[RuntimeChecker]:
+def checking(race: bool = False) -> Iterator[RuntimeChecker]:
     """Enable a fresh checker for the block; disables on exit (the checker
     object and its findings stay readable afterwards)."""
-    checker = enable()
+    checker = enable(race=race)
     try:
         yield checker
     finally:
         disable()
+
+
+def _arm_from_env() -> None:
+    """``MPIT_RT_RACE=1`` arms a race-mode checker for the whole process
+    (each launch.py rank imports this module early, so transport locks are
+    created tracked) and reports findings at exit — the chaos-soak wiring."""
+    if os.environ.get("MPIT_RT_RACE", "0") in ("", "0"):
+        return
+    checker = enable(race=True)
+    print(
+        f"[rt-race] vector-clock race sanitizer armed (pid {os.getpid()})",
+        file=sys.stderr,
+    )
+    import atexit
+
+    @atexit.register
+    def _report() -> None:
+        for finding in checker.findings:
+            print(finding.format(), file=sys.stderr)
+        print(
+            f"[rt-race] {len(checker.findings)} finding(s)",
+            file=sys.stderr,
+        )
+
+
+_arm_from_env()

@@ -21,11 +21,12 @@ from __future__ import annotations
 
 import dataclasses
 import os
-import threading
 from typing import Optional, Sequence
 
 import jax
 import numpy as np
+
+from mpit_tpu.analysis.runtime import make_lock
 from jax.sharding import Mesh, NamedSharding, PartitionSpec
 
 # Default mesh axis for the data-parallel worker dimension. The reference's
@@ -33,7 +34,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec
 # parallelism-strategy ledger), so a 1-D mesh is the common case.
 WORKER_AXIS = "dp"
 
-_lock = threading.Lock()
+_lock = make_lock("topology._lock")
 _topology: Optional["Topology"] = None
 _distributed_initialized = False
 

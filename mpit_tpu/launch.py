@@ -39,6 +39,8 @@ import sys
 import threading
 import time
 
+from mpit_tpu.analysis.runtime import make_lock
+
 
 def _reserve_ports(n: int) -> tuple[list[socket.socket], list[int]]:
     """Reserve n distinct free TCP ports; the RESERVING SOCKETS STAY OPEN.
@@ -152,7 +154,7 @@ def main(argv=None) -> int:
         os.path.join(obs_dir, "membership.jsonl")
         if elastic and obs_dir else None
     )
-    mem_lock = threading.Lock()
+    mem_lock = make_lock("launch.mem_lock")
     t0 = time.monotonic()
 
     def _member(kind: str, rank: int, gen: int, **extra) -> None:
@@ -260,7 +262,7 @@ def main(argv=None) -> int:
     # never a rank whose respawn budget is spent)
     gens = [0] * ns.n
     budget = [max_respawns if elastic else 0] * ns.n
-    procs_lock = threading.Lock()
+    procs_lock = make_lock("launch.procs_lock")
     killer_stop = threading.Event()
     if elastic and kill_every > 0:
         rng_k = random.Random(kill_seed)
@@ -316,8 +318,11 @@ def main(argv=None) -> int:
                     # elastic: the rank died with budget left — respawn it
                     # in place (same rank/port, next generation) instead
                     # of taking the world down
-                    budget[r] -= 1
-                    gens[r] += 1
+                    # budget/gens are read by the killer thread under
+                    # procs_lock — mutate them under the same lock
+                    with procs_lock:
+                        budget[r] -= 1
+                        gens[r] += 1
                     _archive_blackbox(r, gens[r] - 1)
                     with procs_lock:
                         procs[r] = _spawn(r, gens[r])

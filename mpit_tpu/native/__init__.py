@@ -18,9 +18,9 @@ from __future__ import annotations
 import contextlib
 import ctypes
 import pickle
-import threading
 from typing import Any, Optional
 
+from mpit_tpu.analysis.runtime import make_condition
 from mpit_tpu.native.build import LIB, NativeUnavailable, ensure_built
 from mpit_tpu.transport.base import (
     ANY_SOURCE,
@@ -117,7 +117,7 @@ class NativeBroker:
         # only then frees the C object — so no thread can ever touch a
         # dangling handle (the C-side ops counter alone cannot guarantee
         # that; see tagged_broker.cpp teardown comments).
-        self._cv = threading.Condition()
+        self._cv = make_condition("NativeBroker._cv")
         self._active = 0
         self._closing = False
 

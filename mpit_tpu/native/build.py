@@ -13,13 +13,14 @@ from __future__ import annotations
 import os
 import shutil
 import subprocess
-import threading
+
+from mpit_tpu.analysis.runtime import make_lock
 
 _DIR = os.path.dirname(os.path.abspath(__file__))
 SRC = os.path.join(_DIR, "src", "tagged_broker.cpp")
 LIB = os.path.join(_DIR, "_libmpit_native.so")
 
-_build_lock = threading.Lock()
+_build_lock = make_lock("native.build._build_lock")
 
 
 class NativeUnavailable(RuntimeError):

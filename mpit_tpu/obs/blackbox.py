@@ -54,6 +54,8 @@ import threading
 import time
 from typing import Any, Callable, Optional
 
+from mpit_tpu.analysis.runtime import make_lock
+
 #: dump_request.json poll cadence for the watcher thread — fast enough
 #: that survivors freeze their windows while the incident is still in
 #: the ring horizon, slow enough to be free (one stat per poll)
@@ -98,7 +100,7 @@ class BlackBox:
         self.dumps = 0
         self.last_trigger: Optional[str] = None
         self._ring: list = []  # (t, clk, ev, fields)
-        self._lock = threading.Lock()
+        self._lock = make_lock(f"obs.BlackBox._lock[{rank}]")
         self._closed = False
         self._seen_incidents: set = set()
         self._sources: list = []  # (name, callable) extra dump content
@@ -254,7 +256,7 @@ def _jsonable(v: Any) -> Any:
 # rank in a single process; process mode has one per OS process), one
 # watcher thread, one atexit hook, at most one handler per signal.
 
-_REG_LOCK = threading.Lock()
+_REG_LOCK = make_lock("obs.blackbox._REG_LOCK")
 _BOXES: list = []
 _WATCHER: Optional[threading.Thread] = None
 _WATCHER_STOP = threading.Event()

@@ -414,7 +414,7 @@ def config_from_env(
 # once for the world and process mode arms it per rank. The dump file
 # stays open for the process lifetime — faulthandler holds the fd.
 
-_FAULTHANDLER_LOCK = threading.Lock()
+_FAULTHANDLER_LOCK = make_lock("obs._FAULTHANDLER_LOCK")
 _FAULTHANDLER_FILE = None
 
 

@@ -389,6 +389,11 @@ class LiveExporter:
         self.path = os.path.join(live_dir, f"rank_{registry.rank}.json")
         self.write_errors = 0
         self._seq = 0
+        # write() runs on the export thread AND on close()'s caller; the
+        # join() in close() has a timeout, so it is not a guaranteed fence
+        self._write_lock = make_lock(
+            f"obs.LiveExporter._write_lock[{registry.rank}]"
+        )
         self._stop = threading.Event()
         self._closed = False
         self._thread = threading.Thread(
@@ -406,20 +411,21 @@ class LiveExporter:
 
     def write(self) -> None:
         snap = self.registry.snapshot()
-        self._seq += 1
-        snap["seq"] = self._seq
-        snap["interval_s"] = self.interval_s
-        tmp = f"{self.path}.tmp.{os.getpid()}"
-        try:
-            with open(tmp, "w") as f:
-                json.dump(snap, f)
-            os.replace(tmp, self.path)
-        except OSError:
-            self.write_errors += 1
+        with self._write_lock:
+            self._seq += 1
+            snap["seq"] = self._seq
+            snap["interval_s"] = self.interval_s
+            tmp = f"{self.path}.tmp.{os.getpid()}"
             try:
-                os.unlink(tmp)
+                with open(tmp, "w") as f:
+                    json.dump(snap, f)
+                os.replace(tmp, self.path)
             except OSError:
-                pass
+                self.write_errors += 1
+                try:
+                    os.unlink(tmp)
+                except OSError:
+                    pass
 
     def close(self) -> None:
         """Stop the thread and write one final snapshot (the run's last

@@ -262,8 +262,10 @@ class TelemetryTransport(Transport):
                 sampled = n % cfg.sample == 0
             if sampled:
                 depth = self._queue_depth()
-                if depth is not None and depth > self._max_queue_depth:
-                    self._max_queue_depth = depth
+                if depth is not None:
+                    with self._stats_lock:
+                        if depth > self._max_queue_depth:
+                            self._max_queue_depth = depth
             if self.journal is not None and sampled:
                 # "mtag" not "tag": MetricsLogger's record schema already
                 # uses "tag" for the run identifier ("obs")

@@ -86,7 +86,7 @@ from typing import Optional, Sequence
 
 import numpy as np
 
-from mpit_tpu.analysis.runtime import make_lock
+from mpit_tpu.analysis.runtime import make_lock, note as _rt_note
 from mpit_tpu.obs.live import M_STALENESS, live_registry
 from mpit_tpu.parallel.elastic import ElasticMembership
 from mpit_tpu.transport import (
@@ -322,6 +322,12 @@ class PServer:
         if membership is not None:
             self._membership.load_state(membership)
 
+    def _note(self, field: str, write: bool = True) -> None:
+        """RT103 annotation: stamp an access to a shared field into the
+        vector-clock sanitizer (no-op — one attr load — unless a
+        race-mode runtime checker is armed, see MPIT_RT_RACE)."""
+        _rt_note(f"PServer#{id(self)}.{field}", write)
+
     def start(self) -> None:
         """Recv loop; stores any exception in ``self.error`` (a daemon
         thread's traceback would otherwise vanish while clients block into
@@ -353,6 +359,7 @@ class PServer:
             if watchdog and msg.src in last_seen:
                 last_seen[msg.src] = time.monotonic()
                 # a late message from a declared-dead client revives it
+                self._note("membership")
                 self.dead_clients.discard(msg.src)
             if isinstance(msg.payload, CorruptedPayload):
                 # an unparseable frame: in a real stack the tag itself
@@ -361,12 +368,16 @@ class PServer:
                 # still refreshed liveness above: garbage is a sign of
                 # life.
                 with self._lock:
+                    self._note("counts")
                     self.counts["malformed_dropped"] += 1
                 if watchdog:
                     self._expire(last_seen)
                 continue
             if msg.tag == TAG_FETCH:
                 with self._lock:
+                    self._note("center", write=False)
+                    self._note("version", write=False)
+                    self._note("counts")
                     snapshot = self.center.copy()
                     version = self.version
                     self.counts["fetch"] += 1
@@ -391,6 +402,9 @@ class PServer:
             elif msg.tag == TAG_PUSH_EASGD:
                 if self._admit_push(msg):
                     with self._lock:
+                        self._note("center")
+                        self._note("version")
+                        self._note("counts")
                         # elastic move toward the client (SURVEY.md §3(c) push)
                         self.center += self.alpha * (
                             np.asarray(msg.payload) - self.center
@@ -404,6 +418,9 @@ class PServer:
             elif msg.tag == TAG_PUSH_DELTA:
                 if self._admit_push(msg):
                     with self._lock:
+                        self._note("center")
+                        self._note("version")
+                        self._note("counts")
                         self.center += self.server_lr * np.asarray(msg.payload)
                         self.counts["push_delta"] += 1
                         self._updates_since_save += 1
@@ -413,6 +430,7 @@ class PServer:
                     self._maybe_persist()
             elif msg.tag == TAG_HEARTBEAT:
                 with self._lock:
+                    self._note("counts")
                     self.counts["heartbeat"] += 1
             elif msg.tag == TAG_JOIN:
                 # membership handshake: register the (rank, epoch) pair
@@ -422,11 +440,16 @@ class PServer:
                 parsed = self._parse_join(msg.payload)
                 if parsed is None:
                     with self._lock:
+                        self._note("counts")
                         self.counts["malformed_dropped"] += 1
                 else:
                     attempt, client_epoch = parsed
+                    self._note("membership")
                     kind = self._membership.register(msg.src, client_epoch)
                     with self._lock:
+                        self._note("center", write=False)
+                        self._note("version", write=False)
+                        self._note("counts")
                         snapshot = self.center.copy()
                         version = self.version
                         self.counts["join"] += 1
@@ -447,14 +470,17 @@ class PServer:
                     )
                     self.transport.send(msg.src, TAG_PARAM, reply)
             elif msg.tag == TAG_LEAVE:
+                self._note("membership")
                 self._membership.leave(msg.src)
                 with self._lock:
+                    self._note("counts")
                     self.counts["leave"] += 1
                 self._journal_dynamics(
                     "membership", src=msg.src, kind="leave",
                     view=self._membership.view_epoch, gen=self.gen,
                 )
             elif msg.tag == TAG_STOP:
+                self._note("membership")
                 self._stopped.add(msg.src)
             else:
                 raise ValueError(f"pserver: unknown tag {msg.tag}")
@@ -514,11 +540,16 @@ class PServer:
             arr = self._validate_chunk(chunk)
             if arr is None:
                 with self._lock:
+                    self._note("counts")
                     self.counts["malformed_dropped"] += 1
                 return False
             msg.payload = arr
+            # dedup is confined to the server thread — annotated so RT103
+            # would catch any future second mutator
+            self._note("dedup")
             if not self._dedup.admit(msg.src, epoch, seq):
                 with self._lock:
+                    self._note("counts")
                     self.counts["dup_dropped"] += 1
                 return False
             msg.basis_version = basis
@@ -527,6 +558,7 @@ class PServer:
         arr = self._validate_chunk(payload)
         if arr is None:
             with self._lock:
+                self._note("counts")
                 self.counts["malformed_dropped"] += 1
             return False
         msg.payload = arr
@@ -558,6 +590,7 @@ class PServer:
             return
         staleness = max(0, version - 1 - basis)
         with self._lock:
+            self._note("staleness")
             st = self.staleness_by_src.setdefault(
                 msg.src, {"pushes": 0, "sum": 0, "max": 0}
             )
@@ -612,6 +645,8 @@ class PServer:
         it mutated (its redelivery then re-applies exactly once relative
         to the restored state)."""
         with self._lock:
+            self._note("center", write=False)
+            self._note("version", write=False)
             state = {
                 "center": self.center.copy(),
                 "version": int(self.version),
@@ -634,6 +669,7 @@ class PServer:
             return
         if self.ckpt_path.endswith(".npy"):
             with self._lock:
+                self._note("center", write=False)
                 snap = self.center.copy()
                 self._updates_since_save = 0
             tmp = self.ckpt_path + ".tmp"
@@ -653,10 +689,12 @@ class PServer:
                 and r not in self.dead_clients
                 and now - seen > self.client_timeout
             ):
+                self._note("membership")
                 self.dead_clients.add(r)
 
     def snapshot(self) -> np.ndarray:
         with self._lock:
+            self._note("center", write=False)
             return self.center.copy()
 
 

@@ -66,12 +66,12 @@ from __future__ import annotations
 import dataclasses
 import os
 import random
-import threading
 import time
 from typing import Any, Iterable, Mapping, Optional, Sequence
 
 import numpy as np
 
+from mpit_tpu.analysis.runtime import make_lock
 from mpit_tpu.transport.base import Transport
 from mpit_tpu.transport.wire import QuantArray
 
@@ -155,7 +155,7 @@ class FaultLog:
     """
 
     def __init__(self):
-        self._lock = threading.Lock()
+        self._lock = make_lock("chaos.FaultLog._lock")
         self._events: list[FaultEvent] = []
 
     def append(self, event: FaultEvent) -> None:
@@ -346,7 +346,7 @@ class ChaosTransport(Transport):
         self.size = inner.size
         self.config = config
         self.log = log if log is not None else FaultLog()
-        self._lock = threading.Lock()
+        self._lock = make_lock(f"chaos.ChaosTransport._lock[{inner.rank}]")
         self._stream_n: dict[tuple[int, int], int] = {}
         self._blackhole_until: dict[tuple[int, int], int] = {}
         self._sent_total = 0

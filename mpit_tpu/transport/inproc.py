@@ -11,7 +11,6 @@ messages are small.
 from __future__ import annotations
 
 import collections
-import threading
 import time
 from typing import Any, Optional
 
@@ -31,13 +30,21 @@ class Broker:
     def __init__(self, size: int):
         self.size = size
         self._queues = [collections.deque() for _ in range(size)]
-        self._conds = [threading.Condition() for _ in range(size)]
+        self._conds = [
+            _rt.make_condition(f"Broker.cond[{i}]") for i in range(size)
+        ]
+
+    def _note(self, dst: int) -> None:
+        """RT103 annotation: every mailbox mutation is stamped into the
+        vector-clock sanitizer when one is armed (no-op otherwise)."""
+        _rt.note(f"Broker#{id(self)}.q{dst}", True)
 
     def put(self, msg: Message) -> None:
         if not 0 <= msg.dst < self.size:
             raise ValueError(f"dst {msg.dst} out of range (size {self.size})")
         cond = self._conds[msg.dst]
         with cond:
+            self._note(msg.dst)
             self._queues[msg.dst].append(msg)
             cond.notify_all()
 
@@ -66,6 +73,7 @@ class Broker:
                     # and gives ANY_SOURCE the MPI arrival-order semantics
                     for i, msg in enumerate(q):
                         if msg.matches(src, tag):
+                            self._note(dst)
                             del q[i]
                             return msg
                     if deadline is None:

@@ -59,7 +59,7 @@ import threading
 import time
 from typing import Any, Optional, Sequence
 
-from mpit_tpu.analysis.runtime import make_lock
+from mpit_tpu.analysis.runtime import make_condition, make_lock
 from mpit_tpu.transport import wire
 from mpit_tpu.transport.base import (
     ANY_SOURCE,
@@ -471,8 +471,13 @@ class SocketTransport(Transport):
         return n
 
     def _send_item(self, dst: int, item: _OutMessage) -> int:
-        sock = self._out[dst]
-        if item.buffers is not None and self._peer_framed.get(dst):
+        # under the dst lock the cached entries are stable, but the DICTS
+        # are shared with close()/other drainers — reads take the cache
+        # lock like every other access
+        with self._out_cache_lock:
+            sock = self._out[dst]
+            peer_framed = self._peer_framed.get(dst)
+        if item.buffers is not None and peer_framed:
             return self._sendmsg_all(sock, item.framed_buffers())
         frame = item.pickle_frame()
         sock.sendall(frame)
@@ -606,7 +611,7 @@ class _SendQueue:
     def __init__(self, transport: "SocketTransport", dst: int):
         self._transport = transport
         self._dst = dst
-        self._cond = threading.Condition()
+        self._cond = make_condition(f"socket._SendQueue.cond[{dst}]")
         # deque: the drainer pops from the front on every message — a list's
         # pop(0) is O(n) and melts under backlog (a slow peer + isend burst)
         # items are (msg, handle, enqueue perf_counter) — the timestamp

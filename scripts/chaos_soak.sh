@@ -10,6 +10,9 @@
 # 600) is spent, so CI can pin a budget without killing a round midway.
 # After the sweep, one live-armed 3-rank process round runs and gates on
 # the alert engine (`obs live --once`): unexpected alerts exit nonzero.
+# A second 3-rank round runs with MPIT_RT_RACE=1 — every rank arms the
+# vector-clock race sanitizer (RT103, docs/ANALYSIS.md) and a healthy
+# run must report zero findings from every process.
 set -euo pipefail
 cd "$(dirname "$0")/.."
 
@@ -57,5 +60,35 @@ if ((SECONDS - START < MAX_SECONDS)); then
   trap - EXIT
 else
   echo "chaos_soak: budget spent; skipping live-armed round" >&2
+fi
+
+# RT103-armed round: the same healthy 3-rank shape with the runtime race
+# sanitizer on in every rank process. The gate is two-sided — the armed
+# marker must appear (the knob can't silently rot) and no rank may
+# report a race (the annotated PServer/Broker hot paths must stay
+# lock-ordered under real traffic).
+if ((SECONDS - START < MAX_SECONDS)); then
+  echo "=== chaos soak: RT103-armed 3-rank round ===" >&2
+  OUT="$(mktemp -d)"
+  LOG="$OUT/rt_race.log"
+  trap 'rm -rf "$OUT"' EXIT
+  env JAX_PLATFORMS=cpu MPIT_RT_RACE=1 MPIT_OBS_DIR="$OUT" \
+      timeout -k 10 120 \
+      python -m mpit_tpu.launch -n 3 examples/ptest_proc.py \
+      --model mlp --steps 16 --train-size 256 --algo ps-easgd \
+      2>&1 | tee "$LOG"
+  if ! grep -q "rt-race.*armed" "$LOG"; then
+    echo "chaos_soak: MPIT_RT_RACE=1 never armed the sanitizer" >&2
+    exit 1
+  fi
+  if grep "\[rt-race\]" "$LOG" | grep -v "armed" | grep -qv " 0 finding(s)"; then
+    echo "chaos_soak: RT103 reported race finding(s):" >&2
+    grep -B1 -A12 "RT103\|race on" "$LOG" >&2 || true
+    exit 1
+  fi
+  rm -rf "$OUT"
+  trap - EXIT
+else
+  echo "chaos_soak: budget spent; skipping RT103-armed round" >&2
 fi
 echo "chaos_soak: OK"

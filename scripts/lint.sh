@@ -5,8 +5,12 @@
 #   scripts/lint.sh --update        # accept the current findings as baseline
 #   scripts/lint.sh --fix           # rewrite fixable MPT002 sites, then gate
 #   scripts/lint.sh path/to/file.py # lint specific paths (vs the baseline)
+#   scripts/lint.sh --fix --only MPT013,MPT015 path.py
+#                                   # everything after --fix passes through,
+#                                   # so one rule iterates without the full
+#                                   # pass (--only also works standalone)
 #
-# The default run is five gates behind the one baseline:
+# The default run is eight gates behind the one baseline:
 #   1. the static lint (MPT001-008, MPT012) + protocol model check
 #      (MPT009-011);
 #   2. an explicit `mcheck` pass, so the exhaustive state counts land in
@@ -26,9 +30,15 @@
 #   7. the black-box post-mortem contract over the checked-in golden
 #      (tests/fixtures/blackbox: 3-rank run, rank 2 SIGKILLed) — exit
 #      codes pinned: the incident fixture must exit 1 naming rank 2 as
-#      first-mover, an empty dir must exit 2.
-# The whole default run is bounded to < 15 s wall-clock
-# (tests/test_lint_gate.py enforces it).
+#      first-mover, an empty dir must exit 2;
+#   8. the concurrency gate: each seeded MPT013/014/015 fixture must
+#      trip exactly its rule through the real CLI (the lockset walk
+#      can't silently lose thread-root discovery), and the RT103
+#      vector-clock sanitizer must catch a seeded unsynchronized write
+#      pair while staying silent on the lock-ordered twin.
+# Every gate prints its wall-clock ([lint] gate N ... Xs); the whole
+# default run is bounded to < 15 s (tests/test_lint_gate.py enforces
+# it, and separately pins the in-process whole-package scan to < 5 s).
 #
 # Exit codes: 0 clean vs baseline, 1 new findings, 2 usage error.
 # The linter parses, never imports, the scanned code and initializes no
@@ -47,21 +57,38 @@ if [[ "${1:-}" == "--fix" ]]; then
     exec python -m mpit_tpu.analysis --fix "${@:-mpit_tpu/}"
 fi
 
+# per-gate wall-clock: every gate below reports its own cost, so a
+# budget regression (the 15 s bound) names its gate instead of hiding
+# in the total
+_gate_last=$(date +%s%N)
+gate_done() {
+    local now
+    now=$(date +%s%N)
+    awk -v n="$1" -v a="$_gate_last" -v b="$now" \
+        'BEGIN{printf "[lint] gate %-14s %6.2fs\n", n, (b-a)/1e9}'
+    _gate_last=$now
+}
+
 python -m mpit_tpu.analysis "${@:-mpit_tpu/}"
+gate_done lint
 
 # explicit-path gates only make sense for the default whole-package run
 if [[ $# -eq 0 ]]; then
     python -m mpit_tpu.analysis mcheck
+    gate_done mcheck
     # one extraction, two audits: the chaos fixture covers TC201-203
     # under faults, the dynamics fixture carries param_version records
     # so TC204 (version monotonicity) runs non-vacuously
     python -m mpit_tpu.analysis conform \
         tests/fixtures/conformance/good_run tests/fixtures/dynamics/good_run
+    gate_done conform
     # the live-snapshot schema contract, gated on the checked-in golden
     python -m mpit_tpu.obs live tests/fixtures/live --validate
+    gate_done live
     # the update-quality contract, gated on the same dynamics golden
     python -m mpit_tpu.obs dynamics tests/fixtures/dynamics/good_run \
         --gate scripts/dynamics_smoke.json
+    gate_done dynamics
     # the shared quant kernels must stay importable WITHOUT a jax
     # backend (the host wire path depends on it; the jnp half is lazy) —
     # and the transport re-exports the MPT007 coverage rides on must
@@ -80,6 +107,7 @@ q = quant.quantize(np.ones(8, np.float32), "int8")
 out = quant.dequantize(q)
 assert out.shape == (8,) and out.dtype == np.float32
 EOF
+    gate_done quant-probe
     # the post-mortem contract, gated on the checked-in incident golden
     # (exit codes are part of the CLI contract: 1 = incident found,
     # 2 = no dumps; one python process drives obs_main for both runs).
@@ -117,7 +145,51 @@ print(
     "exit codes 1/2 pinned — ok"
 )
 EOF
+    gate_done postmortem
+    # gate 8: the concurrency contract. (a) Each seeded fixture must
+    # trip exactly its rule through the REAL CLI — a regression in
+    # thread-root discovery or the lockset walk turns these scans
+    # silently green, so the expected exit-1 is asserted, not assumed.
+    for rule in MPT013 MPT014 MPT015; do
+        low=$(echo "$rule" | tr '[:upper:]' '[:lower:]')
+        if python -m mpit_tpu.analysis --no-baseline --only "$rule" \
+                "tests/fixtures/analysis/fixture_${low}" > /dev/null; then
+            echo "concurrency gate: fixture_${low} no longer trips ${rule}" >&2
+            exit 1
+        fi
+    done
+    # (b) RT103 smoke: the vector-clock sanitizer must flag a seeded
+    # unsynchronized write pair (with both stacks) and stay silent when
+    # the same traffic is ordered through a tracked lock
+    python - <<'EOF'
+import threading
+from mpit_tpu.analysis import runtime as rt
+
+with rt.checking(race=True) as ck:
+    def bump():
+        for _ in range(3):
+            rt.note("gate.shared", True)
+    ts = [threading.Thread(target=bump) for _ in range(2)]
+    [t.start() for t in ts]; [t.join() for t in ts]
+races = [f for f in ck.findings if f.rule == "RT103"]
+assert races, "RT103 smoke: seeded race not caught"
+assert races[0].message.count('File "') >= 2, "RT103 smoke: missing a stack"
+
+with rt.checking(race=True) as ck2:
+    lk = rt.make_lock("gate.lk")
+    def bump2():
+        for _ in range(3):
+            with lk:
+                rt.note("gate.shared2", True)
+    ts = [threading.Thread(target=bump2) for _ in range(2)]
+    [t.start() for t in ts]; [t.join() for t in ts]
+assert not [f for f in ck2.findings if f.rule == "RT103"], \
+    "RT103 smoke: false positive on lock-ordered writes"
+print("concurrency gate: 3 fixtures trip their rules, RT103 smoke ok")
+EOF
+    gate_done concurrency
     # warn-only: bench trajectory drift should be SEEN at lint time, but
     # bench noise must never block a commit (--strict exists for CI)
     python scripts/bench_gate.py --trend || true
+    gate_done bench-trend
 fi

@@ -1,0 +1,7 @@
+"""Seeded MPT013 package: cross-thread shared state with no common lock.
+
+``worker.py`` spawns a drainer thread that pops ``pending`` under the
+instance lock while ``submit()`` (main thread) appends to it with no lock
+at all — the canonical empty-lockset-intersection race. Parsed by the
+linter tests, never imported.
+"""

@@ -1,0 +1,22 @@
+"""Seeded MPT013: ``pending`` is written from two thread roots, and the
+submitting side holds no lock. Parsed by the linter tests, never
+imported or executed."""
+
+import threading
+
+
+class JobPump:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.pending = []
+        self._thread = threading.Thread(target=self._drain, daemon=True)
+        self._thread.start()
+
+    def _drain(self):
+        while True:
+            with self._lock:
+                if self.pending:
+                    self.pending.pop()
+
+    def submit(self, job):
+        self.pending.append(job)  # BUG: no lock — races with _drain

@@ -1,0 +1,7 @@
+"""Seeded MPT014 package: a static lock-order cycle.
+
+``deadlock.py`` runs two threads over the same pair of locks in opposite
+nesting order — each path is deadlock-free alone, together they can
+deadlock; only the cross-path cycle check sees it. Parsed by the linter
+tests, never imported.
+"""

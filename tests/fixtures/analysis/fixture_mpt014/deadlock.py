@@ -1,0 +1,24 @@
+"""Seeded MPT014: ``_a_lock``/``_b_lock`` acquired in opposite orders on
+two thread roots. Parsed by the linter tests, never imported or
+executed."""
+
+import threading
+
+
+class Shuttle:
+    def __init__(self):
+        self._a_lock = threading.Lock()
+        self._b_lock = threading.Lock()
+        self.hops = 0
+        threading.Thread(target=self._forward, daemon=True).start()
+        threading.Thread(target=self._backward, daemon=True).start()
+
+    def _forward(self):
+        with self._a_lock:
+            with self._b_lock:
+                self.hops += 1
+
+    def _backward(self):
+        with self._b_lock:  # BUG: opposite order — cycle with _forward
+            with self._a_lock:
+                self.hops += 1

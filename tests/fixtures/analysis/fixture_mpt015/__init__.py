@@ -1,0 +1,7 @@
+"""Seeded MPT015 package: blocking I/O under a lock held by a CALLER.
+
+``flusher.py``'s leaf helper looks innocent in isolation (MPT006 stays
+silent by design — the ``with`` is a frame above); only the call-graph
+lockset walk sees the socket write inside the critical section. Parsed
+by the linter tests, never imported.
+"""

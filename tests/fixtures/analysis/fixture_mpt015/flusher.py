@@ -1,0 +1,19 @@
+"""Seeded MPT015: the blocking ``sendall`` sits one call-frame below the
+``with self._lock:`` that covers it. Parsed by the linter tests, never
+imported or executed."""
+
+import threading
+
+
+class Flusher:
+    def __init__(self, sock):
+        self._lock = threading.Lock()
+        self._sock = sock
+        threading.Thread(target=self._loop, daemon=True).start()
+
+    def _loop(self):
+        with self._lock:
+            self._flush()  # BUG: the lock spans the blocking write below
+
+    def _flush(self):
+        self._sock.sendall(b"x")

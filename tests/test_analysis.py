@@ -102,6 +102,13 @@ def test_baseline_is_not_stale():
         # to find the violating fault schedule (and nothing else)
         ("fixture_mpt009", "MPT009"),
         ("fixture_mpt011", "MPT011"),
+        # concurrency rules: whole-program thread-root discovery + the
+        # lockset walk over each seeded package (tests/test_threads.py
+        # exercises the model itself; here each fixture pins the
+        # fires-exactly-once contract like every other rule)
+        ("fixture_mpt013", "MPT013"),
+        ("fixture_mpt014", "MPT014"),
+        ("fixture_mpt015", "MPT015"),
     ],
 )
 def test_fixture_triggers_exactly_its_rule(fixture, rule):

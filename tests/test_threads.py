@@ -1,0 +1,376 @@
+"""Whole-program concurrency analysis (mpit_tpu.analysis.threads) and the
+RT103 vector-clock race sanitizer.
+
+Four layers:
+
+- the MODEL: thread-root discovery and per-access locksets over the real
+  package — the named daemon threads must be found, and the PServer hot
+  state must carry the lockset the code actually takes;
+- the RULES going QUIET: each seeded MPT013/014/015 fixture, with its
+  one bug fixed, lints clean (tests/test_analysis.py pins the firing
+  direction; this file pins the silence direction);
+- the CLI: the ``threads`` subcommand and the ``--only`` rule filter;
+- RT103: the sanitizer catches a seeded unsynchronized mutation of live
+  PServer state with both stacks, stays silent across a swarm-shaped
+  multi-client round, and arms from MPIT_RT_RACE=1.
+
+Plus the lock-hygiene contract: every raw ``threading.Lock/RLock/
+Condition`` constructed in the package is either routed through
+``make_lock``/``make_condition`` or allowlisted with a reason.
+"""
+
+from __future__ import annotations
+
+import ast
+import json
+import os
+import shutil
+import subprocess
+import sys
+import threading
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+from mpit_tpu.analysis import lint
+from mpit_tpu.analysis import runtime as rt
+
+REPO = Path(__file__).resolve().parent.parent
+PKG = REPO / "mpit_tpu"
+FIXTURES = Path(__file__).resolve().parent / "fixtures" / "analysis"
+ALLOWLIST = Path(__file__).resolve().parent / "lock_allowlist.json"
+
+
+def _model(paths):
+    modules = []
+    for ap, rel in lint.collect_files(paths):
+        ctx = lint.load_module(ap, rel)
+        if ctx is not None:
+            modules.append(ctx)
+    project = lint.Project(modules=modules, config=lint.Config())
+    return project.threads
+
+
+@pytest.fixture(scope="module")
+def package_model():
+    return _model([PKG])
+
+
+# ------------------------------------------------------------------ model
+
+
+def test_package_model_discovers_known_roots(package_model):
+    names = {r.name for r in package_model.roots}
+    # the load-bearing daemons: the PS server loop, the socket reader
+    # machinery, the heartbeat, the blackbox watcher, and the live
+    # exporter — losing any of these silently blinds MPT013-015
+    for expected in (
+        "mpit-pserver",
+        "mpit-pclient-heartbeat",
+        "mpit-blackbox-watch",
+        "SocketTransport._accept_loop",
+        "SocketTransport._read_loop",
+        "LiveExporter._run",
+    ):
+        assert expected in names, sorted(names)
+
+
+def test_pserver_center_is_shared_and_locked(package_model):
+    """The acceptance enumeration: PServer.center is cross-root shared
+    state whose server-side WRITES all hold PServer._lock."""
+    states = package_model.owner_state("PServer")
+    center = next(
+        (pr for s, pr in states.items() if s.name == "center"), None
+    )
+    assert center is not None, sorted(s.label() for s in states)
+    assert len(center) >= 2, "center must be touched from >=2 roots"
+    server = center.get("mpit-pserver")
+    assert server is not None and server["writes"] > 0
+    for ls in server["write_locksets"]:
+        assert any("PServer._lock" in l.label() for l in ls), ls
+
+
+def test_pserver_counts_writes_are_all_locked(package_model):
+    states = package_model.owner_state("PServer")
+    counts = next(
+        (pr for s, pr in states.items() if s.name == "counts"), None
+    )
+    assert counts is not None
+    server = counts.get("mpit-pserver")
+    assert server is not None
+    for ls in server["write_locksets"]:
+        assert any("PServer._lock" in l.label() for l in ls), ls
+
+
+def test_model_json_shape(package_model):
+    doc = package_model.to_json()
+    assert doc["roots"] and doc["shared_state"] is not None
+    json.dumps(doc)  # the --json contract: serializable as-is
+
+
+# ------------------------------------------------- rules go quiet when fixed
+
+_FIXES = {
+    "fixture_mpt013": (
+        "worker.py",
+        "    def submit(self, job):\n"
+        "        self.pending.append(job)  # BUG: no lock — races with _drain\n",
+        "    def submit(self, job):\n"
+        "        with self._lock:\n"
+        "            self.pending.append(job)\n",
+    ),
+    "fixture_mpt014": (
+        "deadlock.py",
+        "        with self._b_lock:  # BUG: opposite order — cycle with _forward\n"
+        "            with self._a_lock:\n",
+        "        with self._a_lock:\n"
+        "            with self._b_lock:\n",
+    ),
+    "fixture_mpt015": (
+        "flusher.py",
+        "        with self._lock:\n"
+        "            self._flush()  # BUG: the lock spans the blocking write below\n",
+        "        with self._lock:\n"
+        "            pass\n"
+        "        self._flush()\n",
+    ),
+}
+
+
+@pytest.mark.parametrize("fixture", sorted(_FIXES))
+def test_fixture_goes_quiet_when_fixed(fixture, tmp_path):
+    """The other half of the fires-exactly-once contract: applying the
+    obvious fix silences the rule (no residual finding survives)."""
+    target, bug, fix = _FIXES[fixture]
+    dst = tmp_path / fixture
+    shutil.copytree(FIXTURES / fixture, dst)
+    f = dst / target
+    src = f.read_text()
+    assert bug in src, "fixture drifted from the test's patch"
+    f.write_text(src.replace(bug, fix))
+    findings = lint.run_lint([dst], lint.Config(hot_all=True))
+    assert findings == [], [x.format() for x in findings]
+
+
+# -------------------------------------------------------------------- CLI
+
+
+def _cli(*args, **kw):
+    return subprocess.run(
+        [sys.executable, "-m", "mpit_tpu.analysis", *args],
+        capture_output=True,
+        text=True,
+        cwd=REPO,
+        **kw,
+    )
+
+
+def test_threads_cli_json():
+    p = _cli("threads", "--package", "mpit_tpu", "--json")
+    assert p.returncode == 0, p.stderr
+    doc = json.loads(p.stdout)
+    assert any(r["name"] == "mpit-pserver" for r in doc["roots"])
+    assert doc["shared_state"]
+
+
+def test_threads_cli_owner_filter():
+    p = _cli("threads", "--package", "mpit_tpu", "--owner", "PServer")
+    assert p.returncode == 0, p.stderr
+    assert "center" in p.stdout and "PServer._lock" in p.stdout
+
+
+def test_only_filter_skips_other_rules():
+    # the MPT015 fixture under an MPT013-only run: nothing may fire
+    fx = str(FIXTURES / "fixture_mpt015")
+    p = _cli("--no-baseline", "--only", "MPT013", fx)
+    assert p.returncode == 0, p.stdout + p.stderr
+    p = _cli("--no-baseline", "--only", "MPT015", fx)
+    assert p.returncode == 1
+    assert "MPT015" in p.stdout
+
+
+def test_only_filter_rejects_unknown_rule():
+    p = _cli("--no-baseline", "--only", "MPT999", "mpit_tpu")
+    assert p.returncode == 2
+    assert "unknown rule" in p.stderr
+
+
+def test_only_filter_in_process():
+    findings = lint.run_lint(
+        [FIXTURES / "fixture_mpt013"],
+        lint.Config(hot_all=True, only_rules=["MPT014"]),
+    )
+    assert findings == []
+
+
+# ------------------------------------------------------------------- RT103
+
+
+def _pserver_world(n_clients):
+    from mpit_tpu.parallel.pserver import PServer, spawn_server_thread
+    from mpit_tpu.transport import Broker
+
+    broker = Broker(n_clients + 1)
+    tps = broker.transports()
+    server = PServer(
+        tps[0], np.zeros(16, np.float32), num_clients=n_clients, alpha=0.3
+    )
+    return server, spawn_server_thread(server), tps
+
+
+def test_rt103_catches_seeded_pserver_race():
+    """A rogue thread mutating live server state WITHOUT the server lock
+    while real traffic flows: RT103 must report the pair with both
+    stacks (the whole point over a plain assertion — you see both
+    sides of the interleaving)."""
+    from mpit_tpu.parallel.pserver import TAG_HEARTBEAT, TAG_STOP
+
+    with rt.checking(race=True) as ck:
+        server, th, tps = _pserver_world(1)
+
+        def rogue():
+            for _ in range(100):
+                server._note("counts")  # the bug: no server._lock held
+                server.counts["heartbeat"] += 1
+
+        rg = threading.Thread(target=rogue, name="rogue-mutator")
+        rg.start()
+        for _ in range(30):
+            tps[1].send(0, TAG_HEARTBEAT, None)
+        rg.join()
+        tps[1].send(0, TAG_STOP, None)
+        th.join(timeout=5)
+        assert not th.is_alive() and server.error is None
+    races = [f for f in ck.findings if f.rule == "RT103"]
+    assert races, [f.format() for f in ck.findings]
+    msg = races[0].message
+    assert "counts" in msg
+    assert msg.count('File "') >= 2, "both stacks must be reported:\n" + msg
+
+
+def test_rt103_silent_on_multi_client_swarm():
+    """Swarm shape: 8 clients hammering fetch/push/heartbeat against one
+    live server through the broker — every annotated access is ordered
+    by PServer._lock / the mailbox conditions, so RT103 stays silent."""
+    from mpit_tpu.parallel.pserver import (
+        TAG_FETCH,
+        TAG_HEARTBEAT,
+        TAG_PARAM,
+        TAG_PUSH_EASGD,
+        TAG_STOP,
+    )
+
+    n = 8
+    with rt.checking(race=True) as ck:
+        server, th, tps = _pserver_world(n)
+
+        def client(r):
+            tp = tps[r]
+            for _ in range(5):
+                tp.send(0, TAG_FETCH, None)
+                center = tp.recv(src=0, tag=TAG_PARAM, timeout=10).payload
+                tp.send(0, TAG_PUSH_EASGD, center + 0.01 * r)
+                tp.send(0, TAG_HEARTBEAT, None)
+            tp.send(0, TAG_STOP, None)
+
+        ts = [
+            threading.Thread(target=client, args=(r,))
+            for r in range(1, n + 1)
+        ]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join(timeout=30)
+        th.join(timeout=10)
+        assert not th.is_alive() and server.error is None
+        assert server.counts["push_easgd"] == n * 5
+    races = [f for f in ck.findings if f.rule == "RT103"]
+    assert races == [], [f.format() for f in races]
+
+
+def test_rt103_condition_handoff_is_ordered():
+    """wait()/notify() through a tracked condition is a happens-before
+    edge: producer-consumer over make_condition must not report."""
+    with rt.checking(race=True) as ck:
+        cv = rt.make_condition("t.cv")
+        box = []
+
+        def producer():
+            with cv:
+                rt.note("t.box", True)
+                box.append(1)
+                cv.notify()
+
+        def consumer():
+            with cv:
+                while not box:
+                    cv.wait(5.0)
+                rt.note("t.box", False)
+
+        tc = threading.Thread(target=consumer)
+        tc.start()
+        tp_ = threading.Thread(target=producer)
+        tp_.start()
+        tc.join(5)
+        tp_.join(5)
+    assert [f for f in ck.findings if f.rule == "RT103"] == []
+
+
+def test_rt103_arms_from_env():
+    """MPIT_RT_RACE=1 arms the sanitizer at import and prints the atexit
+    report — the knob chaos_soak.sh's RT103 round greps for."""
+    p = subprocess.run(
+        [sys.executable, "-c", "import mpit_tpu.analysis.runtime"],
+        capture_output=True,
+        text=True,
+        env={**os.environ, "MPIT_RT_RACE": "1"},
+        cwd=REPO,
+    )
+    assert p.returncode == 0, p.stderr
+    assert "vector-clock race sanitizer armed" in p.stderr
+    assert "0 finding(s)" in p.stderr
+
+
+# ---------------------------------------------------------------- hygiene
+
+_RAW_CTORS = {"Lock", "RLock", "Condition"}
+
+
+def _raw_lock_files():
+    """Repo-relative paths of package files that construct a raw
+    threading.Lock/RLock/Condition (AST-level: comments and strings
+    don't count, aliased imports do)."""
+    offenders = set()
+    for py in sorted(PKG.rglob("*.py")):
+        tree = ast.parse(py.read_text(), filename=str(py))
+        for node in ast.walk(tree):
+            if not isinstance(node, ast.Call):
+                continue
+            fn = node.func
+            if (
+                isinstance(fn, ast.Attribute)
+                and fn.attr in _RAW_CTORS
+                and isinstance(fn.value, ast.Name)
+                and fn.value.id == "threading"
+            ):
+                offenders.add(py.relative_to(REPO).as_posix())
+    return offenders
+
+
+def test_raw_lock_constructors_are_allowlisted():
+    """Every raw lock/condition constructor in the package either goes
+    through the tracked factory or is in tests/lock_allowlist.json with
+    a reason — and the allowlist carries no stale entries."""
+    allow = json.loads(ALLOWLIST.read_text())["allowed"]
+    offenders = _raw_lock_files()
+    unlisted = offenders - set(allow)
+    assert not unlisted, (
+        f"raw threading.Lock/RLock/Condition in {sorted(unlisted)} — "
+        "route through mpit_tpu.analysis.runtime.make_lock/make_condition "
+        "or add an allowlist entry with a reason"
+    )
+    stale = set(allow) - offenders
+    assert not stale, f"stale allowlist entries: {sorted(stale)}"
+    for path, reason in allow.items():
+        assert len(reason) > 20, f"{path}: allowlist reason too thin"
