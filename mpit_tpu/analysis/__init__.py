@@ -11,7 +11,12 @@ Two halves (ISSUE 1, cross-module pass ISSUE 2):
   cross-module rules share a whole-program name-resolution index
   (:mod:`~mpit_tpu.analysis.graph`) and a protocol-role model
   (:mod:`~mpit_tpu.analysis.protocol`) — still AST-only, scanned code is
-  never imported;
+  never imported. The wire payload-schema model
+  (:mod:`~mpit_tpu.analysis.schema`, rules MPT016–018, ``schema``
+  subcommand) rides the same indexes: per-tag sender/receiver schemas
+  gated against the checked-in ``wire-schema.lock.json``, with the
+  differential codec fuzz gate (``fuzz`` subcommand,
+  :mod:`mpit_tpu.transport.fuzz`) as its dynamic half;
 - an opt-in runtime checker (:mod:`~mpit_tpu.analysis.runtime`, rules
   RT101/RT102) instrumenting the transport layer's locks and mailboxes for
   lock-order cycles and concurrent tag reuse.

@@ -31,6 +31,22 @@ Subcommands:
     class/module's state (shared or not); ``--json`` emits the
     machine-readable form the threading-model doc is generated from.
 
+``python -m mpit_tpu.analysis schema [--json|--check|--update-lock]``
+    Print the inferred per-tag payload-schema table behind MPT016–018
+    (sender construction shapes vs receiver consumption patterns, plus
+    the snapshot write/read key sets). ``--check`` diffs it against the
+    checked-in ``wire-schema.lock.json`` and exits 1 on undeclared
+    drift; ``--update-lock`` regenerates the lock — protocol-shape
+    changes are *declared*, never silent.
+
+``python -m mpit_tpu.analysis fuzz [--corpus PATH] [--examples N]``
+    The differential codec fuzz gate: seeded strategies over the
+    structural payload grammar drive encode→decode roundtrips,
+    framed-vs-pickle differential equality, and frame mutations that
+    must always land on WireDecodeError — never a wrong value.
+    ``--corpus`` additionally replays the checked-in regression corpus;
+    ``--regen-corpus`` rebuilds it deterministically.
+
 Exit codes (every mode, regardless of output format): 0 clean (vs
 baseline), 1 new findings / violations, 2 usage or input error.
 """
@@ -311,6 +327,204 @@ def _main_threads(argv) -> int:
     return 0
 
 
+def _default_lock_path(package: str):
+    root = lint.find_repo_root(Path(package))
+    if root is None:
+        return None
+    from mpit_tpu.analysis import schema as schema_mod
+
+    return root / schema_mod.SCHEMA_LOCK_FILENAME
+
+
+def _schema_drift_lines(locked: dict, inferred: dict) -> list:
+    """Human-readable per-tag drift between the lock and the scan."""
+    out = []
+    ltags = locked.get("tags", {})
+    itags = inferred.get("tags", {})
+    for key in sorted(set(ltags) | set(itags), key=int):
+        lt, it = ltags.get(key), itags.get(key)
+        name = (it or lt or {}).get("name") or f"tag {key}"
+        if lt is None:
+            out.append(f"  {name} ({key}): not in lock (new tag)")
+            continue
+        if it is None:
+            out.append(f"  {name} ({key}): in lock but no longer inferred")
+            continue
+        for side in ("sender", "receiver"):
+            if lt.get(side) != it.get(side):
+                out.append(
+                    f"  {name} ({key}) {side}: lock {lt.get(side)} != "
+                    f"inferred {it.get(side)}"
+                )
+    lsnap = locked.get("snapshot", {})
+    isnap = inferred.get("snapshot", {})
+    for side in ("writes", "reads"):
+        if lsnap.get(side) != isnap.get(side):
+            out.append(
+                f"  snapshot {side}: lock {lsnap.get(side)} != "
+                f"inferred {isnap.get(side)}"
+            )
+    if locked.get("version") != inferred.get("version"):
+        out.append(
+            f"  lock version {locked.get('version')!r} != "
+            f"{inferred.get('version')!r}"
+        )
+    return out
+
+
+def _main_schema(argv) -> int:
+    from mpit_tpu.analysis import schema as schema_mod
+
+    parser = argparse.ArgumentParser(
+        prog="python -m mpit_tpu.analysis schema",
+        description="Infer the per-tag wire payload schemas (MPT016-018"
+        " model) and diff them against wire-schema.lock.json.",
+    )
+    parser.add_argument(
+        "--package",
+        default=_default_scan_path(),
+        help="package to analyze (default: mpit_tpu)",
+    )
+    parser.add_argument(
+        "--lock",
+        metavar="PATH",
+        help="lock file (default: wire-schema.lock.json at the repo root)",
+    )
+    parser.add_argument(
+        "--check",
+        action="store_true",
+        help="exit 1 when the inferred schema drifts from the lock",
+    )
+    parser.add_argument(
+        "--update-lock",
+        action="store_true",
+        help="regenerate the lock from the current scan (declaring the "
+        "protocol change) and exit 0",
+    )
+    parser.add_argument(
+        "--json", action="store_true", help="machine-readable output"
+    )
+    args = parser.parse_args(argv)
+    if not Path(args.package).exists():
+        print(f"error: no such path: {args.package}", file=sys.stderr)
+        return 2
+    model = _load_project(args.package).schema
+    doc = model.to_json()
+    lock_path = (
+        Path(args.lock) if args.lock else _default_lock_path(args.package)
+    )
+
+    if args.update_lock:
+        if lock_path is None:
+            print(
+                "error: no lock path (pass --lock or run inside the repo)",
+                file=sys.stderr,
+            )
+            return 2
+        with open(lock_path, "w") as f:
+            json.dump(doc, f, indent=2, sort_keys=True)
+            f.write("\n")
+        print(f"wrote {len(doc['tags'])} tag schema(s) to {lock_path}")
+        return 0
+
+    if args.check:
+        if lock_path is None or not lock_path.exists():
+            print(
+                f"error: no schema lock at {lock_path} — generate it "
+                "with --update-lock",
+                file=sys.stderr,
+            )
+            return 2
+        with open(lock_path) as f:
+            locked = json.load(f)
+        drift = _schema_drift_lines(locked, doc)
+        if not drift:
+            print(
+                f"wire schema: {len(doc['tags'])} tag(s) match "
+                f"{lock_path.name}"
+            )
+            return 0
+        print(f"wire schema drifted from {lock_path}:")
+        for line in drift:
+            print(line)
+        print(
+            "declare the protocol change with: python -m "
+            "mpit_tpu.analysis schema --update-lock"
+        )
+        return 1
+
+    if args.json:
+        print(json.dumps(doc, indent=2, sort_keys=True))
+        return 0
+    for key in sorted(doc["tags"], key=int):
+        ent = doc["tags"][key]
+        name = ent["name"] or f"tag {key}"
+        print(f"{name} ({key})")
+        print(f"  sender:   {', '.join(ent['sender']) or '(none seen)'}")
+        print(f"  receiver: {', '.join(ent['receiver']) or '(none seen)'}")
+    snap = doc["snapshot"]
+    print(
+        f"snapshot: writes {snap['writes'] or '(none)'} / "
+        f"reads {snap['reads'] or '(none)'}"
+    )
+    return 0
+
+
+def _main_fuzz(argv) -> int:
+    from mpit_tpu.transport import fuzz
+
+    parser = argparse.ArgumentParser(
+        prog="python -m mpit_tpu.analysis fuzz",
+        description="Differential codec fuzz gate: roundtrip + "
+        "framed-vs-pickle equality over the structural payload grammar, "
+        "plus frame mutations that must always land on WireDecodeError.",
+    )
+    parser.add_argument(
+        "--seed", type=int, default=0, help="PRNG seed (default: 0)"
+    )
+    parser.add_argument(
+        "--examples",
+        type=int,
+        default=10000,
+        help="generated examples (default: 10000)",
+    )
+    parser.add_argument(
+        "--corpus",
+        metavar="PATH",
+        help="also replay this regression corpus (jsonl)",
+    )
+    parser.add_argument(
+        "--regen-corpus",
+        metavar="PATH",
+        help="deterministically rebuild the regression corpus and exit",
+    )
+    parser.add_argument(
+        "--json", action="store_true", help="machine-readable output"
+    )
+    args = parser.parse_args(argv)
+
+    if args.regen_corpus:
+        n = fuzz.write_corpus(args.regen_corpus, seed=args.seed)
+        print(f"wrote {n} corpus entries to {args.regen_corpus}")
+        return 0
+
+    report = fuzz.run_fuzz(seed=args.seed, examples=args.examples)
+    if args.corpus:
+        if not Path(args.corpus).exists():
+            print(
+                f"error: no such corpus: {args.corpus}", file=sys.stderr
+            )
+            return 2
+        report.merge(fuzz.replay_corpus(args.corpus))
+    if args.json:
+        print(json.dumps(report.to_json(), indent=2))
+    else:
+        print(report.summary())
+        for line in report.failures[:10]:
+            print(f"  FAIL {line}")
+    return 1 if report.failures else 0
+
+
 def main(argv=None) -> int:
     if argv is None:
         argv = sys.argv[1:]
@@ -322,6 +536,10 @@ def main(argv=None) -> int:
         return _main_conform(argv[1:])
     if argv and argv[0] == "threads":
         return _main_threads(argv[1:])
+    if argv and argv[0] == "schema":
+        return _main_schema(argv[1:])
+    if argv and argv[0] == "fuzz":
+        return _main_fuzz(argv[1:])
     parser = argparse.ArgumentParser(
         prog="python -m mpit_tpu.analysis",
         description="Distributed-correctness linter (rules MPT001-MPT008).",

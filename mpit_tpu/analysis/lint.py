@@ -124,6 +124,9 @@ class Project:
     # lazily-built whole-program concurrency model (analysis/threads.py) —
     # the MPT013-015 rules and the `threads` CLI share one build
     _threads: object = dataclasses.field(default=None, repr=False)
+    # lazily-built wire payload-schema model (analysis/schema.py) — the
+    # MPT016-018 rules and the `schema` CLI/lockfile share one build
+    _schema: object = dataclasses.field(default=None, repr=False)
 
     @property
     def graph(self):
@@ -148,6 +151,14 @@ class Project:
 
             self._threads = threads_mod.build_model(self)
         return self._threads
+
+    @property
+    def schema(self):
+        if self._schema is None:
+            from mpit_tpu.analysis import schema as schema_mod
+
+            self._schema = schema_mod.build_schema(self)
+        return self._schema
 
 
 def _parse_ignores(source_lines: list) -> dict:

@@ -16,6 +16,7 @@ from mpit_tpu.analysis.rules import (
     locks,
     metric_names,
     model_check,
+    payload_schema,
     protocol_roles,
     tags,
     wire_format,
@@ -32,6 +33,7 @@ RULE_MODULES = (
     model_check,
     metric_names,
     concurrency,
+    payload_schema,
 )
 
 # rule id -> (title, one-line rationale); the CLI's --list-rules output and

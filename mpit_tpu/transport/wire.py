@@ -269,7 +269,9 @@ def _encode_value(value: Any, header: bytearray, body: list) -> None:
         header.append(a.ndim)
         for dim in a.shape:
             header += _U32.pack(dim)
-        body.append(a.data.cast("B"))
+        # memoryview.cast rejects zero-in-shape views; an empty array's
+        # body is empty regardless
+        body.append(a.data.cast("B") if a.size else b"")
     elif type(value) is QuantArray:
         mode = _QUANT_MODE_CODES.get(value.mode)
         data = value.data
@@ -287,7 +289,7 @@ def _encode_value(value: Any, header: bytearray, body: list) -> None:
         header.append(a.ndim)
         for dim in a.shape:
             header += _U32.pack(dim)
-        body.append(a.data.cast("B"))
+        body.append(a.data.cast("B") if a.size else b"")
     else:
         # numpy scalars, dataclasses (CorruptedPayload), arbitrary
         # objects: not this codec's business — the caller pickles them

@@ -2,6 +2,7 @@
 # Distributed-correctness lint gate.
 #
 #   scripts/lint.sh                 # fail on findings not in the baseline
+#   scripts/lint.sh --strict        # CI mode: bench trend drift blocks too
 #   scripts/lint.sh --update        # accept the current findings as baseline
 #   scripts/lint.sh --fix           # rewrite fixable MPT002 sites, then gate
 #   scripts/lint.sh path/to/file.py # lint specific paths (vs the baseline)
@@ -10,7 +11,7 @@
 #                                   # so one rule iterates without the full
 #                                   # pass (--only also works standalone)
 #
-# The default run is eight gates behind the one baseline:
+# The default run is nine gates behind the one baseline:
 #   1. the static lint (MPT001-008, MPT012) + protocol model check
 #      (MPT009-011);
 #   2. an explicit `mcheck` pass, so the exhaustive state counts land in
@@ -35,10 +36,20 @@
 #      trip exactly its rule through the real CLI (the lockset walk
 #      can't silently lose thread-root discovery), and the RT103
 #      vector-clock sanitizer must catch a seeded unsynchronized write
-#      pair while staying silent on the lock-ordered twin.
+#      pair while staying silent on the lock-ordered twin;
+#   9. the wire-schema gate: the inferred per-tag payload schemas must
+#      match the checked-in wire-schema.lock.json (protocol changes are
+#      declared with `schema --update-lock`, never discovered in prod);
+#      each seeded MPT016/017/018 fixture must trip exactly its rule
+#      through the real CLI; and the differential codec fuzz gate runs
+#      10k seeded examples (roundtrip + framed-vs-pickle differential +
+#      mutation corpus: every corrupted frame lands on WireDecodeError
+#      or the original value — never a wrong value or a crash) plus a
+#      replay of the checked-in corpus under tests/fixtures/wire_corpus.
 # Every gate prints its wall-clock ([lint] gate N ... Xs); the whole
-# default run is bounded to < 15 s (tests/test_lint_gate.py enforces
-# it, and separately pins the in-process whole-package scan to < 5 s).
+# default run is bounded to < 30 s with the wire-schema gate itself
+# under 20 s (tests/test_lint_gate.py enforces both, and separately
+# pins the in-process whole-package scan to < 5 s).
 #
 # Exit codes: 0 clean vs baseline, 1 new findings, 2 usage error.
 # The linter parses, never imports, the scanned code and initializes no
@@ -46,6 +57,12 @@
 set -euo pipefail
 
 cd "$(dirname "$0")/.."
+
+STRICT=0
+if [[ "${1:-}" == "--strict" ]]; then
+    STRICT=1
+    shift
+fi
 
 if [[ "${1:-}" == "--update" ]]; then
     shift
@@ -188,8 +205,36 @@ assert not [f for f in ck2.findings if f.rule == "RT103"], \
 print("concurrency gate: 3 fixtures trip their rules, RT103 smoke ok")
 EOF
     gate_done concurrency
-    # warn-only: bench trajectory drift should be SEEN at lint time, but
-    # bench noise must never block a commit (--strict exists for CI)
-    python scripts/bench_gate.py --trend || true
+    # gate 9: the wire-schema contract. (a) The inferred per-tag payload
+    # schemas must match the checked-in lockfile — a protocol change
+    # ships only together with its declared schema bump.
+    python -m mpit_tpu.analysis schema --check
+    # (b) each seeded schema fixture must trip exactly its rule through
+    # the REAL CLI (same contract as gate 8: expected exit-1 asserted)
+    for rule in MPT016 MPT017 MPT018; do
+        low=$(echo "$rule" | tr '[:upper:]' '[:lower:]')
+        fixture="tests/fixtures/analysis/fixture_${low}"
+        [[ -d "$fixture" ]] || fixture="${fixture}.py"
+        if python -m mpit_tpu.analysis --no-baseline --only "$rule" \
+                "$fixture" > /dev/null; then
+            echo "wire-schema gate: fixture_${low} no longer trips ${rule}" >&2
+            exit 1
+        fi
+    done
+    # (c) the differential codec fuzz gate: 10k seeded examples of
+    # roundtrip + framed-vs-pickle equality + mutation outcomes, plus a
+    # replay of the checked-in regression corpus — every corrupted frame
+    # must land on WireDecodeError or the original value, never a wrong
+    # value, a crash, or a hang
+    python -m mpit_tpu.analysis fuzz --examples 10000 \
+        --corpus tests/fixtures/wire_corpus/corpus.jsonl
+    gate_done wire-schema
+    # bench trajectory drift should be SEEN at lint time; it blocks only
+    # under --strict (CI), because bench noise must never block a commit
+    if [[ "$STRICT" == "1" ]]; then
+        python scripts/bench_gate.py --strict --trend
+    else
+        python scripts/bench_gate.py --trend || true
+    fi
     gate_done bench-trend
 fi

@@ -1,0 +1,9 @@
+"""Seeded MPT016 package: a sender/receiver payload-arity divergence.
+
+A miniature streaming pair: the client pushes chunk envelopes, the
+server destructures them. The only defect is the envelope arity — the
+client packs ``(seq, chunk)`` where the server unpacks
+``epoch, seq, chunk``: every message mis-unpacks at dispatch. The
+schema rule must flag the send site (MPT016) and nothing else. Parsed
+by the linter tests, never imported.
+"""

@@ -1,0 +1,12 @@
+"""Client role: streams epoch-stamped chunks to the server."""
+
+from fixture_mpt016.tags import TAG_DATA
+
+# mpit-analysis: protocol-role[client->server]
+
+
+def push_chunks(transport, epoch, chunks):
+    for seq, chunk in enumerate(chunks):
+        # BUG: drops the epoch stamp — a 2-tuple where the server
+        # unpacks three fields
+        transport.send(0, TAG_DATA, (seq, chunk))

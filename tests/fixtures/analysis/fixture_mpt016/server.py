@@ -1,0 +1,13 @@
+"""Server role: dispatch loop unpacking the three-field data envelope."""
+
+from fixture_mpt016.tags import TAG_DATA
+
+# mpit-analysis: protocol-role[server->client]
+
+
+def serve(transport, sink):
+    while True:
+        msg = transport.recv(-1, -1)
+        if msg.tag == TAG_DATA:
+            epoch, seq, chunk = msg.payload
+            sink.append((epoch, seq, chunk))

@@ -1,0 +1,3 @@
+"""Tag registry for the seeded arity-divergence protocol."""
+
+TAG_DATA = 26

@@ -1,0 +1,14 @@
+"""Seeded MPT017: a telemetry send whose payload is a dict literal.
+
+Dicts are not in the structural wire grammar, so the whole message
+falls off ``encode_frame`` onto the per-message pickle fallback —
+silently, and on every step. The schema rule must flag the send site
+(MPT017) and nothing else. Parsed by the linter tests, never imported.
+"""
+
+TAG_EVENT = 31
+
+
+def report(transport, step, loss):
+    # BUG: dict payload — unencodable by the structural wire codec
+    transport.send(0, TAG_EVENT, {"step": step, "loss": loss})

@@ -1,0 +1,20 @@
+"""Seeded MPT018: restore reads a snapshot field no writer packs.
+
+The save path stopped writing ``gen``; the restore path still reads it
+with a default — every recovery silently restarts generation counting
+from zero. The schema rule must flag the orphaned read (MPT018) and
+nothing else. Parsed by the linter tests, never imported.
+"""
+
+
+def save(state_io, path, center, version):
+    state_io.save_shard_state(path, {"center": center, "version": version})
+
+
+def restore(state_io, path):
+    state = state_io.load_shard_state(path)
+    center = state["center"]
+    version = state["version"]
+    # BUG: no save_shard_state writer packs 'gen' any more
+    gen = state.get("gen", 0)
+    return center, version, gen

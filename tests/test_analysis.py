@@ -109,6 +109,12 @@ def test_baseline_is_not_stale():
         ("fixture_mpt013", "MPT013"),
         ("fixture_mpt014", "MPT014"),
         ("fixture_mpt015", "MPT015"),
+        # wire-schema rules: the payload-schema model over a role pair
+        # (MPT016), a single pickle-fallback send (MPT017), and a
+        # snapshot save/restore key diff (MPT018)
+        ("fixture_mpt016", "MPT016"),
+        ("fixture_mpt017.py", "MPT017"),
+        ("fixture_mpt018.py", "MPT018"),
     ],
 )
 def test_fixture_triggers_exactly_its_rule(fixture, rule):

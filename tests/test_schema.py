@@ -1,0 +1,192 @@
+"""Wire-schema inference (mpit_tpu.analysis.schema) and the lockfile gate.
+
+Four layers:
+
+- the MODEL: per-tag sender/receiver schemas inferred over the real
+  package — every canonical TAG_* must come out with BOTH halves
+  populated, and the envelope tags must carry their known shapes;
+- the RULES going QUIET: each seeded MPT016/017/018 fixture, with its
+  one bug fixed, lints clean (tests/test_analysis.py pins the firing
+  direction; this file pins the silence direction);
+- the CLI: ``schema --json`` emits the full 8-tag table, ``--check``
+  is clean against the checked-in wire-schema.lock.json and exits 1
+  the moment the lock is mutated out from under it (the undeclared-
+  protocol-drift gate, pinned by mutate-and-rescan);
+- the LOCKFILE itself: committed, current, and regenerated verbatim by
+  ``--update-lock``.
+"""
+
+from __future__ import annotations
+
+import json
+import shutil
+import subprocess
+import sys
+from pathlib import Path
+
+import pytest
+
+from mpit_tpu.analysis import lint
+from mpit_tpu.analysis import schema as schema_mod
+
+REPO = Path(__file__).resolve().parent.parent
+PKG = REPO / "mpit_tpu"
+LOCK = REPO / "wire-schema.lock.json"
+FIXTURES = Path(__file__).resolve().parent / "fixtures" / "analysis"
+
+
+def _project(paths):
+    modules = []
+    for ap, rel in lint.collect_files(paths):
+        ctx = lint.load_module(ap, rel)
+        if ctx is not None:
+            modules.append(ctx)
+    return lint.Project(modules=modules, config=lint.Config())
+
+
+@pytest.fixture(scope="module")
+def package_schema():
+    return _project([PKG]).schema
+
+
+# ------------------------------------------------------------------ model
+
+
+def test_all_eight_tags_have_both_halves(package_schema):
+    doc = package_schema.to_json()
+    assert sorted(doc["tags"], key=int) == [str(t) for t in range(1, 9)]
+    for tag, entry in doc["tags"].items():
+        assert entry["sender"], f"tag {tag} has no sender schema"
+        assert entry["receiver"], f"tag {tag} has no receiver schema"
+
+
+def test_push_envelope_shape(package_schema):
+    doc = package_schema.to_json()
+    by_name = {e["name"]: e for e in doc["tags"].values()}
+    # the EASGD/delta push envelope: (round, seq, epoch, chunk) where
+    # the chunk is a raw array or its quantized form
+    for name in ("TAG_PUSH_EASGD", "TAG_PUSH_DELTA"):
+        assert by_name[name]["sender"] == [
+            "(int, int, int, ndarray|quant)"
+        ], by_name[name]
+    # control tags carry None and the receiver ignores the payload
+    for name in ("TAG_STOP", "TAG_HEARTBEAT", "TAG_LEAVE"):
+        assert by_name[name]["sender"] == ["none"], by_name[name]
+        assert by_name[name]["receiver"] == ["ignored"], by_name[name]
+    assert by_name["TAG_JOIN"]["sender"] == ["(int, int)"]
+
+
+def test_snapshot_schema_is_closed(package_schema):
+    doc = package_schema.to_json()
+    assert doc["snapshot"]["writes"] == doc["snapshot"]["reads"]
+    assert "center" in doc["snapshot"]["writes"]
+
+
+def test_model_json_is_serializable(package_schema):
+    json.dumps(package_schema.to_json())
+
+
+# ------------------------------------------------- rules go quiet when fixed
+
+_FIXES = {
+    "fixture_mpt016": (
+        "client.py",
+        "        # BUG: drops the epoch stamp — a 2-tuple where the server\n"
+        "        # unpacks three fields\n"
+        "        transport.send(0, TAG_DATA, (seq, chunk))\n",
+        "        transport.send(0, TAG_DATA, (epoch, seq, chunk))\n",
+    ),
+    "fixture_mpt017.py": (
+        None,
+        "    # BUG: dict payload — unencodable by the structural wire codec\n"
+        '    transport.send(0, TAG_EVENT, {"step": step, "loss": loss})\n',
+        "    transport.send(0, TAG_EVENT, (step, loss))\n",
+    ),
+    "fixture_mpt018.py": (
+        None,
+        "    # BUG: no save_shard_state writer packs 'gen' any more\n"
+        '    gen = state.get("gen", 0)\n'
+        "    return center, version, gen\n",
+        "    return center, version\n",
+    ),
+}
+
+
+@pytest.mark.parametrize("fixture", sorted(_FIXES))
+def test_fixture_goes_quiet_when_fixed(fixture, tmp_path):
+    """The other half of the fires-exactly-once contract: applying the
+    obvious fix silences the rule (no residual finding survives)."""
+    target, bug, fix = _FIXES[fixture]
+    if target is None:
+        dst = tmp_path / fixture
+        shutil.copy(FIXTURES / fixture, dst)
+        f = dst
+    else:
+        dst = tmp_path / fixture
+        shutil.copytree(FIXTURES / fixture, dst)
+        f = dst / target
+    src = f.read_text()
+    assert bug in src, "fixture drifted from the test's patch"
+    f.write_text(src.replace(bug, fix))
+    findings = lint.run_lint([dst], lint.Config(hot_all=True))
+    assert findings == [], [x.format() for x in findings]
+
+
+# -------------------------------------------------------------------- CLI
+
+
+def _cli(*args, **kw):
+    return subprocess.run(
+        [sys.executable, "-m", "mpit_tpu.analysis", *args],
+        capture_output=True,
+        text=True,
+        cwd=REPO,
+        **kw,
+    )
+
+
+def test_cli_schema_json_emits_all_tags():
+    r = _cli("schema", "--json")
+    assert r.returncode == 0, r.stderr
+    doc = json.loads(r.stdout)
+    assert doc["version"] == schema_mod.SCHEMA_LOCK_VERSION
+    assert sorted(doc["tags"], key=int) == [str(t) for t in range(1, 9)]
+    for entry in doc["tags"].values():
+        assert entry["sender"] and entry["receiver"]
+
+
+def test_cli_schema_check_clean_against_committed_lock():
+    r = _cli("schema", "--check")
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert "8 tag(s) match" in r.stdout
+
+
+def test_cli_schema_check_fails_on_undeclared_drift(tmp_path):
+    """Mutate-and-rescan: an edited lock (i.e. the inferred schema
+    moving away from the committed one) must exit 1 and name the tag."""
+    mutated = json.loads(LOCK.read_text())
+    mutated["tags"]["2"]["sender"] = ["(int, ndarray)"]
+    alt = tmp_path / "wire-schema.lock.json"
+    alt.write_text(json.dumps(mutated, indent=2, sort_keys=True))
+    r = _cli("schema", "--check", "--lock", str(alt))
+    assert r.returncode == 1, r.stdout + r.stderr
+    assert "TAG_PUSH_EASGD" in r.stdout
+    assert "--update-lock" in r.stdout  # the remediation hint
+
+
+def test_cli_schema_check_missing_lock_is_usage_error(tmp_path):
+    r = _cli("schema", "--check", "--lock", str(tmp_path / "nope.json"))
+    assert r.returncode == 2
+
+
+# --------------------------------------------------------------- lockfile
+
+
+def test_lockfile_is_committed_and_current(tmp_path):
+    """--update-lock regenerates the committed file verbatim: the lock
+    can never silently lag the code it describes."""
+    assert LOCK.exists(), "wire-schema.lock.json must be checked in"
+    regen = tmp_path / "regen.json"
+    r = _cli("schema", "--update-lock", "--lock", str(regen))
+    assert r.returncode == 0, r.stderr
+    assert regen.read_text() == LOCK.read_text()

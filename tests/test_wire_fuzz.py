@@ -1,0 +1,194 @@
+"""Differential codec fuzz harness (mpit_tpu.transport.fuzz).
+
+The gate itself is stdlib-random (seeded, replayable — lint gate 9);
+this file pins its contracts:
+
+- determinism: the same seed produces the same report, and corpus
+  regeneration is byte-identical to the checked-in corpus;
+- the oracle: deep_equal's bit-exact semantics (NaN, signed zero, f32
+  quant scales, dtype-sensitive arrays) — a sloppier oracle would wave
+  wrong decodes through;
+- the mutation contract: structured corruptions land on WireDecodeError
+  or the original value, and a frame that decodes to a DIFFERENT value
+  is classified "wrong" (the failure class the gate exists to catch);
+- the corpus: the checked-in file replays clean, end to end.
+
+An optional hypothesis layer re-states the roundtrip/differential
+properties generatively where hypothesis is installed (it is not a
+dependency of the gate).
+"""
+
+from __future__ import annotations
+
+import random
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+from mpit_tpu.quant import quantize
+from mpit_tpu.transport import fuzz, wire
+
+CORPUS = (
+    Path(__file__).resolve().parent / "fixtures" / "wire_corpus"
+    / "corpus.jsonl"
+)
+
+
+# -------------------------------------------------------------- determinism
+
+
+def test_run_fuzz_is_deterministic():
+    a = fuzz.run_fuzz(seed=7, examples=200)
+    b = fuzz.run_fuzz(seed=7, examples=200)
+    assert a.to_json() == b.to_json()
+    assert not a.failures, a.failures[:3]
+    assert a.roundtrip_ok == a.differential_ok == 200
+
+
+def test_generator_is_seed_sensitive():
+    a = fuzz.run_fuzz(seed=1, examples=50)
+    b = fuzz.run_fuzz(seed=2, examples=50)
+    assert a.to_json() != b.to_json()
+
+
+def test_corpus_regenerates_byte_identical(tmp_path):
+    """The corpus is a FUNCTION of the codec + seed: regeneration must
+    reproduce the checked-in bytes exactly, or the codec changed and
+    the corpus (and lockfile thinking) must be refreshed consciously."""
+    out = tmp_path / "corpus.jsonl"
+    n = fuzz.write_corpus(out, seed=0)
+    assert n == len(CORPUS.read_text().splitlines())
+    assert out.read_bytes() == CORPUS.read_bytes()
+
+
+def test_checked_in_corpus_replays_clean():
+    report = fuzz.replay_corpus(CORPUS)
+    assert not report.failures, report.failures[:5]
+    assert report.corpus_clean >= 40
+    assert report.corpus_mutations >= 9 * report.corpus_clean
+
+
+# ------------------------------------------------------------------ oracle
+
+
+def test_deep_equal_bit_exact_floats():
+    nan = float("nan")
+    assert fuzz.deep_equal(nan, nan)
+    assert fuzz.deep_equal((1, nan), (1, nan))
+    assert not fuzz.deep_equal(0.0, -0.0)  # distinct IEEE bit patterns
+    assert not fuzz.deep_equal(1, 1.0)  # type-sensitive
+    assert not fuzz.deep_equal(True, 1)  # bool is not int on the wire
+    assert not fuzz.deep_equal((1,), [1])
+
+
+def test_deep_equal_arrays_and_quant():
+    a = np.arange(4, dtype=np.int32)
+    assert fuzz.deep_equal(a, a.copy())
+    assert not fuzz.deep_equal(a, a.astype(np.int64))  # dtype-sensitive
+    assert not fuzz.deep_equal(a, a.reshape(2, 2))  # shape-sensitive
+    q = quantize(np.arange(8, dtype=np.float32), "int8")
+    r = quantize(np.arange(8, dtype=np.float32), "int8")
+    assert fuzz.deep_equal(q, r)
+    assert not fuzz.deep_equal(
+        q, quantize(np.arange(8, dtype=np.float32), "bf16")
+    )
+
+
+def test_empty_multidim_array_roundtrips():
+    """Regression: zero-in-shape arrays crashed encode_frame
+    (memoryview.cast rejects views with zeros in shape)."""
+    for shape in ((0,), (2, 0), (2, 0, 3)):
+        payload = np.zeros(shape, dtype=np.float32)
+        data = fuzz.frame_bytes(3, 4, payload)
+        assert data is not None
+        _, _, out = fuzz.decode_bytes(data)
+        assert fuzz.deep_equal(out, payload)
+
+
+# --------------------------------------------------------------- mutations
+
+
+def _frame():
+    payload = (7, 3, 1, np.arange(5, dtype=np.float32))
+    return fuzz.frame_bytes(2, 2, payload), 2, 2, payload
+
+
+@pytest.mark.parametrize("name,op", fuzz.MUTATIONS)
+def test_every_mutation_op_is_error_or_benign(name, op):
+    data, src, tag, payload = _frame()
+    rng = random.Random(0)
+    for _ in range(50):
+        outcome, detail = fuzz.classify_mutation(
+            op(data, rng), src, tag, payload
+        )
+        assert outcome in ("error", "ok"), (name, outcome, detail)
+
+
+def test_crc_corruption_always_errors():
+    data, src, tag, payload = _frame()
+    rng = random.Random(0)
+    for _ in range(20):
+        outcome, _ = fuzz.classify_mutation(
+            fuzz._mut_crc_xor(data, rng), src, tag, payload
+        )
+        assert outcome == "error"
+
+
+def test_future_version_always_errors():
+    data, src, tag, payload = _frame()
+    rng = random.Random(0)
+    for _ in range(20):
+        outcome, _ = fuzz.classify_mutation(
+            fuzz._mut_version_bump(data, rng), src, tag, payload
+        )
+        assert outcome == "error"
+
+
+def test_wrong_value_is_classified_wrong():
+    """The failure class the gate exists to catch: a frame that decodes
+    CLEANLY to a different value must come back 'wrong', not 'ok'."""
+    other = fuzz.frame_bytes(
+        2, 2, (7, 3, 2, np.arange(5, dtype=np.float32))
+    )
+    _, src, tag, payload = _frame()
+    outcome, detail = fuzz.classify_mutation(other, src, tag, payload)
+    assert outcome == "wrong", (outcome, detail)
+
+
+def test_short_and_empty_frames_error():
+    for blob in (b"", b"M", b"MW\x01\x00"):
+        with pytest.raises(wire.WireDecodeError):
+            fuzz.decode_bytes(blob)
+
+
+# ------------------------------------------- optional hypothesis property
+
+
+try:  # hypothesis is optional — the stdlib tests above always run
+    import hypothesis
+    from hypothesis import strategies as st
+except ImportError:  # pragma: no cover
+    hypothesis = None
+
+if hypothesis is not None:
+
+    @hypothesis.given(st.integers())
+    @hypothesis.settings(deadline=None, max_examples=50)
+    def test_property_roundtrip_any_int(n):
+        data = fuzz.frame_bytes(0, 1, n)
+        assert data is not None
+        _, _, out = fuzz.decode_bytes(data)
+        assert out == n and type(out) is int
+
+    @hypothesis.given(st.text())
+    @hypothesis.settings(deadline=None, max_examples=50)
+    def test_property_roundtrip_text(s):
+        try:
+            s.encode("utf-8")
+        except UnicodeEncodeError:
+            hypothesis.assume(False)  # lone surrogates: not encodable
+        data = fuzz.frame_bytes(0, 1, s)
+        assert data is not None
+        _, _, out = fuzz.decode_bytes(data)
+        assert out == s
