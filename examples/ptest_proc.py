@@ -80,7 +80,15 @@ def main():
     # socket in the fault injector — same contract as thread mode, but
     # each process has its own FaultLog (faults are recorded sender-side,
     # so the per-rank union is the whole schedule)
-    base = SocketTransport(rank, world)
+    # MPIT_CONNECT_RETRY_S: how long a refused outbound connection is
+    # retried. The 30s default absorbs startup skew, but it also hides a
+    # dead peer — the sharded soak leg shrinks it so a killed server is
+    # *seen* to be dead (and its shards rerouted) instead of every send
+    # quietly waiting out the window
+    base = SocketTransport(
+        rank, world,
+        connect_retry_s=float(os.environ.get("MPIT_CONNECT_RETRY_S", "30")),
+    )
     chaos_cfg = chaos_config_from_env()
     fault_log = None
     if chaos_cfg is not None:
@@ -116,6 +124,17 @@ def main():
     client_ranks = list(range(num_servers, world))
     bounds = partition_bounds(flat0.size, num_servers)
 
+    # sharded ownership opt-in (docs/ROBUSTNESS.md "Shard ownership &
+    # resharding"): MPIT_PS_SHARDS=N splits the flat vector into N ring-
+    # placed shards so clients reassign a killed server's shards to the
+    # survivors (live resharding) instead of skipping its range forever
+    ps_shards = int(os.environ.get("MPIT_PS_SHARDS", "0"))
+    shard_map = None
+    if ps_shards > 0:
+        from mpit_tpu.comm.topology import HashRing, ShardMap
+
+        shard_map = ShardMap(HashRing(server_ranks), flat0.size, ps_shards)
+
     # elastic mode (docs/ROBUSTNESS.md): set by the supervising launcher
     # (MPIT_ELASTIC_RESPAWN=1) — clients announce themselves with JOIN so
     # a respawned replacement registers a fresh dedup epoch, servers
@@ -132,8 +151,15 @@ def main():
 
     if rank < num_servers:
         start, end = bounds[rank]
+        if shard_map is not None:
+            pieces = [flat0[s:e] for _, s, e in shard_map.ranges_for(rank)]
+            center0 = (
+                np.concatenate(pieces) if pieces else np.zeros(0, np.float32)
+            )
+        else:
+            center0 = flat0[start:end]
         server = PServer(
-            tp, flat0[start:end],
+            tp, center0,
             num_clients=num_clients, alpha=alpha,
             client_ranks=client_ranks,
             client_timeout=client_timeout,
@@ -142,6 +168,7 @@ def main():
                 if ckpt_dir else None
             ),
             ckpt_every=int(os.environ.get("MPIT_ELASTIC_CKPT_EVERY", "5")),
+            shard_map=shard_map,
         )
         server.start()  # blocks until every client stopped (or died)
         print(
@@ -155,8 +182,16 @@ def main():
             tp, server_ranks, flat0.size, heartbeat_interval=hb,
             # elastic: a killed server respawns within seconds — waiting
             # the default 60s per attempt would stall its clients past
-            # the soak budget; short attempts + skipped rounds instead
-            timeout=15.0 if elastic else 60.0,
+            # the soak budget; short attempts + skipped rounds instead.
+            # The sharded soak leg overrides both knobs so a killed
+            # server is declared dead (and its shards rerouted) within
+            # seconds, not after the full retry ladder
+            timeout=float(
+                os.environ.get("MPIT_PS_TIMEOUT")
+                or (15.0 if elastic else 60.0)
+            ),
+            max_retries=int(os.environ.get("MPIT_PS_MAX_RETRIES", "3")),
+            shard_map=shard_map,
         )
         xs = shard_for_worker(x_tr, c, num_clients)
         ys = shard_for_worker(y_tr, c, num_clients)

@@ -92,6 +92,12 @@ class ModelSemantics:
     #: there is no snapshot machinery at all (None — restart schedules
     #: still run, modeling restart-from-nothing)
     snapshot_includes_dedup: Optional[bool] = None
+    #: a shard HANDOFF ships the dedup entries along with the shard data
+    #: (True), ships the data but forgets the window (False — the
+    #: exactly-once-across-handoff bug the sharded config exists to
+    #: catch), or the protocol has no handoff machinery at all (None —
+    #: the sharded configuration is skipped)
+    handoff_carries_dedup: Optional[bool] = None
 
 
 def from_protocol(sem) -> ModelSemantics:
@@ -114,6 +120,7 @@ def from_protocol(sem) -> ModelSemantics:
         dedup_opaque=sem.dedup_opaque,
         dedup_keyed_by_epoch=keyed,
         snapshot_includes_dedup=sem.snapshot_includes_dedup,
+        handoff_carries_dedup=getattr(sem, "handoff_includes_dedup", None),
     )
 
 
@@ -137,6 +144,18 @@ class ModelConfig:
     #: epoch), servers may snapshot and CRASH-RESTORE — a second,
     #: independent single-fault budget on top of the network one
     elastic: bool = False
+    #: sharded-ownership mode (implies the elastic crash machinery):
+    #: parameters live in ``shards`` ring-placed shards whose ownership
+    #: can move between servers mid-run via a HANDOFF transition (its
+    #: own one-shot budget, independent of both fault budgets); pushes
+    #: are routed to the shard's CURRENT owner at delivery — the model's
+    #: version of the client-side reshard repair
+    sharded: bool = False
+    shards: int = 2
+    #: spend the network-fault budget on PUSH messages only (REQ/REP
+    #: fault coverage is the base configs' jurisdiction) — the sharded
+    #: config uses this to keep handoff x crash x fault exhaustive
+    fault_push_only: bool = False
 
     @property
     def label(self) -> str:
@@ -146,15 +165,29 @@ class ModelConfig:
         )
 
 
-def default_configs(has_push: bool) -> tuple:
+def default_configs(has_push: bool, quick: bool = False) -> tuple:
     """The two shipped-protocol configurations: EASGD (fetch -> push)
     and Downpour (push -> fetch). A push-less protocol gets a single
-    fetch-only config (the scripts would coincide)."""
+    fetch-only config (the scripts would coincide).
+
+    ``quick=True`` drops to 1 client (~300-400 states each vs ~12-20k):
+    the single-fault hazards these configs witness — the dedup boundary
+    re-admit, the stale reply, the block-forever recv — are all
+    per-client-per-server, so one client keeps every seeded-mutation
+    witness (verified per fixture in tests/test_analysis.py) while the
+    pre-commit scan stays cheap; test_mcheck.py runs the 2-client
+    acceptance pair."""
+    clients = 1 if quick else 2
     if not has_push:
-        return (ModelConfig(algo="fetch-only", script=("fetch",)),)
+        return (
+            ModelConfig(algo="fetch-only", script=("fetch",),
+                        clients=clients),
+        )
     return (
-        ModelConfig(algo="easgd", script=("fetch", "push")),
-        ModelConfig(algo="downpour", script=("push", "fetch")),
+        ModelConfig(algo="easgd", script=("fetch", "push"),
+                    clients=clients),
+        ModelConfig(algo="downpour", script=("push", "fetch"),
+                    clients=clients),
     )
 
 
@@ -172,6 +205,35 @@ def elastic_config() -> ModelConfig:
         servers=1,
         rounds=2,
         elastic=True,
+    )
+
+
+def sharded_config(quick: bool = False) -> ModelConfig:
+    """The shard-ownership configuration: 2 clients x 2 servers, 2 ring
+    shards (initially one per server), with a one-shot HANDOFF budget on
+    top of the network-fault and crash-restore budgets. Two servers are
+    the minimum with somewhere for a shard to move; two clients make the
+    handed-off dedup state multi-sourced. Client REPLACE is disabled
+    here (the elastic config already owns that hazard) to keep the
+    handoff x crash x fault product exhaustive within budget.
+
+    ``quick=True`` is the lint-tier variant (1 client, ~1k states vs
+    ~100k): every handoff hazard that is per-client-per-server — the
+    dedup window forgotten in transit, the replayed push after the
+    move — still has a witness, so the pre-commit scan stays inside its
+    wall-clock budget while test_mcheck.py owns the full 2-client
+    exhaustive acceptance run."""
+    return ModelConfig(
+        algo="easgd-sharded",
+        script=("fetch", "push"),
+        clients=1 if quick else 2,
+        servers=2,
+        rounds=1,
+        kinds=("drop", "dup"),
+        elastic=True,
+        sharded=True,
+        shards=2,
+        fault_push_only=True,
     )
 
 
@@ -729,6 +791,305 @@ def _successors_elastic(state, sem, cfg, viol, points) -> list:
     return out
 
 
+# sharded mode (cfg.sharded) reshapes the elastic state:
+# state  = (clients, servers, net, fault_avail, crash_avail,
+#           handoff_avail, owners)
+#          owners[h] = server index currently owning shard h; HANDOFF
+#          moves one shard to another server (own one-shot budget)
+# server = (stops, applied, dedup) — applied keyed (c, inc, shard,
+#          seq); dedup is a sorted tuple-map of ((c, inc, shard) ->
+#          (high, seen)) windows, created lazily — per-shard windows
+#          travel with the shard on handoff (or are forgotten, the
+#          seeded handoff_carries_dedup=False bug). CRASH restores from
+#          NOTHING: snapshot-at-any-point timing multiplies the state
+#          space ~8x and its consistency hazard is already exhausted by
+#          elastic_config, so this config keeps only the restart — the
+#          shard data (and thus `applied`) rolls back with the center,
+#          which is exactly the real restore's semantics for shards the
+#          snapshot predates
+# PUSH   = (K_PUSH, c, dst, seq, (inc, shard), flags) — one per shard,
+#          addressed to the owner AT SEND time but applied by the owner
+#          AT DELIVERY time (the client-side reshard repair re-routes
+#          in-flight traffic; dst only keys the FIFO stream)
+# client REPLACE is disabled here (elastic_config owns that hazard)
+
+
+def _dmap_get(dmap, key):
+    for k, v in dmap:
+        if k == key:
+            return v
+    return (0, frozenset())
+
+
+def _dmap_set(dmap, key, val) -> tuple:
+    out = [kv for kv in dmap if kv[0] != key]
+    out.append((key, val))
+    out.sort(key=lambda kv: kv[0])
+    return tuple(out)
+
+
+def _apply_push_sharded(servers, s, c, seq, inc, h, sem, cfg, viol):
+    """Sharded push application at shard ``h``'s current owner ``s``:
+    the admit window is selected per (client, incarnation, shard) — the
+    model twin of the implementation's one-admit-per-envelope dedup
+    surviving shard collapse — and the exactly-once assertion keys the
+    applied set the same way."""
+    stops, applied, dedup = servers[s]
+    keyed = sem.dedup_keyed_by_epoch
+    widx = inc if keyed else 0
+    akey = (c, inc, h, seq)
+    ds = dedup
+    if sem.dedup is not None:
+        high, seen = _dmap_get(dedup, (c, widx, h))
+        bound = high - cfg.window
+        if sem.dedup.rejects_at_boundary:
+            reject = seq <= bound
+        else:
+            reject = seq < bound
+        if not reject and sem.dedup.checks_seen and seq in seen:
+            reject = True
+        admitted = not reject
+        if admitted:
+            seen2 = seen | {seq}
+            if seq > high:
+                if sem.dedup.prunes_seen and len(seen2) > cfg.window:
+                    floor = seq - cfg.window
+                    seen2 = frozenset(x for x in seen2 if x > floor)
+                ds = _dmap_set(dedup, (c, widx, h), (seq, frozenset(seen2)))
+            else:
+                ds = _dmap_set(dedup, (c, widx, h), (high, frozenset(seen2)))
+    elif sem.dedup_opaque:
+        admitted = akey not in applied
+    else:
+        admitted = True
+    if admitted:
+        if akey in applied:
+            viol.setdefault(
+                "MPT009",
+                f"[{cfg.label}] push (client {c}, shard {h}, seq {seq}) "
+                "applied TWICE: a redelivered copy passed the dedup admit "
+                "at the shard's new owner because the handoff shipped the "
+                "shard data without its dedup window",
+            )
+        applied = applied | {akey}
+    elif (
+        sem.dedup is not None
+        and not keyed
+        and akey not in applied
+        and any(
+            t[0] == c and t[2] == h and t[3] == seq and t[1] != inc
+            for t in applied
+        )
+    ):
+        viol.setdefault(
+            "MPT009",
+            f"[{cfg.label}] push (client {c}, incarnation {inc}, shard "
+            f"{h}, seq {seq}) wrongfully REJECTED: the dedup window is "
+            "not keyed by client epoch, so the replacement's push was "
+            "mistaken for its predecessor's replay and dropped",
+        )
+    return _set(servers, s, (stops, applied, ds))
+
+
+def _successors_sharded(state, sem, cfg, viol, points) -> list:
+    """Sharded-mode successor relation: the elastic protocol moves (with
+    delivery-time push re-routing to the shard's current owner) plus the
+    HANDOFF transition — one shard's ownership moves to another server,
+    carrying its applied entries (the shard data embodies them) and,
+    per the extracted ``handoff_carries_dedup``, its dedup windows."""
+    clients, servers, net, avail, eavail, havail, owners = state
+    out = []
+    deliv = _deliverable(net)
+    steps = len(cfg.script)
+    n_stages = cfg.rounds * steps
+    all_clients = frozenset(range(cfg.clients))
+
+    def _send_variants(msgs, av):
+        if cfg.fault_push_only and not any(m[0] == K_PUSH for m in msgs):
+            return [(tuple(msgs), av)]
+        return _variants(msgs, av, cfg.kinds, points)
+
+    # -- server deliveries (handle + reply are one atomic step)
+    for i in deliv:
+        m = net[i]
+        kind = m[0]
+        if kind == K_REP:
+            continue
+        rest = net[:i] + net[i + 1:]
+        if kind == K_PUSH:
+            inc, h = m[4]
+            tgt = owners[h]  # re-routed to the CURRENT owner
+            if servers[tgt][0] == all_clients:
+                continue  # owner exited its loop; late pushes park
+            srv2 = _apply_push_sharded(
+                servers, tgt, m[1], m[3], inc, h, sem, cfg, viol
+            )
+            out.append(
+                (clients, srv2, rest, avail, eavail, havail, owners)
+            )
+            continue
+        s = m[2]
+        stops = servers[s][0]
+        if stops == all_clients:
+            continue  # server exited its loop; late messages park
+        if kind == K_REQ:
+            c, att = m[1], m[3]
+            echo = att if sem.attempt_echoed else -1
+            rep = (K_REP, s, c, att, echo, 0)
+            for added, av2 in _send_variants([rep], avail):
+                out.append(
+                    (clients, servers, rest + added, av2, eavail,
+                     havail, owners)
+                )
+        else:  # STOP
+            srv2 = _set(servers, s, (stops | {m[1]},) + servers[s][1:])
+            out.append(
+                (clients, srv2, rest, avail, eavail, havail, owners)
+            )
+
+    # -- handoff: one shard's ownership moves to another live server
+    if havail:
+        for h, owner in enumerate(owners):
+            o_stops, o_applied, o_dedup = servers[owner]
+            if o_stops == all_clients:
+                continue  # old owner already exited — nothing to hand off
+            for s2 in range(cfg.servers):
+                if s2 == owner or servers[s2][0] == all_clients:
+                    continue
+                moved = frozenset(t for t in o_applied if t[2] == h)
+                moved_d = tuple(
+                    kv for kv in o_dedup if kv[0][2] == h
+                )
+                kept_d = tuple(kv for kv in o_dedup if kv[0][2] != h)
+                d_stops, d_applied, d_dedup = servers[s2]
+                if sem.handoff_carries_dedup is False:
+                    nd = d_dedup  # the window is forgotten in transit
+                else:
+                    nd = d_dedup
+                    for k, v in moved_d:
+                        nd = _dmap_set(nd, k, v)
+                srv2 = _set(
+                    servers, owner, (o_stops, o_applied - moved, kept_d)
+                )
+                srv2 = _set(
+                    srv2, s2, (d_stops, d_applied | moved, nd)
+                )
+                out.append((
+                    clients, srv2, net, avail, eavail, False,
+                    _set(owners, h, s2),
+                ))
+
+    # -- crash-restore (restart-from-nothing; REPLACE and snapshot
+    # timing are elastic_config's jurisdiction — see the shape comment)
+    if eavail:
+        for s, sv in enumerate(servers):
+            stops = sv[0]
+            if stops == all_clients:
+                continue
+            out.append((
+                clients,
+                _set(servers, s, (stops, frozenset(), ())),
+                net, avail, False, havail, owners,
+            ))
+
+    # -- client moves
+    for c, cl in enumerate(clients):
+        stage, waiting, att, retries, pending, inc = cl
+        if stage > n_stages:
+            continue  # done
+        if waiting:
+            for i in deliv:
+                m = net[i]
+                if m[0] != K_REP or m[2] != c:
+                    continue
+                rest = net[:i] + net[i + 1:]
+                true_att, s = m[3], m[1]
+                if true_att != att:
+                    if sem.attempt_echoed and sem.attempt_checked:
+                        out.append(
+                            (clients, servers, rest, avail, eavail,
+                             havail, owners)
+                        )
+                        continue
+                    viol.setdefault(
+                        "MPT011",
+                        f"[{cfg.label}] client {c} assembled a reply "
+                        f"generated for attempt {true_att} into its live "
+                        f"attempt {att} — "
+                        + (
+                            "the echoed attempt id is never compared "
+                            "to the live one"
+                            if sem.attempt_echoed
+                            else "replies carry no attempt id, so stale "
+                            "ones are indistinguishable from fresh"
+                        ),
+                    )
+                pend2 = pending - {s}
+                if pend2:
+                    cl2 = (stage, True, att, retries, pend2, inc)
+                else:
+                    cl2 = (stage + 1, False, att, 0, frozenset(), inc)
+                out.append((
+                    _set(clients, c, cl2), servers, rest, avail, eavail,
+                    havail, owners,
+                ))
+            if sem.reply_recv_timeout and _starved(
+                net, c, att, pending, sem
+            ):
+                if retries < cfg.max_retries:
+                    att2 = att + 1
+                    reqs = [
+                        (K_REQ, c, s, att2, 0, 0) for s in sorted(pending)
+                    ]
+                    cl2 = (stage, True, att2, retries + 1, pending, inc)
+                    for added, av2 in _send_variants(reqs, avail):
+                        out.append((
+                            _set(clients, c, cl2), servers, net + added,
+                            av2, eavail, havail, owners,
+                        ))
+                else:
+                    stage2 = (stage // steps + 1) * steps
+                    cl2 = (stage2, False, att, 0, frozenset(), inc)
+                    out.append((
+                        _set(clients, c, cl2), servers, net, avail,
+                        eavail, havail, owners,
+                    ))
+            continue
+        if stage == n_stages:
+            msgs = tuple(
+                (K_STOP, c, s, 0, 0, 0) for s in range(cfg.servers)
+            )
+            cl2 = (stage + 1, False, att, 0, frozenset(), inc)
+            out.append((
+                _set(clients, c, cl2), servers, net + msgs, avail,
+                eavail, havail, owners,
+            ))
+        elif cfg.script[stage % steps] == "fetch":
+            att2 = att + 1
+            reqs = [(K_REQ, c, s, att2, 0, 0) for s in range(cfg.servers)]
+            cl2 = (
+                stage, True, att2, 0, frozenset(range(cfg.servers)), inc
+            )
+            for added, av2 in _send_variants(reqs, avail):
+                out.append((
+                    _set(clients, c, cl2), servers, net + added, av2,
+                    eavail, havail, owners,
+                ))
+        else:  # push: one message per shard, addressed by current view
+            seq = stage // steps + 1
+            msgs = [
+                (K_PUSH, c, owners[h], seq, (inc, h), 0)
+                for h in range(cfg.shards)
+            ]
+            cl2 = (stage + 1, False, att, 0, frozenset(), inc)
+            for added, av2 in _send_variants(msgs, avail):
+                out.append((
+                    _set(clients, c, cl2), servers, net + added, av2,
+                    eavail, havail, owners,
+                ))
+    return out
+
+
 def _terminal(state, cfg) -> bool:
     clients, servers = state[0], state[1]
     n_stages = cfg.rounds * len(cfg.script)
@@ -767,7 +1128,17 @@ def check(sem: ModelSemantics, cfg: Optional[ModelConfig] = None
     carries its first witness; ``states`` is the visited-set size (the
     exhaustiveness receipt the CLI prints)."""
     cfg = cfg or ModelConfig()
-    if cfg.elastic:
+    if cfg.sharded:
+        clients0 = tuple(
+            (0, False, 0, 0, frozenset(), 0) for _ in range(cfg.clients)
+        )
+        servers0 = tuple(
+            (frozenset(), frozenset(), ()) for _ in range(cfg.servers)
+        )
+        owners0 = tuple(h % cfg.servers for h in range(cfg.shards))
+        init = (clients0, servers0, (), True, True, True, owners0)
+        succ_fn = _successors_sharded
+    elif cfg.elastic:
         clients0 = tuple(
             (0, False, 0, 0, frozenset(), 0) for _ in range(cfg.clients)
         )
@@ -797,6 +1168,12 @@ def check(sem: ModelSemantics, cfg: Optional[ModelConfig] = None
     points: set = set()
     truncated = False
     while stack:
+        if viol:
+            # a witness is in hand — further exploration can only find
+            # MORE schedules for the same (first-witness) verdict, so a
+            # failing run stops here (a CLEAN run is unaffected: it
+            # explores to fixpoint, which is what `states` certifies)
+            break
         st = stack.pop()
         succ = succ_fn(st, sem, cfg, viol, points)
         if not succ:
@@ -821,17 +1198,27 @@ def check(sem: ModelSemantics, cfg: Optional[ModelConfig] = None
     )
 
 
-def check_all(sem: ModelSemantics, configs=None) -> list:
+def check_all(sem: ModelSemantics, configs=None, quick: bool = False) -> list:
     """One CheckResult per configuration (default: the acceptance pair,
     plus the elastic-membership configuration when the protocol has the
     machinery it exercises — an epoch-keyed dedup window or shard
     snapshot persistence; a bare dedup'd protocol with neither would
-    fail elastic schedules it never claims to survive)."""
+    fail elastic schedules it never claims to survive). ``quick`` swaps
+    the default and sharded configurations for their 1-client lint-tier
+    variants (see :func:`default_configs` / :func:`sharded_config`; the
+    elastic configuration is already 1-client)."""
     if configs is None:
-        configs = default_configs(sem.has_push)
+        configs = default_configs(sem.has_push, quick)
         if sem.dedup is not None and (
             sem.dedup_keyed_by_epoch
             or sem.snapshot_includes_dedup is not None
         ):
             configs = tuple(configs) + (elastic_config(),)
+        if (
+            sem.dedup is not None
+            and sem.handoff_carries_dedup is not None
+        ):
+            # the protocol has shard-handoff machinery: verify
+            # exactly-once across ownership moves too
+            configs = tuple(configs) + (sharded_config(quick),)
     return [check(sem, cfg) for cfg in configs]

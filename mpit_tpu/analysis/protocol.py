@@ -370,6 +370,12 @@ class ProtocolSemantics:
     #: and classified; None = no snapshot machinery in the scan set
     #: (the model checker then skips restart schedules entirely)
     snapshot_includes_dedup: Optional[bool] = None
+    #: does the server's shard HANDOFF (the reshard envelope that moves
+    #: a shard's ownership to another server) ship the dedup window
+    #: along with the shard data? True/False when handoff machinery was
+    #: found and classified; None = no handoff machinery in the scan
+    #: set (the model checker then skips the sharded configuration)
+    handoff_includes_dedup: Optional[bool] = None
 
     @property
     def has_fault_machinery(self) -> bool:
@@ -663,6 +669,42 @@ def _extract_snapshot_dedup(server, by_rel) -> Optional[bool]:
     return None
 
 
+def _extract_handoff_dedup(server, by_rel) -> Optional[bool]:
+    """Does the server's shard-handoff path move the dedup window along
+    with the shard data? Recognized idiom: server-role functions whose
+    name mentions ``handoff`` or ``reshard`` — the send side extracts
+    what travels, the receive side absorbs it — referencing the dedup
+    machinery (any ``dedup``-named attribute or variable). True when
+    any such function touches it, False when handoff functions exist
+    but none does (exactly-once then dies at the ownership move), None
+    when there is no handoff machinery at all."""
+    found = None
+    for rel in server.rels:
+        mod = by_rel.get(rel)
+        if mod is None:
+            continue
+        for node in mod.nodes:
+            if not isinstance(
+                node, (ast.FunctionDef, ast.AsyncFunctionDef)
+            ) or not (
+                "handoff" in node.name or "reshard" in node.name
+            ):
+                continue
+            mentions = any(
+                "dedup"
+                in (
+                    sub.attr
+                    if isinstance(sub, ast.Attribute)
+                    else sub.id if isinstance(sub, ast.Name) else ""
+                )
+                for sub in ast.walk(node)
+            )
+            if mentions:
+                return True
+            found = False
+    return found
+
+
 def extract_semantics(project) -> Optional[ProtocolSemantics]:
     """The modeled client/server pair's fault semantics, or None when the
     scan set has no recognizable request/reply protocol (no role pair, no
@@ -720,4 +762,5 @@ def extract_semantics(project) -> Optional[ProtocolSemantics]:
             [op for op in client.concrete_recvs if op.tag == reply_tag]
         ),
         snapshot_includes_dedup=_extract_snapshot_dedup(server, by_rel),
+        handoff_includes_dedup=_extract_handoff_dedup(server, by_rel),
     )

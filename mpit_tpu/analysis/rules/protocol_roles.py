@@ -66,6 +66,12 @@ def _unpaired_sends(role, cp, by_rel) -> Iterable:
     for op in role.sends:
         if op.tag in cp.handled_tags or op.tag in seen:
             continue
+        if op.tag in role.handled_tags:
+            # intra-role traffic: the SENDING role's own dispatch handles
+            # this tag (peer-to-peer exchange between instances of one
+            # role, e.g. server->server shard handoff) — the counterpart
+            # never needs a branch for it
+            continue
         seen.add(op.tag)  # one finding per divergent tag, not per site
         yield from _emit(
             by_rel,

@@ -149,6 +149,20 @@ def main(argv=None) -> int:
     max_respawns = int(os.environ.get("MPIT_ELASTIC_MAX_RESPAWNS", "3"))
     kill_every = float(os.environ.get("MPIT_ELASTIC_KILL_EVERY_S", "0") or 0)
     kill_seed = int(os.environ.get("MPIT_ELASTIC_KILL_SEED", "0"))
+    # restrict the killer's victim pool (comma-separated ranks) — the
+    # sharded-PS soak leg aims it at the server ranks so every kill
+    # exercises reshard/repair, not just client JOIN
+    _kill_ranks = os.environ.get("MPIT_ELASTIC_KILL_RANKS", "").strip()
+    kill_ranks = (
+        {int(r) for r in _kill_ranks.split(",")} if _kill_ranks else None
+    )
+    # hold a killed rank down for N seconds before respawning it — an
+    # immediate respawn (the default) reconnects before its peers even
+    # notice; the delay opens a real dead window so failure paths
+    # (reshard/repair, dead-rank declaration) actually run
+    respawn_delay = float(
+        os.environ.get("MPIT_ELASTIC_RESPAWN_DELAY_S", "0") or 0
+    )
     obs_dir = os.environ.get("MPIT_OBS_DIR")
     mem_path = (
         os.path.join(obs_dir, "membership.jsonl")
@@ -273,7 +287,11 @@ def main(argv=None) -> int:
                     alive = [
                         r for r in range(ns.n) if procs[r].poll() is None
                     ]
-                    victims = [r for r in alive if budget[r] > 0]
+                    victims = [
+                        r for r in alive
+                        if budget[r] > 0
+                        and (kill_ranks is None or r in kill_ranks)
+                    ]
                     if len(alive) <= 1 or not victims:
                         continue
                     r = rng_k.choice(victims)
@@ -292,8 +310,28 @@ def main(argv=None) -> int:
     try:
         remaining = set(range(ns.n))
         world_down = False
+        pending: dict = {}  # rank -> monotonic respawn deadline
         while remaining:
+            now = time.monotonic()
+            for r in sorted(pending):
+                if world_down:
+                    pending.pop(r)
+                    remaining.discard(r)
+                    continue
+                if now < pending[r]:
+                    continue
+                pending.pop(r)
+                with procs_lock:
+                    procs[r] = _spawn(r, gens[r])
+                print(
+                    f"[launch] rank {r} respawned as gen {gens[r]} "
+                    f"after {respawn_delay:g}s hold "
+                    f"({budget[r]} respawn(s) left)",
+                    file=sys.stderr,
+                )
             for r in sorted(remaining):
+                if r in pending:
+                    continue  # held down: its exit is already handled
                 code = procs[r].poll()
                 if code is None:
                     continue
@@ -324,6 +362,15 @@ def main(argv=None) -> int:
                         budget[r] -= 1
                         gens[r] += 1
                     _archive_blackbox(r, gens[r] - 1)
+                    if respawn_delay > 0:
+                        pending[r] = time.monotonic() + respawn_delay
+                        print(
+                            f"[launch] rank {r} exited with {code}; "
+                            f"holding down {respawn_delay:g}s before "
+                            f"gen {gens[r]}",
+                            file=sys.stderr,
+                        )
+                        continue
                     with procs_lock:
                         procs[r] = _spawn(r, gens[r])
                     print(
@@ -345,10 +392,16 @@ def main(argv=None) -> int:
                 for other in sorted(remaining):
                     procs[other].terminate()
             if remaining:
-                try:
-                    procs[min(remaining)].wait(timeout=0.2)
-                except subprocess.TimeoutExpired:
-                    pass
+                waitable = [r for r in remaining if r not in pending]
+                if waitable:
+                    try:
+                        procs[min(waitable)].wait(timeout=0.2)
+                    except subprocess.TimeoutExpired:
+                        pass
+                else:
+                    # every live rank is held down: a dead proc's wait()
+                    # returns instantly, so sleep instead of spinning
+                    time.sleep(0.2)
     except KeyboardInterrupt:
         for proc in procs:
             proc.send_signal(signal.SIGINT)

@@ -69,6 +69,7 @@ M_PUSHES = "train.pushes"
 M_SKIPPED_ROUNDS = "train.skipped_rounds"
 M_EXCHANGE_FAILURES = "train.exchange_failures"
 M_STALE_PARAMS = "train.stale_params_dropped"
+M_REPAIRED_CHUNKS = "train.repaired_chunks"
 
 # training-dynamics plane (docs/OBSERVABILITY.md "dynamics"):
 # M_STALENESS is a histogram published by the SERVER per applied

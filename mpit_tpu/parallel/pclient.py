@@ -40,6 +40,7 @@ from typing import Optional, Sequence
 
 import numpy as np
 
+from mpit_tpu.analysis.runtime import make_lock
 from mpit_tpu.parallel.pserver import (
     TAG_FETCH,
     TAG_HEARTBEAT,
@@ -48,6 +49,7 @@ from mpit_tpu.parallel.pserver import (
     TAG_PARAM,
     TAG_PUSH_DELTA,
     TAG_PUSH_EASGD,
+    TAG_SHARD_MAP,
     TAG_STOP,
     partition_bounds,
 )
@@ -98,32 +100,70 @@ class PClient:
         backoff_base: float = 0.05,
         backoff_max: float = 2.0,
         quant: Optional[str] = None,
+        shard_map=None,
     ):
         if max_retries < 0:
             raise ValueError("max_retries must be >= 0")
         self.transport = transport
         self.server_ranks = list(server_ranks)
         self.param_size = int(param_size)
-        self.bounds = partition_bounds(self.param_size, len(self.server_ranks))
-        # coalescing: a rank appearing k times in server_ranks owns k
-        # adjacent chunks — merge them so each round sends ONE message per
-        # distinct server (one framed scatter instead of k sends, one
-        # FETCH/PARAM round trip instead of k). Non-adjacent repeats would
-        # make the merged chunk non-contiguous; reject them.
-        self.ranks: list[int] = []
-        self.rank_bounds: list[tuple[int, int]] = []
-        for rank, (start, end) in zip(self.server_ranks, self.bounds):
-            if self.ranks and rank == self.ranks[-1]:
-                self.rank_bounds[-1] = (self.rank_bounds[-1][0], end)
-            elif rank in self.ranks:
+        # consistent-hash routing (docs/ROBUSTNESS.md "Shard ownership &
+        # resharding"): with a ShardMap, chunk ownership comes from the
+        # ring instead of positional partition_bounds, PARAM replies and
+        # push envelopes carry per-shard parts, and a dead server is a
+        # repair (reroute + fallback fill) instead of a lost round
+        self._shard_map = shard_map
+        # chunks repaired across reshards: every shard whose ownership
+        # this client rerouted off a dead server (the re-offered chunks
+        # land at the new owner next round instead of skipping it)
+        self.repaired_chunks = 0
+        # per-shard center versions from sharded PARAM replies — the
+        # dynamics-plane staleness signal stays attributable per shard
+        # even while ownership moves
+        self.shard_versions: dict[int, int] = {}
+        self._rank_shards: dict[int, list[tuple[int, int, int]]] = {}
+        # guards the routing tables (server_ranks/ranks/_rank_chunks/...)
+        # that `_repair_dead` rebuilds mid-run while the heartbeat thread
+        # (and a supervising caller's stop/leave) iterate them
+        self._route_lock = make_lock("PClient._route_lock")
+        if shard_map is not None:
+            if shard_map.param_size != self.param_size:
                 raise ValueError(
-                    f"server rank {rank} repeats non-adjacently in "
-                    f"{self.server_ranks} — its chunks would not be "
-                    "contiguous, so they cannot coalesce"
+                    f"shard_map covers {shard_map.param_size} params, "
+                    f"client has {self.param_size}"
                 )
-            else:
-                self.ranks.append(rank)
-                self.rank_bounds.append((start, end))
+            self.bounds = list(shard_map.layout)
+            self._rank_chunks: dict[int, list[tuple[int, int]]] = {}
+            self.ranks: list[int] = []
+            self.rank_bounds: list[tuple[int, int]] = []
+            self._build_ring_routing()
+        else:
+            self.bounds = partition_bounds(
+                self.param_size, len(self.server_ranks)
+            )
+            # coalescing: a rank appearing k times in server_ranks owns k
+            # chunks — group them per destination so each round sends ONE
+            # message per distinct server (one framed scatter instead of
+            # k sends, one FETCH/PARAM round trip instead of k). Adjacent
+            # chunks merge into one contiguous slice; non-adjacent ones
+            # (the common case under ring assignment) ride the same
+            # message as separate slices.
+            self.ranks = []
+            self._rank_chunks = {}
+            for rank, (start, end) in zip(self.server_ranks, self.bounds):
+                chunks = self._rank_chunks.setdefault(rank, [])
+                if rank not in self.ranks:
+                    self.ranks.append(rank)
+                if chunks and chunks[-1][1] == start:
+                    chunks[-1] = (chunks[-1][0], end)
+                else:
+                    chunks.append((start, end))
+            # bounding hull per rank, kept for observability/back-compat
+            # (equals the merged chunk when a rank's slices are adjacent)
+            self.rank_bounds = [
+                (self._rank_chunks[r][0][0], self._rank_chunks[r][-1][1])
+                for r in self.ranks
+            ]
         if quant is None:
             quant = quant_mode_from_env()
         elif quant not in ("off", "bf16", "int8"):
@@ -168,7 +208,9 @@ class PClient:
 
     def _heartbeat_loop(self, interval: float) -> None:
         while not self._hb_stop.wait(interval):
-            for rank in self.server_ranks:
+            with self._route_lock:
+                targets = list(self.server_ranks)
+            for rank in targets:
                 try:
                     self.transport.send(rank, TAG_HEARTBEAT, None)
                 except Exception:
@@ -178,6 +220,81 @@ class PClient:
                     # dead later. The interval bounds the retry rate; the
                     # thread exits only via stop().
                     pass
+
+    # -- ring routing & repair --------------------------------------------
+
+    def _build_ring_routing(self) -> None:
+        """Derive per-server routing from the current shard map: which
+        (sid, start, end) slices each live server owns, ascending. Also
+        refreshes ``server_ranks``/``ranks`` so heartbeats, STOP/LEAVE
+        fan-out, and scatters track the surviving membership."""
+        sm = self._shard_map
+        shards: dict[int, list[tuple[int, int, int]]] = {}
+        for sid, (s, e) in enumerate(sm.layout):
+            shards.setdefault(sm.assignment[sid], []).append((sid, s, e))
+        with self._route_lock:
+            self._rank_shards = {
+                r: sorted(v, key=lambda t: t[1]) for r, v in shards.items()
+            }
+            self.ranks = sorted(self._rank_shards)
+            self.server_ranks = list(self.ranks)
+            self._rank_chunks = {
+                r: [(s, e) for _, s, e in v]
+                for r, v in self._rank_shards.items()
+            }
+            self.rank_bounds = [
+                (self._rank_chunks[r][0][0], self._rank_chunks[r][-1][1])
+                for r in self.ranks
+            ]
+
+    def _repair_dead(self, dead_rank: int) -> None:
+        """Partial-scatter repair: reroute ownership off a dead server.
+
+        The ring is deterministic, so every client that observes the
+        same death derives the SAME successor view — the announcements
+        they fan out to the survivors share a ring version, and the
+        servers take the first one and idempotently ignore the rest.
+        This client's next scatter re-offers the dead server's chunks
+        to their new owners instead of skipping the round."""
+        sm = self._shard_map
+        if dead_rank not in sm.ring.members or len(sm.ring.members) <= 1:
+            return
+        new_ring = sm.ring.without(dead_rank)
+        new_map = sm.with_ring(new_ring)
+        moved = [
+            sid
+            for sid in range(sm.num_shards)
+            if sm.assignment[sid] != new_map.assignment[sid]
+        ]
+        self._shard_map = new_map
+        self._build_ring_routing()
+        for r in self.ranks:
+            self.push_sent.setdefault(r, 0)
+        # quantization residuals are keyed per shard in ring mode, so
+        # they survive the reroute; versions for moved shards restart at
+        # the new owner's counter on the next fetch
+        announce = (new_ring.version, list(new_ring.members))
+        for r in list(self.ranks):
+            try:
+                self._send_with_retry(r, TAG_SHARD_MAP, announce)
+            except (ConnectionError, OSError):
+                # unreachable survivor: its own clients' repair rounds
+                # (or ours, next fetch) re-announce the same view
+                pass
+        self.repaired_chunks += len(moved)
+        self._journal(
+            "reshard_repair", dead=dead_rank, view=new_ring.version,
+            moved=len(moved),
+        )
+
+    def _journal(self, ev: str, **fields) -> None:
+        """Dynamics-plane journal record via the transport's obs tracer
+        (no-op unless obs-wrapped with journaling on — the same
+        disabled-cost contract as the server's `_journal_dynamics`)."""
+        tracer = getattr(self.transport, "obs_tracer", None)
+        if tracer is None or tracer.journal is None:
+            return
+        tracer.journal.event(ev, tracer.clock.tick(), **fields)
 
     # -- retry plumbing ---------------------------------------------------
 
@@ -237,6 +354,49 @@ class PClient:
             return None
         return arr
 
+    def _parts_ok(self, chunk) -> Optional[list]:
+        """``[(sid, shard_version, arr)]`` from a sharded PARAM reply,
+        or None when malformed. Each part is validated against its
+        static layout slot — placement never depends on the sender's
+        ring view, so a reply stays interpretable even when ownership
+        moved under us (the server replies with everything it owns; we
+        take whatever arrives, wherever the layout says it lives)."""
+        if not isinstance(chunk, list) or not chunk:
+            return None
+        out = []
+        layout = self._shard_map.layout
+        num_shards = self._shard_map.num_shards
+        for part in chunk:
+            if not (
+                isinstance(part, (tuple, list))
+                and len(part) == 3
+                and isinstance(part[0], int)
+            ):
+                return None
+            sid, ver, arr = part
+            if not (0 <= sid < num_shards):
+                return None
+            try:
+                if isinstance(arr, QuantArray):
+                    arr = dequantize(arr)
+                # wire payloads are host numpy (msgpack-decoded), never
+                # device arrays — no host sync happens here
+                a = np.asarray(arr, dtype=np.float32)  # mpit-analysis: ignore[MPT005]
+            except (TypeError, ValueError):
+                return None
+            s, e = layout[sid]
+            if a.shape != (e - s,):
+                return None
+            out.append((sid, ver if isinstance(ver, int) else 0, a))
+        return out
+
+    def _accept_chunk(self, chunk, expected: Optional[int]):
+        """Validate a PARAM body: ``expected=None`` means a sharded
+        parts reply, an int the legacy contiguous chunk of that size."""
+        if expected is None:
+            return self._parts_ok(chunk)
+        return self._chunk_ok(chunk, expected)
+
     def _await_param(
         self, rank: int, attempt_id: Optional[int], expected: int,
         resend=None,
@@ -291,7 +451,7 @@ class PClient:
                     if got_id != attempt_id:
                         self.stale_params_dropped += 1
                         continue  # a timed-out attempt's late reply
-                    arr = self._chunk_ok(chunk, expected)
+                    arr = self._accept_chunk(chunk, expected)
                     if arr is None:
                         # mangled on the wire: keep waiting; the timeout
                         # re-fetches (the server won't resend on its own)
@@ -311,12 +471,12 @@ class PClient:
                     if got_id != attempt_id:
                         self.stale_params_dropped += 1
                         continue
-                    arr = self._chunk_ok(chunk, expected)
+                    arr = self._accept_chunk(chunk, expected)
                     if arr is None:
                         self.corrupt_params_dropped += 1
                         continue
                     return arr
-                arr = self._chunk_ok(payload, expected)  # legacy un-id'd
+                arr = self._accept_chunk(payload, expected)  # legacy un-id'd
                 if arr is None:
                     self.corrupt_params_dropped += 1
                     continue
@@ -329,26 +489,22 @@ class PClient:
 
     # -- protocol ---------------------------------------------------------
 
-    def fetch(self) -> np.ndarray:
+    def fetch(self, fallback: Optional[np.ndarray] = None) -> np.ndarray:
         """Gather the full flat center from all servers (async fan-out:
         request every chunk before waiting on any — the reference's
         ``async_fetch_param`` shape, SURVEY.md §3(b)); per-server
         retry-with-backoff on timeout, attempt-id'd against stale
-        replies."""
-        attempts: dict[int, Optional[int]] = {}
-        for rank in self.ranks:
-            try:
-                attempts[rank] = self._send_fetch(rank)
-            except (ConnectionError, OSError):
-                attempts[rank] = None  # the retry path re-sends
-        out = np.empty(self.param_size, np.float32)
-        for rank, (start, end) in zip(self.ranks, self.rank_bounds):
-            out[start:end] = self._await_param(
-                rank, attempts[rank], end - start
-            )
-        return out
+        replies.
 
-    def join(self) -> np.ndarray:
+        ``fallback`` (ring mode): the client's local flat params. When a
+        server is declared dead mid-fetch, its shards are rerouted on
+        the ring (partial-scatter repair) and any still-unsatisfied
+        slice is filled from ``fallback`` for THIS round only — the next
+        round fetches it from the new owner. Without a fallback a dead
+        server raises, as in legacy mode."""
+        return self._gather(self._send_fetch, fallback)
+
+    def join(self, fallback: Optional[np.ndarray] = None) -> np.ndarray:
         """Announce this client's (rank, epoch) to every server and
         gather the full flat center — the elastic-membership entry
         point (docs/ROBUSTNESS.md). Same fan-out/retry/attempt-id shape
@@ -358,17 +514,65 @@ class PClient:
         "replace" (clean dedup slot, dead flag cleared), a reconnecting
         preempted one as a "rejoin" — instead of being mistaken for a
         replay of its predecessor."""
+        return self._gather(self._send_join, fallback)
+
+    def _gather(self, resend, fallback: Optional[np.ndarray]) -> np.ndarray:
         attempts: dict[int, Optional[int]] = {}
-        for rank in self.ranks:
+        for rank in list(self.ranks):
             try:
-                attempts[rank] = self._send_join(rank)
+                attempts[rank] = resend(rank)
             except (ConnectionError, OSError):
                 attempts[rank] = None  # the retry path re-sends
         out = np.empty(self.param_size, np.float32)
-        for rank, (start, end) in zip(self.ranks, self.rank_bounds):
-            out[start:end] = self._await_param(
-                rank, attempts[rank], end - start, resend=self._send_join
-            )
+        if self._shard_map is None:
+            for rank in self.ranks:
+                chunks = self._rank_chunks[rank]
+                total = sum(e - s for s, e in chunks)
+                arr = self._await_param(
+                    rank, attempts[rank], total, resend=resend
+                )
+                # split the coalesced reply back across this rank's
+                # slices, ascending — the inverse of the scatter order
+                off = 0
+                for s, e in chunks:
+                    out[s:e] = arr[off:off + (e - s)]
+                    off += e - s
+            return out
+        # ring mode: parts replies carry (sid, version, slice); place by
+        # the static layout, then repair around any dead server
+        filled: set[int] = set()
+        dead: list[int] = []
+        for rank in list(self.ranks):
+            try:
+                parts = self._await_param(
+                    rank, attempts.get(rank), None, resend=resend
+                )
+            except RecvTimeout:
+                if fallback is None:
+                    raise
+                dead.append(rank)
+                continue
+            for sid, ver, arr in parts:
+                s, e = self._shard_map.layout[sid]
+                out[s:e] = arr
+                filled.add(sid)
+                self.shard_versions[sid] = ver
+        for rank in dead:
+            self._repair_dead(rank)
+        missing = [
+            sid
+            for sid in range(self._shard_map.num_shards)
+            if sid not in filled
+        ]
+        if missing:
+            if fallback is None:
+                raise RecvTimeout(
+                    f"shards {missing} unavailable and no fallback given"
+                )
+            fb = np.asarray(fallback, np.float32)
+            for sid in missing:
+                s, e = self._shard_map.layout[sid]
+                out[s:e] = fb[s:e]
         return out
 
     def push_easgd(self, flat_params: np.ndarray) -> None:
@@ -409,7 +613,9 @@ class PClient:
 
     def _detach_all(self, tag: int, what: str) -> None:
         errors: list[tuple[int, BaseException]] = []
-        for rank in self.server_ranks:
+        with self._route_lock:
+            targets = list(self.server_ranks)
+        for rank in targets:
             try:
                 self._send_with_retry(rank, tag, None)
             except Exception as e:
@@ -433,8 +639,38 @@ class PClient:
         # Each chunk carries that server's last-fetched center version
         # as its staleness basis (0 = never fetched a versioned reply).
         seq = next(self._push_seq)
-        for rank, (start, end) in zip(self.ranks, self.rank_bounds):
-            chunk = flat[start:end]
+        if self._shard_map is not None:
+            # ring mode: one envelope per live server carrying its
+            # (sid, chunk) parts — after a repair the re-offered shards
+            # simply route to their new owner under the same seq
+            # discipline. Residuals are keyed per shard so error
+            # feedback survives ownership moves.
+            for rank in list(self.ranks):
+                parts = []
+                for sid, s, e in self._rank_shards[rank]:
+                    chunk = flat[s:e]
+                    if self.quant != "off":
+                        key = (tag, sid)
+                        res = self._residual.get(key)
+                        comp = chunk if res is None else chunk + res
+                        q = quantize(comp, self.quant)
+                        self._residual[key] = comp - dequantize(q)
+                        parts.append((sid, q))
+                    else:
+                        parts.append((sid, chunk))
+                self._send_with_retry(
+                    rank, tag,
+                    (
+                        self._epoch, seq,
+                        self.server_version.get(rank, 0),
+                        parts,
+                    ),
+                )
+                self.push_sent[rank] = self.push_sent.get(rank, 0) + 1
+            return
+        for rank in self.ranks:
+            pieces = [flat[s:e] for s, e in self._rank_chunks[rank]]
+            chunk = pieces[0] if len(pieces) == 1 else np.concatenate(pieces)
             if self.quant != "off":
                 # error feedback: compensate this push with the residual
                 # the previous quantized push left behind, then carry the

@@ -34,6 +34,7 @@ from mpit_tpu.obs.live import (
     M_PARAM_NORM,
     M_PUSHES,
     M_PUSH_NORM,
+    M_REPAIRED_CHUNKS,
     M_ROUNDS,
     M_SAMPLES,
     M_SKIPPED_ROUNDS,
@@ -160,7 +161,9 @@ def client_train_loop(
     and only escalates once that many *consecutive* rounds have failed
     (any success resets the count). ``None`` keeps fail-fast semantics.
     ``exchange_stats`` (when provided) is filled with
-    ``{"skipped_rounds", "exchange_failures"}`` totals.
+    ``{"skipped_rounds", "exchange_failures", "repaired_chunks"}`` totals
+    (``repaired_chunks``: shards rerouted off dead servers by ring-mode
+    partial-scatter repair — 0 in legacy flat mode).
 
     ``join``: announce this client via the elastic-membership JOIN
     envelope for its initial pull instead of a plain fetch — required
@@ -194,7 +197,21 @@ def client_train_loop(
     # (docs/OBSERVABILITY.md) — each span groups one exchange's wire
     # traffic under a single trace on the merged timeline
     with obs_span(client.transport, "initial_fetch"):
-        initial = client.join() if join else client.fetch()
+        # startup patience: the initial pull races server startup (under
+        # a process launcher peers come up seconds apart, and a short
+        # MPIT_CONNECT_RETRY_S narrows the transport's own grace). A
+        # client that comes up before its servers must wait, not die —
+        # unlike mid-run failures, there is no stale center to fall back
+        # on yet, so keep re-asking until the deadline
+        deadline = time.monotonic() + 60.0
+        while True:
+            try:
+                initial = client.join() if join else client.fetch()
+                break
+            except (RecvTimeout, ConnectionError, OSError):
+                if time.monotonic() >= deadline:
+                    raise
+                time.sleep(0.5)
         params = unflatten_params(spec, jnp.asarray(initial))
     opt_state = optimizer.init(params)
     last_pull = np.asarray(flatten_params(params)[0])
@@ -259,7 +276,11 @@ def client_train_loop(
                     # path. Push-then-fetch would couple against a center
                     # already moved by this client's own push (an
                     # alpha*(1-alpha) effective move).
-                    center = client.fetch()
+                    # The local params ride along as the repair fallback
+                    # (ring mode): a dead server's shards are rerouted
+                    # and THIS round's gap filled locally instead of
+                    # skipping the round (docs/ROBUSTNESS.md).
+                    center = client.fetch(fallback=flat)
                     client.push_easgd(flat)
                     if dyn_on:
                         _record_dynamics(
@@ -275,7 +296,7 @@ def client_train_loop(
                     # failure below must not get it re-pushed next round
                     prev_pull = last_pull
                     last_pull = flat
-                    fetched = client.fetch()
+                    fetched = client.fetch(fallback=flat)
                     if dyn_on:
                         # elastic here = ‖local − fetched center‖; the
                         # fetch-delta baseline is the previous pull
@@ -314,9 +335,15 @@ def client_train_loop(
             reg.observe(M_EXCHANGE_LAT, dt_x)
             reg.set_gauge(M_PUSHES, sum(client.push_sent.values()))
             reg.set_gauge(M_STALE_PARAMS, client.stale_params_dropped)
+            reg.set_gauge(
+                M_REPAIRED_CHUNKS, getattr(client, "repaired_chunks", 0)
+            )
             params = unflatten_params(spec, jnp.asarray(flat))
     flush()  # flush any remainder losses
     if exchange_stats is not None:
         exchange_stats["skipped_rounds"] = skipped_rounds
         exchange_stats["exchange_failures"] = total_failures
+        exchange_stats["repaired_chunks"] = getattr(
+            client, "repaired_chunks", 0
+        )
     return losses

@@ -137,6 +137,7 @@ class AsyncPSTrainer:
         max_exchange_failures: Optional[int] = 3,
         fetch_timeout: float = 60.0,
         fetch_retries: int = 3,
+        ps_shards: Optional[int] = None,
     ):
         if algo not in ("easgd", "downpour"):
             raise ValueError(f"unknown algo {algo!r}")
@@ -180,6 +181,21 @@ class AsyncPSTrainer:
             raise ValueError("fetch_retries must be >= 0")
         self.chaos = chaos
         self.obs = obs
+        # sharded ownership (docs/ROBUSTNESS.md "Shard ownership &
+        # resharding"): split the flat vector into this many shards placed
+        # on servers by a consistent-hash ring, so clients can reassign a
+        # dead server's shards to the survivors mid-run (live resharding)
+        # instead of degrading every round that touches its range. None
+        # (the default) keeps the legacy one-contiguous-chunk-per-server
+        # layout. Env opt-in MPIT_PS_SHARDS serves launcher-driven runs.
+        if ps_shards is None:
+            import os
+
+            env_shards = int(os.environ.get("MPIT_PS_SHARDS", "0"))
+            ps_shards = env_shards if env_shards > 0 else None
+        if ps_shards is not None and ps_shards < 1:
+            raise ValueError("ps_shards must be >= 1 (None = legacy layout)")
+        self.ps_shards = ps_shards
         self.max_exchange_failures = max_exchange_failures
         self.fetch_timeout = float(fetch_timeout)
         self.fetch_retries = int(fetch_retries)
@@ -283,6 +299,16 @@ class AsyncPSTrainer:
             range(self.num_servers, self.num_servers + self.num_clients)
         )
         bounds = partition_bounds(flat0.size, self.num_servers)
+        shard_map = None
+        if self.ps_shards is not None:
+            from mpit_tpu.comm.topology import HashRing, ShardMap
+
+            # ring placement: every actor derives the same shard→server
+            # assignment from the member list alone (blake2b, not Python
+            # hash()), so no coordinator hands out the layout
+            shard_map = ShardMap(
+                HashRing(server_ranks), flat0.size, self.ps_shards
+            )
 
         ckpt_paths = [None] * self.num_servers
         if self.ckpt_dir is not None:
@@ -297,10 +323,21 @@ class AsyncPSTrainer:
                 for p in ckpt_paths:
                     if os.path.exists(p):
                         os.remove(p)
+        def _server_center(r: int, start: int, end: int) -> np.ndarray:
+            if shard_map is None:
+                return flat0[start:end]
+            # sharded: this server's center is the ascending concat of the
+            # shards the ring assigns it (possibly non-contiguous in the
+            # flat vector, possibly empty when servers outnumber shards)
+            pieces = [flat0[s:e] for _, s, e in shard_map.ranges_for(r)]
+            if not pieces:
+                return np.zeros(0, np.float32)
+            return np.concatenate(pieces)
+
         servers = [
             PServer(
                 transports[r],
-                flat0[start:end],
+                _server_center(r, start, end),
                 num_clients=self.num_clients,
                 alpha=self.alpha,
                 server_lr=self.server_lr,
@@ -308,6 +345,7 @@ class AsyncPSTrainer:
                 client_timeout=self.client_timeout,
                 ckpt_path=path,
                 ckpt_every=self.ckpt_every,
+                shard_map=shard_map,
             )
             for r, (start, end), path in zip(server_ranks, bounds, ckpt_paths)
         ]
@@ -331,6 +369,7 @@ class AsyncPSTrainer:
                     tp, server_ranks, flat0.size, heartbeat_interval=hb,
                     timeout=self.fetch_timeout,
                     max_retries=self.fetch_retries,
+                    shard_map=shard_map,
                 )
                 clients[c] = client
                 xs = shard_for_worker(x, c, self.num_clients)
@@ -387,7 +426,20 @@ class AsyncPSTrainer:
             teardown_transports()
             raise errors[0]
 
-        center_flat = np.concatenate([s.snapshot() for s in servers])
+        if shard_map is None:
+            center_flat = np.concatenate([s.snapshot() for s in servers])
+        else:
+            # place each server's owned shards back by the STATIC layout
+            # (ownership may have moved mid-run; seed values back any shard
+            # nobody ended up holding)
+            center_flat = np.array(flat0, copy=True)
+            for s in servers:
+                snap = s.snapshot()
+                off = 0
+                for _sid, start, end in s.owned_ranges():
+                    n = end - start
+                    center_flat[start:end] = snap[off:off + n]
+                    off += n
         center_params = unflatten_params(spec, jnp.asarray(center_flat))
         stats = {
             "server_counts": [dict(s.counts) for s in servers],
@@ -417,6 +469,13 @@ class AsyncPSTrainer:
             ],
             "skipped_rounds": [
                 s.get("skipped_rounds", 0) for s in exchange_stats
+            ],
+            # sharded repair accounting: per-client count of shards the
+            # client re-routed to surviving owners after a server death
+            # (0s in legacy mode; see docs/ROBUSTNESS.md)
+            "ps_shards": self.ps_shards,
+            "repaired_chunks": [
+                s.get("repaired_chunks", 0) for s in exchange_stats
             ],
             "exchange_failures": [
                 s.get("exchange_failures", 0) for s in exchange_stats

@@ -28,6 +28,18 @@ Protocol tags (client → server unless noted):
   LEAVE       ()                 planned departure (preemption notice) —
                                  the rank stops counting toward teardown
                                  without waiting for the watchdog
+  SHARD_MAP   ((ring_version, members))  new ring view (sharded mode,
+                                 docs/ROBUSTNESS.md "Shard ownership &
+                                 resharding"): the server hands off shards
+                                 it no longer owns and marks newly-owned
+                                 ones pending; stale/duplicate views
+                                 (ring_version <= current) are idempotently
+                                 ignored
+  RESHARD     ((ring_version, shard, shard_version, chunk, dedup))
+                                 server -> server slice handoff: the new
+                                 owner materializes the shard at its static
+                                 layout slot and absorbs the sender's dedup
+                                 window so exactly-once survives the move
 
 Fault-tolerant envelopes (docs/ROBUSTNESS.md): a FETCH carrying an
 ``attempt_id`` gets it echoed in the PARAM reply, so a client whose
@@ -87,6 +99,7 @@ from typing import Optional, Sequence
 import numpy as np
 
 from mpit_tpu.analysis.runtime import make_lock, note as _rt_note
+from mpit_tpu.comm.topology import HashRing
 from mpit_tpu.obs.live import M_STALENESS, live_registry
 from mpit_tpu.parallel.elastic import ElasticMembership
 from mpit_tpu.transport import (
@@ -115,6 +128,8 @@ TAG_STOP = 5
 TAG_HEARTBEAT = 6
 TAG_JOIN = 7
 TAG_LEAVE = 8
+TAG_SHARD_MAP = 9
+TAG_RESHARD = 10
 
 
 class _DedupWindow:
@@ -148,6 +163,19 @@ class _DedupWindow:
                 floor = seq - self.size
                 self._seen[key] = {s for s in seen if s > floor}
         return True
+
+    def absorb(self, entries) -> None:
+        """Merge another window's :meth:`state` into this one (shard
+        handoff): per (src, epoch) the high-water mark takes the max and
+        the seen sets union, so a push the old owner already applied is
+        still rejected by the new owner after the shard moves — the
+        exactly-once guarantee travels WITH the shard, not with the
+        server that happened to hold it."""
+        for src, epoch, high, seen in entries:
+            key = (int(src), int(epoch))  # mpit-analysis: ignore[MPT005]
+            self._high[key] = max(self._high.get(key, 0), int(high))  # mpit-analysis: ignore[MPT005]
+            s = self._seen.setdefault(key, set())
+            s.update(int(x) for x in seen)  # mpit-analysis: ignore[MPT005]
 
     def state(self) -> list:
         """Snapshot as plain msgpack-friendly lists: one
@@ -202,6 +230,7 @@ class PServer:
         ckpt_every: Optional[int] = 100,
         dedup_window: int = 1024,
         quant: Optional[str] = None,
+        shard_map=None,
     ):
         """``client_timeout``: seconds of per-client silence before the
         watchdog declares it dead (requires ``client_ranks``); None keeps
@@ -216,9 +245,42 @@ class PServer:
         ``center_chunk``, so a restarted server resumes where the dead
         one left off. A shape mismatch (different model or server count)
         fails loudly — re-chunking across topologies is a layout change,
-        not a resume."""
+        not a resume.
+
+        ``shard_map``: a :class:`~mpit_tpu.comm.topology.ShardMap` opts
+        this server into consistent-hash sharded ownership
+        (docs/ROBUSTNESS.md "Shard ownership & resharding"):
+        ``center_chunk`` must be the ascending concatenation of the
+        shards the map assigns to ``transport.rank``, pushes/fetches
+        carry per-shard parts, and TAG_SHARD_MAP / TAG_RESHARD move
+        ownership live. ``None`` keeps the legacy single contiguous
+        chunk."""
         self.transport = transport
         self.center = np.array(center_chunk, dtype=np.float32, copy=True)
+        self._shard_map = shard_map
+        # sharded-ownership state: `_owned` is the ascending
+        # (sid, start, end) list of MATERIALIZED shards backing
+        # self.center; `_pending` are shards the current ring assigns
+        # here whose data has not arrived yet (via TAG_RESHARD from the
+        # old owner, or adopted from the first full EASGD push) — a
+        # pending shard occupies no memory, which is what keeps the
+        # reshard peak at old-slice + incoming-slice
+        self._owned: list[tuple[int, int, int]] = []
+        self._pending: dict[int, tuple[int, int]] = {}
+        # per-shard monotonic update counters (dynamics plane): bumped
+        # with every applied part, stamped into sharded PARAM replies so
+        # staleness stays attributable per shard across ownership moves
+        self.shard_versions: dict[int, int] = {}
+        if shard_map is not None:
+            self._owned = list(shard_map.ranges_for(transport.rank))
+            owned_size = sum(e - s for _, s, e in self._owned)
+            if self.center.size != owned_size:
+                raise ValueError(
+                    f"center_chunk has {self.center.size} elements but the "
+                    f"shard map assigns {owned_size} to rank "
+                    f"{transport.rank}"
+                )
+            self.shard_versions = {sid: 0 for sid, _, _ in self._owned}
         self.num_clients = num_clients
         self.alpha = float(alpha)
         self.server_lr = float(server_lr)
@@ -244,7 +306,9 @@ class PServer:
         self.quant = quant
         self.counts = {"fetch": 0, "push_easgd": 0, "push_delta": 0,
                        "heartbeat": 0, "join": 0, "leave": 0,
-                       "dup_dropped": 0, "malformed_dropped": 0}
+                       "dup_dropped": 0, "malformed_dropped": 0,
+                       "shard_map": 0, "reshard": 0, "handoff_sent": 0,
+                       "adopted_shards": 0, "misrouted_parts": 0}
         # training-dynamics plane (docs/OBSERVABILITY.md "dynamics"):
         # monotonic center-update version — bumped per applied push,
         # stamped into attempt-id'd PARAM replies, echoed back by
@@ -303,13 +367,46 @@ class PServer:
 
         state = load_shard_state(ckpt_path)
         saved = np.asarray(state["center"], dtype=np.float32)
-        if saved.shape != self.center.shape:
-            raise ValueError(
-                f"persisted shard snapshot {ckpt_path!r} has shape "
-                f"{saved.shape}, this server owns {self.center.shape} "
-                "— resuming across a model/server-count change is not "
-                "supported"
-            )
+        shards = state.get("shards")
+        if shards is None or self._shard_map is None:
+            if saved.shape != self.center.shape:
+                raise ValueError(
+                    f"persisted shard snapshot {ckpt_path!r} has shape "
+                    f"{saved.shape}, this server owns {self.center.shape} "
+                    "— resuming across a model/server-count change is not "
+                    "supported"
+                )
+        else:
+            # sharded snapshot: the persisted ownership rows, not the
+            # constructor's map, say what the center covers (ownership
+            # may have moved between construction and the snapshot)
+            owned = [
+                (int(x[0]), int(x[1]), int(x[2]))  # mpit-analysis: ignore[MPT005]
+                for x in shards
+            ]
+            if sum(e - s for _, s, e in owned) != saved.size:
+                raise ValueError(
+                    f"persisted shard snapshot {ckpt_path!r}: ownership "
+                    "rows do not cover the persisted center"
+                )
+            self._owned = owned
+            self._pending = {}
+            self.shard_versions = {
+                int(x[0]): int(x[3])  # mpit-analysis: ignore[MPT005]
+                for x in shards
+            }
+        ring = state.get("ring")
+        if ring is not None and self._shard_map is not None:
+            rv = int(ring[0])  # mpit-analysis: ignore[MPT005]
+            if rv > self._shard_map.ring.version:
+                members = [int(m) for m in ring[1]]  # mpit-analysis: ignore[MPT005]
+                self._shard_map = self._shard_map.with_ring(
+                    HashRing(
+                        members,
+                        vnodes=self._shard_map.ring.vnodes,
+                        version=rv,
+                    )
+                )
         self.center = saved.copy()
         self.version = int(state.get("version", 0))
         # a restore is a new generation: PARAM version records after the
@@ -378,7 +475,7 @@ class PServer:
                     self._note("center", write=False)
                     self._note("version", write=False)
                     self._note("counts")
-                    snapshot = self.center.copy()
+                    snapshot = self._reply_chunk()
                     version = self.version
                     self.counts["fetch"] += 1
                 # echo the client's attempt id so a retrying fetch can
@@ -388,12 +485,8 @@ class PServer:
                 # server can attribute per-push staleness
                 if msg.payload is None:
                     reply = snapshot
-                elif self.quant != "off":
-                    reply = (
-                        msg.payload, version, quantize(snapshot, self.quant)
-                    )
                 else:
-                    reply = (msg.payload, version, snapshot)
+                    reply = (msg.payload, version, self._quant_chunk(snapshot))
                 self._journal_dynamics(
                     "param_version", dst=msg.src, version=version,
                     gen=self.gen,
@@ -401,32 +494,12 @@ class PServer:
                 self.transport.send(msg.src, TAG_PARAM, reply)
             elif msg.tag == TAG_PUSH_EASGD:
                 if self._admit_push(msg):
-                    with self._lock:
-                        self._note("center")
-                        self._note("version")
-                        self._note("counts")
-                        # elastic move toward the client (SURVEY.md §3(c) push)
-                        self.center += self.alpha * (
-                            np.asarray(msg.payload) - self.center
-                        )
-                        self.counts["push_easgd"] += 1
-                        self._updates_since_save += 1
-                        self.version += 1
-                        version = self.version
-                    self._record_push(msg, version)
+                    # elastic move toward the client (SURVEY.md §3(c) push)
+                    self._apply_update(msg, easgd=True)
                     self._maybe_persist()
             elif msg.tag == TAG_PUSH_DELTA:
                 if self._admit_push(msg):
-                    with self._lock:
-                        self._note("center")
-                        self._note("version")
-                        self._note("counts")
-                        self.center += self.server_lr * np.asarray(msg.payload)
-                        self.counts["push_delta"] += 1
-                        self._updates_since_save += 1
-                        self.version += 1
-                        version = self.version
-                    self._record_push(msg, version)
+                    self._apply_update(msg, easgd=False)
                     self._maybe_persist()
             elif msg.tag == TAG_HEARTBEAT:
                 with self._lock:
@@ -450,16 +523,13 @@ class PServer:
                         self._note("center", write=False)
                         self._note("version", write=False)
                         self._note("counts")
-                        snapshot = self.center.copy()
+                        snapshot = self._reply_chunk()
                         version = self.version
                         self.counts["join"] += 1
                     if watchdog and msg.src not in last_seen:
                         # a brand-new rank: arm its watchdog slot
                         last_seen[msg.src] = time.monotonic()
-                    if self.quant != "off":
-                        reply = (attempt, version, quantize(snapshot, self.quant))
-                    else:
-                        reply = (attempt, version, snapshot)
+                    reply = (attempt, version, self._quant_chunk(snapshot))
                     self._journal_dynamics(
                         "membership", src=msg.src, kind=kind,
                         view=self._membership.view_epoch, gen=self.gen,
@@ -482,6 +552,10 @@ class PServer:
             elif msg.tag == TAG_STOP:
                 self._note("membership")
                 self._stopped.add(msg.src)
+            elif msg.tag == TAG_SHARD_MAP:
+                self._handle_shard_map(msg)
+            elif msg.tag == TAG_RESHARD:
+                self._handle_reshard(msg)
             else:
                 raise ValueError(f"pserver: unknown tag {msg.tag}")
             if watchdog:
@@ -500,6 +574,285 @@ class PServer:
         ):
             return payload
         return None
+
+    # ---- sharded ownership (docs/ROBUSTNESS.md "Shard ownership &
+    # resharding"). All of the state below is confined to the server's
+    # recv thread except `center`/`_owned`, which snapshot() readers see
+    # under the lock.
+
+    def _local_slices(self) -> list[tuple[int, int]]:
+        """Local [start, end) into ``self.center`` per materialized
+        shard, ascending (same order as ``self._owned``)."""
+        out, off = [], 0
+        for _, s, e in self._owned:
+            out.append((off, off + (e - s)))
+            off += e - s
+        return out
+
+    def _shard_slice(self, sid: int) -> Optional[tuple[int, int]]:
+        for (osid, _, _), loc in zip(self._owned, self._local_slices()):
+            if osid == sid:
+                return loc
+        return None
+
+    def _materialize(self, sid: int, arr, version: int) -> None:
+        """Install a pending shard's data at its static layout slot
+        (caller holds the lock). The backing ``center`` array is rebuilt
+        as the ascending concatenation — the only transient extra memory
+        is the one incoming slice."""
+        s, e = self._pending.pop(sid)
+        pieces = [
+            (gs, osid, ge, self.center[ls:le])
+            for (osid, gs, ge), (ls, le) in zip(self._owned, self._local_slices())
+        ]
+        pieces.append((s, sid, e, np.asarray(arr, dtype=np.float32)))
+        pieces.sort(key=lambda p: p[0])
+        self._owned = [(p[1], p[0], p[2]) for p in pieces]
+        self.center = np.concatenate([p[3] for p in pieces])
+        self.shard_versions[sid] = int(version)
+
+    def _drop_shard(self, sid: int) -> None:
+        """Forget a handed-off shard (caller holds the lock): the slice
+        leaves ``center`` immediately, so the old owner never holds a
+        duplicate once the transfer is on the wire."""
+        keep = [
+            ((osid, s, e), self.center[ls:le])
+            for (osid, s, e), (ls, le) in zip(self._owned, self._local_slices())
+            if osid != sid
+        ]
+        self._owned = [k[0] for k in keep]
+        self.center = (
+            np.concatenate([k[1] for k in keep])
+            if keep
+            else np.zeros(0, dtype=np.float32)
+        )
+        self.shard_versions.pop(sid, None)
+
+    def _reply_chunk(self):
+        """PARAM reply body (caller holds the lock): the legacy
+        contiguous copy, or — sharded — ``(sid, shard_version, slice)``
+        parts the client places by the static layout, so a reply stays
+        interpretable even when the client's ring view is behind."""
+        if self._shard_map is None:
+            return self.center.copy()
+        return [
+            (sid, int(self.shard_versions.get(sid, 0)), self.center[ls:le].copy())
+            for (sid, _, _), (ls, le) in zip(self._owned, self._local_slices())
+        ]
+
+    def _quant_chunk(self, snapshot):
+        if self.quant == "off":
+            return snapshot
+        if isinstance(snapshot, list):
+            return [
+                (sid, ver, quantize(arr, self.quant)) for sid, ver, arr in snapshot
+            ]
+        return quantize(snapshot, self.quant)
+
+    def _apply_update(self, msg, easgd: bool) -> None:
+        """Apply an admitted push: the legacy whole-chunk axpy, or the
+        per-shard parts of a sharded envelope."""
+        with self._lock:
+            self._note("center")
+            self._note("version")
+            self._note("counts")
+            payload = msg.payload
+            if isinstance(payload, list):
+                self._apply_parts(payload, easgd)
+            elif easgd:
+                self.center += self.alpha * (np.asarray(payload) - self.center)
+            else:
+                self.center += self.server_lr * np.asarray(payload)
+            self.counts["push_easgd" if easgd else "push_delta"] += 1
+            self._updates_since_save += 1
+            self.version += 1
+            version = self.version
+        self._record_push(msg, version)
+
+    def _apply_parts(self, parts, easgd: bool) -> None:
+        """Per-shard apply (caller holds the lock). An EASGD part for a
+        *pending* shard seeds it (the payload IS the client's parameter
+        values, so the first full push after a repair materializes the
+        orphan slice — and the elastic pull below is then a no-op
+        against an identical center). A DOWNPOUR delta cannot seed a
+        shard and a part for a shard we do not own means the sender's
+        ring view is behind; both are dropped and counted — the client
+        re-offers to the current owner next round."""
+        for sid, arr in parts:
+            if sid in self._pending and easgd:
+                self._materialize(sid, arr, self.shard_versions.get(sid, 0))
+                self.counts["adopted_shards"] += 1
+            loc = self._shard_slice(sid)
+            if loc is None:
+                self.counts["misrouted_parts"] += 1
+                continue
+            ls, le = loc
+            if easgd:
+                self.center[ls:le] += self.alpha * (arr - self.center[ls:le])
+            else:
+                self.center[ls:le] += self.server_lr * arr
+            self.shard_versions[sid] = self.shard_versions.get(sid, 0) + 1
+
+    def _parse_shard_map(self, payload) -> Optional[tuple]:
+        """``(ring_version, members)`` from a SHARD_MAP envelope, or
+        None for a malformed one."""
+        if (
+            isinstance(payload, (tuple, list))
+            and len(payload) == 2
+            and isinstance(payload[0], int)
+            and isinstance(payload[1], (tuple, list))
+            and len(payload[1]) > 0
+            and all(isinstance(m, int) for m in payload[1])
+        ):
+            return int(payload[0]), tuple(int(m) for m in payload[1])
+        return None
+
+    def _handle_shard_map(self, msg) -> None:
+        """Adopt a new ring view: hand off shards the new ring assigns
+        elsewhere, mark newly-assigned ones pending. The ring version is
+        the idempotency key — every repairing client derives the same
+        ring from the same death, so the second and later announcements
+        of one view are no-ops."""
+        parsed = self._parse_shard_map(msg.payload)
+        if parsed is None:
+            with self._lock:
+                self._note("counts")
+                self.counts["malformed_dropped"] += 1
+            return
+        ring_version, members = parsed
+        with self._lock:
+            self._note("counts")
+            self.counts["shard_map"] += 1
+        if self._shard_map is None:
+            return  # flat server: no ring to update
+        if ring_version <= self._shard_map.ring.version:
+            return  # stale or duplicate view
+        new_ring = HashRing(
+            members, vnodes=self._shard_map.ring.vnodes, version=ring_version
+        )
+        new_map = self._shard_map.with_ring(new_ring)
+        mine = {sid for sid, _, _ in new_map.ranges_for(self.transport.rank)}
+        held = {sid for sid, _, _ in self._owned}
+        for sid in sorted(set(self._pending) - mine):
+            del self._pending[sid]  # never arrived and no longer ours
+        for sid in sorted(held - mine):
+            self._handoff_shard(sid, new_map.assignment[sid], ring_version)
+        for sid in sorted(mine - held - set(self._pending)):
+            s, e = new_map.layout[sid]
+            self._pending[sid] = (s, e)
+        self._shard_map = new_map
+        self._journal_dynamics(
+            "shard_map", view=ring_version, src=msg.src,
+            owned=len(self._owned), pending=len(self._pending), gen=self.gen,
+        )
+
+    def _handoff_shard(self, sid: int, dst: int, ring_version: int) -> None:
+        """Graceful slice exchange to the shard's new owner: data +
+        per-shard version + the dedup window travel together, so the new
+        owner rejects replays of pushes the old owner already applied.
+        The slice is dropped from ``center`` only after the transfer is
+        accepted by the transport — a failed send keeps the shard here,
+        and the next view announcement re-offers it (failure during
+        failure-handling degrades to a retry, never to data loss)."""
+        with self._lock:
+            self._note("center", write=False)
+            loc = self._shard_slice(sid)
+            if loc is None:
+                return
+            ls, le = loc
+            arr = self.center[ls:le].copy()
+            ver = int(self.shard_versions.get(sid, 0))
+            entries = self._dedup.state()
+        payload = (ring_version, sid, ver, arr, entries)
+        if not self._send_reshard(dst, payload):
+            return
+        with self._lock:
+            self._note("center")
+            self._note("counts")
+            self._drop_shard(sid)
+            self.counts["handoff_sent"] += 1
+        self._journal_dynamics(
+            "reshard", shard=sid, dst=dst, version=ver,
+            view=ring_version, gen=self.gen,
+        )
+
+    def _send_reshard(self, dst: int, payload) -> bool:
+        """Retry/backoff on the reshard transfer (the server-side twin
+        of PClient._send_with_retry; the (ring_version, shard) pair in
+        the payload plays the attempt-id role — the receiver ignores
+        duplicates and stale versions)."""
+        delay = 0.05
+        for attempt in range(3):
+            try:
+                self.transport.send(dst, TAG_RESHARD, payload)
+                return True
+            except (ConnectionError, OSError):
+                if attempt == 2:
+                    return False
+                time.sleep(delay)
+                delay *= 2
+        return False
+
+    def _parse_reshard(self, payload) -> Optional[tuple]:
+        """``(ring_version, shard, shard_version, chunk, dedup)`` from a
+        RESHARD envelope, or None for a malformed one (a chaos-mangled
+        transfer is dropped whole; the sender's re-offer repeats it)."""
+        if not (
+            isinstance(payload, (tuple, list))
+            and len(payload) == 5
+            and isinstance(payload[0], int)
+            and isinstance(payload[1], int)
+            and isinstance(payload[2], int)
+            and isinstance(payload[4], (list, tuple))
+        ):
+            return None
+        ring_version, sid, ver, chunk, entries = payload
+        if self._shard_map is not None:
+            if not (0 <= sid < self._shard_map.num_shards):
+                return None
+            try:
+                arr = np.asarray(chunk, dtype=np.float32)
+            except (TypeError, ValueError):
+                return None
+            s, e = self._shard_map.layout[sid]
+            if arr.shape != (e - s,):
+                return None
+            chunk = arr
+        return int(ring_version), int(sid), int(ver), chunk, entries
+
+    def _handle_reshard(self, msg) -> None:
+        """Install a handed-off shard: materialize the slice, take over
+        its version counter, absorb the old owner's dedup window. A
+        transfer for a shard that is not pending (duplicate, or a view
+        we have since moved past) is idempotently ignored."""
+        parsed = self._parse_reshard(msg.payload)
+        if parsed is None:
+            with self._lock:
+                self._note("counts")
+                self.counts["malformed_dropped"] += 1
+            return
+        ring_version, sid, ver, chunk, entries = parsed
+        with self._lock:
+            self._note("counts")
+            self.counts["reshard"] += 1
+        if self._shard_map is None or sid not in self._pending:
+            return
+        with self._lock:
+            self._note("center")
+            self._materialize(sid, chunk, ver)
+            self.counts["adopted_shards"] += 1
+        self._note("dedup")
+        self._dedup.absorb(entries)
+        self._journal_dynamics(
+            "reshard", shard=sid, src=msg.src, version=ver,
+            view=ring_version, gen=self.gen,
+        )
+
+    def owned_ranges(self) -> list:
+        """Ascending ``(sid, start, end)`` of materialized shards
+        (empty in legacy flat mode)."""
+        with self._lock:
+            return list(self._owned)
 
     def _admit_push(self, msg) -> bool:
         """Unwrap a push envelope, validate the chunk, and run the
@@ -617,7 +970,15 @@ class PServer:
         at-most-once: an unparseable update is dropped whole, never
         partially or wrongly applied. Quantized chunks are dequantized
         here (a truncated QuantArray dequantizes to the wrong length and
-        fails the shape check like any cut frame)."""
+        fails the shape check like any cut frame). Sharded-mode pushes
+        carry ``(sid, chunk)`` parts instead of one contiguous chunk —
+        each part is validated against its static layout slot."""
+        if (
+            self._shard_map is not None
+            and isinstance(chunk, (list, tuple))
+            and not isinstance(chunk, np.ndarray)
+        ):
+            return self._validate_parts(chunk)
         try:
             if isinstance(chunk, QuantArray):
                 chunk = dequantize(chunk)
@@ -627,6 +988,37 @@ class PServer:
         if arr.shape != self.center.shape:
             return None
         return arr
+
+    def _validate_parts(self, parts) -> Optional[list]:
+        """Validated ``[(sid, float32 array), ...]`` from a sharded push
+        chunk, or None when any part is malformed — all-or-nothing, the
+        same safe side of at-most-once as the contiguous path."""
+        if len(parts) == 0:
+            return None
+        out = []
+        for part in parts:
+            if not (
+                isinstance(part, (tuple, list))
+                and len(part) == 2
+                and isinstance(part[0], int)
+            ):
+                return None
+            sid, chunk = part
+            if not (0 <= sid < self._shard_map.num_shards):
+                return None
+            try:
+                if isinstance(chunk, QuantArray):
+                    chunk = dequantize(chunk)
+                # wire payloads are host numpy (msgpack-decoded), never
+                # device arrays — no host sync happens here
+                arr = np.asarray(chunk, dtype=np.float32)  # mpit-analysis: ignore[MPT005]
+            except (TypeError, ValueError):
+                return None
+            s, e = self._shard_map.layout[sid]
+            if arr.shape != (e - s,):
+                return None
+            out.append((int(sid), arr))  # mpit-analysis: ignore[MPT005]
+        return out
 
     def _maybe_persist(self) -> None:
         if (
@@ -653,9 +1045,30 @@ class PServer:
                 "gen": int(self.gen),
                 "dedup": self._dedup.state(),
                 "membership": self._membership.state(),
+                "shards": self._shards_state(),
+                "ring": self._ring_state(),
             }
             self._updates_since_save = 0
         return state
+
+    def _shards_state(self) -> Optional[list]:
+        """Materialized shard ownership as ``[sid, start, end,
+        shard_version]`` rows (None in legacy flat mode — the key is
+        written either way so the snapshot schema has one shape)."""
+        if self._shard_map is None:
+            return None
+        return [
+            [int(sid), int(s), int(e), int(self.shard_versions.get(sid, 0))]
+            for sid, s, e in self._owned
+        ]
+
+    def _ring_state(self) -> Optional[list]:
+        if self._shard_map is None:
+            return None
+        return [
+            int(self._shard_map.ring.version),
+            list(self._shard_map.ring.members),
+        ]
 
     def persist(self) -> None:
         """Atomically write the persistent snapshot (tmp + rename — a

@@ -93,6 +93,10 @@ _EXCHANGE_KEYS = (
     # elastic-membership churn (scripts/elastic_soak.sh legs): a run
     # that kills/respawns ranks measures recovery, not steady state
     "elastic_churn",
+    # sharded-PS topology: shard count and ring membership version both
+    # change who serves which slice — a resharded round is a different
+    # exchange, not a slower one
+    "ps_shards", "ring_version",
 )
 
 

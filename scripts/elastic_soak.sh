@@ -35,7 +35,9 @@ KILL_SEED="${2:-1234}"
 REPORT_DIR="${3:-soak_reports/$(date +%Y%m%d-%H%M%S)}"
 OUT="$(mktemp -d)"
 CKPT="$(mktemp -d)"
-trap 'rm -rf "$OUT" "$CKPT"' EXIT
+OUT2="$(mktemp -d)"
+CKPT2="$(mktemp -d)"
+trap 'rm -rf "$OUT" "$CKPT" "$OUT2" "$CKPT2"' EXIT
 
 GATE="$OUT/dynamics_gate.json"
 printf '{"staleness_p99_max": 256, "allow_diverging": false}\n' > "$GATE"
@@ -116,10 +118,89 @@ print(f"elastic_soak: postmortem names rank {mover} (killed) as "
       f"{len(rep['ranks'])} dumped window(s)")
 EOF
 
+# ---------------------------------------------------------------------------
+# Leg 2 — sharded server kill (docs/ROBUSTNESS.md "Shard ownership &
+# resharding"): 2 servers × 2 clients with MPIT_PS_SHARDS ring placement,
+# and the killer aimed ONLY at server rank 0. A server dying must be a
+# reshard, not an outage: clients declare it dead within seconds
+# (MPIT_PS_TIMEOUT), reroute its shards to the survivor (journaled as
+# reshard_repair with moved > 0), and finish training with zero skipped
+# rounds of lost coverage. Gates: the run exits 0, the reshard actually
+# happened, the dynamics gate stays green, and the post-mortem names the
+# killed SERVER as first-mover.
+echo "=== elastic soak: sharded server-kill leg (seed ${KILL_SEED}) ===" >&2
+env JAX_PLATFORMS=cpu \
+    MPIT_OBS_DIR="$OUT2" \
+    MPIT_ELASTIC_RESPAWN=1 \
+    MPIT_ELASTIC_CKPT_DIR="$CKPT2" \
+    MPIT_ELASTIC_CKPT_EVERY=2 \
+    MPIT_ELASTIC_KILL_EVERY_S=20 \
+    MPIT_ELASTIC_KILL_RANKS=0 \
+    MPIT_ELASTIC_KILL_SEED="$KILL_SEED" \
+    MPIT_ELASTIC_MAX_RESPAWNS=2 \
+    MPIT_ELASTIC_RESPAWN_DELAY_S=8 \
+    MPIT_PS_SHARDS=4 \
+    MPIT_PS_TIMEOUT=3 \
+    MPIT_PS_MAX_RETRIES=0 \
+    MPIT_CONNECT_RETRY_S=2 \
+    timeout -k 10 "$MAX_SECONDS" \
+    python -m mpit_tpu.launch -n 4 examples/ptest_proc.py \
+    --model mlp --steps 1600 --train-size 256 --algo ps-easgd --servers 2
+
+echo "=== elastic soak: sharded leg gates ===" >&2
+python -m mpit_tpu.obs dynamics "$OUT2" --gate "$GATE" --json \
+    > "$OUT2/dynamics.json"
+rc=0
+python -m mpit_tpu.obs postmortem "$OUT2" --json \
+    > "$OUT2/postmortem.json" || rc=$?
+if [[ $rc -ne 1 ]]; then
+    echo "elastic_soak: sharded-leg postmortem exited $rc (want 1):" \
+         "the server kill left no cross-rank incident" >&2
+    exit 1
+fi
+python - "$OUT2" <<'EOF'
+import glob, json, sys
+out = sys.argv[1]
+members = [json.loads(line) for line in open(f"{out}/membership.jsonl")]
+kills = [m for m in members if m.get("kind") == "kill"]
+if not kills:
+    sys.exit("elastic_soak: sharded leg never killed the server")
+if any(m["rank"] != 0 for m in kills):
+    sys.exit(f"elastic_soak: kill targeting broken — victims "
+             f"{sorted({m['rank'] for m in kills})}, want only rank 0")
+repairs = []
+for path in glob.glob(f"{out}/obs_rank*.jsonl"):
+    for line in open(path):
+        rec = json.loads(line)
+        if rec.get("ev") == "reshard_repair":
+            repairs.append(rec)
+moved = sum(r.get("moved", 0) for r in repairs)
+if not repairs or moved == 0:
+    sys.exit("elastic_soak: server killed but no reshard_repair was "
+             "journaled — clients skipped the round instead of "
+             "rerouting the dead server's shards")
+if any(r.get("dead") != 0 for r in repairs):
+    sys.exit(f"elastic_soak: repair named the wrong dead rank: {repairs}")
+rep = json.load(open(f"{out}/postmortem.json"))
+mover = rep["first_mover"].get("rank")
+if mover != 0:
+    sys.exit(f"elastic_soak: postmortem named rank {mover} as "
+             "first-mover, want the killed server (rank 0)")
+run = json.load(open(f"{out}/dynamics.json"))["run"]
+if run["versions_monotonic"] is False:
+    sys.exit("elastic_soak: sharded leg stepped a center version "
+             "backwards within a generation")
+print(f"elastic_soak: sharded leg — {len(kills)} server kill(s), "
+      f"{moved} shard(s) rerouted across {len(repairs)} repair(s), "
+      "postmortem blames the server, gate green")
+EOF
+
 # archive the evidence before the EXIT trap wipes the working dirs
 mkdir -p "$REPORT_DIR"
 cp "$OUT/postmortem.json" "$OUT/postmortem.txt" "$REPORT_DIR/"
 cp "$OUT/membership.jsonl" "$REPORT_DIR/" 2>/dev/null || true
 cp -r "$OUT/blackbox" "$REPORT_DIR/blackbox" 2>/dev/null || true
+cp "$OUT2/postmortem.json" "$REPORT_DIR/postmortem_sharded.json" 2>/dev/null || true
+cp "$OUT2/membership.jsonl" "$REPORT_DIR/membership_sharded.jsonl" 2>/dev/null || true
 echo "elastic_soak: post-mortem archived to $REPORT_DIR" >&2
 echo "elastic_soak: OK"

@@ -718,10 +718,13 @@ def test_mpt008_repo_roles_pair_up():
     roles = protocol_mod.extract_roles(project)
     assert set(roles) == {"client", "server"}
     client, server = roles["client"], roles["server"]
-    # FETCH/PUSH*/STOP/HEARTBEAT/JOIN/LEAVE
-    assert client.sent_tags == {1, 2, 3, 5, 6, 7, 8}
+    # FETCH/PUSH*/STOP/HEARTBEAT/JOIN/LEAVE/SHARD_MAP
+    assert client.sent_tags == {1, 2, 3, 5, 6, 7, 8, 9}
     assert client.sent_tags <= server.dispatch_tags
-    assert server.sent_tags == {4}  # TAG_PARAM
+    # TAG_PARAM to clients + TAG_RESHARD server-to-server (handoff);
+    # the server dispatches RESHARD itself, closing the intra-role pair
+    assert server.sent_tags == {4, 10}
+    assert 10 in server.dispatch_tags
     assert {op.tag for op in client.concrete_recvs} == {4}
     assert server.has_wildcard_recv
 

@@ -659,6 +659,29 @@ class TestBenchGate:
         tflags, tlabel = bg.trend(bg._load_rounds(str(tmp_path)), 0.10)
         assert tlabel == "" or "int8" in tlabel
 
+    def test_shard_topology_change_not_comparable(self, tmp_path, capsys):
+        """A resharded round (different shard count, or a ring-version
+        bump from churn) serves different slices from different servers
+        — score it as a new series, not a regression of the old one."""
+        bg = _bench_gate()
+        base = {"metric": "ps_exchange_throughput", "platform": "cpu",
+                "ps_shards": 8, "ring_version": 0}
+        _bench_round(tmp_path, 1, {**base, "value": 200.0})
+        _bench_round(tmp_path, 2, {**base, "value": 100.0,
+                                   "ps_shards": 16})
+        assert bg.main(["--strict", str(tmp_path)]) == 0
+        assert "not comparable" in capsys.readouterr().out
+        # same shard count but the ring churned: also a boundary
+        _bench_round(tmp_path, 3, {**base, "value": 100.0,
+                                   "ring_version": 2})
+        assert bg.main(["--strict", str(tmp_path)]) == 0
+        assert "not comparable" in capsys.readouterr().out
+        # identical topology on both sides still flags a real drop
+        _bench_round(tmp_path, 4, {**base, "value": 50.0,
+                                   "ring_version": 2})
+        assert bg.main(["--strict", str(tmp_path)]) == 1
+        assert "WARNING" in capsys.readouterr().out
+
     def test_fewer_than_two_rounds_is_clean(self, tmp_path, capsys):
         bg = _bench_gate()
         assert bg.main([str(tmp_path)]) == 0

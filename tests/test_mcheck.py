@@ -111,6 +111,7 @@ def test_shipped_semantics_extracted_exactly(shipped_sem):
     assert sem.dedup.symbol == "_DedupWindow.admit"
     assert sem.dedup.keyed_by_epoch  # (src, epoch) key, not src alone
     assert sem.snapshot_includes_dedup is True  # shard snapshot carries it
+    assert sem.handoff_includes_dedup is True  # reshard ships the window
     assert sem.reply_send.rel.endswith("parallel/pserver.py")
     assert sem.reply_recv.rel.endswith("parallel/pclient.py")
 
@@ -124,7 +125,7 @@ def test_shipped_protocol_is_clean_and_exhaustive(shipped_sem):
     fault kind contributing schedules."""
     results = mcheck.check_all(mcheck.from_protocol(shipped_sem))
     assert [r.config.algo for r in results] == [
-        "easgd", "downpour", "easgd-elastic"
+        "easgd", "downpour", "easgd-elastic", "easgd-sharded"
     ]
     for r in results:
         assert r.ok, (r.config.algo, r.violations)
@@ -160,6 +161,9 @@ def _mutate(sem, **kw):
         # shard snapshot persists the center but not the dedup window:
         # crash-restore re-applies an already-acked push
         ({"snapshot_includes_dedup": False}, "MPT009"),
+        # shard handoff ships the slice but forgets its dedup window:
+        # the new owner re-applies a push the old owner already acked
+        ({"handoff_carries_dedup": False}, "MPT009"),
     ],
 )
 def test_single_bit_mutations_each_caught(shipped_sem, mutation, rule):
@@ -264,7 +268,7 @@ def test_mcheck_cli_reports_state_counts():
     proc = _cli("mcheck", "--json")
     assert proc.returncode == 0, proc.stdout + proc.stderr
     doc = json.loads(proc.stdout)
-    assert len(doc) == 3  # easgd, downpour, easgd-elastic
+    assert len(doc) == 4  # easgd, downpour, easgd-elastic, easgd-sharded
     for entry in doc:
         assert entry["violations"] == {}
         assert entry["states"] > 10_000

@@ -1,0 +1,47 @@
+"""Property tier for the consistent-hash ring (optional: hypothesis).
+
+Randomized member sets and churn sequences against the two invariants
+the example-based pins in ``test_sharding.py`` can only sample:
+stability (keys owned by survivors never move on a leave) and
+canonicalization (enumeration order and duplicates never change the
+ring). Skipped wholesale when hypothesis is not installed — the
+deterministic pins still hold the line.
+"""
+
+import pytest
+
+pytest.importorskip("hypothesis", reason="property tier needs hypothesis")
+
+from hypothesis import given, settings, strategies as st  # noqa: E402
+
+from mpit_tpu.comm.topology import HashRing  # noqa: E402
+
+members_st = st.lists(
+    st.integers(min_value=0, max_value=31),
+    min_size=2, max_size=6, unique=True,
+)
+
+
+@settings(max_examples=25, deadline=None)
+@given(members=members_st, data=st.data())
+def test_leave_never_moves_survivor_keys(members, data):
+    leaver = data.draw(st.sampled_from(members))
+    ring = HashRing(members, vnodes=16)
+    shrunk = ring.without(leaver)
+    for k in range(64):
+        old = ring.owner(k)
+        if old != leaver:
+            assert shrunk.owner(k) == old
+        else:
+            assert shrunk.owner(k) != leaver
+
+
+@settings(max_examples=25, deadline=None)
+@given(members=members_st, data=st.data())
+def test_enumeration_order_is_canonicalized(members, data):
+    perm = data.draw(st.permutations(members))
+    a = HashRing(members, vnodes=16)
+    b = HashRing(list(perm) + [perm[0]], vnodes=16)  # dup too
+    assert a == b
+    for k in range(64):
+        assert a.owner(k) == b.owner(k)

@@ -8,7 +8,7 @@ Four layers:
 - the RULES going QUIET: each seeded MPT016/017/018 fixture, with its
   one bug fixed, lints clean (tests/test_analysis.py pins the firing
   direction; this file pins the silence direction);
-- the CLI: ``schema --json`` emits the full 8-tag table, ``--check``
+- the CLI: ``schema --json`` emits the full 10-tag table, ``--check``
   is clean against the checked-in wire-schema.lock.json and exits 1
   the moment the lock is mutated out from under it (the undeclared-
   protocol-drift gate, pinned by mutate-and-rescan);
@@ -52,9 +52,9 @@ def package_schema():
 # ------------------------------------------------------------------ model
 
 
-def test_all_eight_tags_have_both_halves(package_schema):
+def test_all_ten_tags_have_both_halves(package_schema):
     doc = package_schema.to_json()
-    assert sorted(doc["tags"], key=int) == [str(t) for t in range(1, 9)]
+    assert sorted(doc["tags"], key=int) == [str(t) for t in range(1, 11)]
     for tag, entry in doc["tags"].items():
         assert entry["sender"], f"tag {tag} has no sender schema"
         assert entry["receiver"], f"tag {tag} has no receiver schema"
@@ -63,11 +63,14 @@ def test_all_eight_tags_have_both_halves(package_schema):
 def test_push_envelope_shape(package_schema):
     doc = package_schema.to_json()
     by_name = {e["name"]: e for e in doc["tags"].values()}
-    # the EASGD/delta push envelope: (round, seq, epoch, chunk) where
-    # the chunk is a raw array or its quantized form
+    # the EASGD/delta push envelope: (epoch, seq, basis, chunk) where
+    # the chunk is a raw array, its quantized form, or — sharded — the
+    # per-shard parts list (docs/WIRE.md "Sharded-PS envelopes"); the
+    # `?` is the coalesced-chunk build the classifier can't resolve
     for name in ("TAG_PUSH_EASGD", "TAG_PUSH_DELTA"):
         assert by_name[name]["sender"] == [
-            "(int, int, int, ndarray|quant)"
+            "(int, int, int, ?|ndarray|quant)",
+            "(int, int, int, list)",
         ], by_name[name]
     # control tags carry None and the receiver ignores the payload
     for name in ("TAG_STOP", "TAG_HEARTBEAT", "TAG_LEAVE"):
@@ -150,7 +153,7 @@ def test_cli_schema_json_emits_all_tags():
     assert r.returncode == 0, r.stderr
     doc = json.loads(r.stdout)
     assert doc["version"] == schema_mod.SCHEMA_LOCK_VERSION
-    assert sorted(doc["tags"], key=int) == [str(t) for t in range(1, 9)]
+    assert sorted(doc["tags"], key=int) == [str(t) for t in range(1, 11)]
     for entry in doc["tags"].values():
         assert entry["sender"] and entry["receiver"]
 
@@ -158,7 +161,7 @@ def test_cli_schema_json_emits_all_tags():
 def test_cli_schema_check_clean_against_committed_lock():
     r = _cli("schema", "--check")
     assert r.returncode == 0, r.stdout + r.stderr
-    assert "8 tag(s) match" in r.stdout
+    assert "10 tag(s) match" in r.stdout
 
 
 def test_cli_schema_check_fails_on_undeclared_drift(tmp_path):

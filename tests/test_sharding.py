@@ -1,0 +1,419 @@
+"""Sharded parameter servers: ring, reshard schedule, repair under churn.
+
+The consistent-hash ring (`comm/topology.py`, docs/ROBUSTNESS.md "Shard
+ownership & resharding") decides which server owns which slice of the
+flat parameter vector; membership churn moves *ownership*, never the cut
+points. These tests pin the ring's contract (deterministic across
+processes, minimal movement on churn, insensitive to member enumeration
+order), the slice-exchange schedule's peak-memory bound (a resharding
+server holds its old slice plus the incoming one — never a full model
+duplicate), the per-destination scatter coalescing, and the full
+failure-during-failure story over the wire: a server killed mid-run is a
+repair (rerouted chunks, adopted shards) rather than a skipped round,
+and exactly-once survives both graceful handoff and crash-restore.
+"""
+
+import json
+import shutil
+import subprocess
+import sys
+import time
+
+import numpy as np
+import pytest
+
+from mpit_tpu.comm.topology import (
+    HashRing,
+    ShardMap,
+    reshard_schedule,
+    schedule_peak_elems,
+    shard_layout,
+)
+from mpit_tpu.parallel.pclient import PClient
+from mpit_tpu.parallel.pserver import (
+    TAG_PUSH_EASGD,
+    TAG_SHARD_MAP,
+    TAG_STOP,
+    PServer,
+    spawn_server_thread,
+)
+from mpit_tpu.transport import Broker
+
+DIM = 97
+NSHARDS = 6
+
+
+def _flat():
+    return np.arange(DIM, dtype=np.float32)
+
+
+def _shard_map(members=(0, 1)):
+    return ShardMap(HashRing(members), DIM, NSHARDS)
+
+
+def _owned_concat(flat0, sm, r):
+    rng = sm.ranges_for(r)
+    if not rng:
+        return np.zeros(0, np.float32)
+    return np.concatenate([flat0[s:e] for _, s, e in rng])
+
+
+# ------------------------------------------------------------------ ring
+
+
+class TestHashRing:
+    def test_deterministic_across_processes(self):
+        """Every client and server must derive the same assignment from
+        the same member set with no coordination — so the ring may never
+        lean on Python's per-process randomized ``hash()``. A fresh
+        interpreter with a different forced hash seed must agree."""
+        want = ShardMap(HashRing([0, 1, 2]), 300, 12).assignment
+        code = (
+            "import json;"
+            "from mpit_tpu.comm.topology import HashRing, ShardMap;"
+            "print(json.dumps(ShardMap(HashRing([0,1,2]),300,12)"
+            ".assignment))"
+        )
+        import os
+
+        env = dict(os.environ)
+        env["JAX_PLATFORMS"] = "cpu"
+        env["PYTHONHASHSEED"] = "12345"  # would flip a hash()-based ring
+        proc = subprocess.run(
+            [sys.executable, "-c", code],
+            capture_output=True, text=True, env=env, timeout=120,
+        )
+        assert proc.returncode == 0, proc.stderr
+        assert tuple(json.loads(proc.stdout)) == want
+
+    def test_assignment_pin(self):
+        """Golden pin: a wire-visible constant (rides TAG_SHARD_MAP), so
+        a hash-function change must be a deliberate, versioned event."""
+        assert _shard_map().assignment == (1, 1, 0, 1, 0, 1)
+
+    def test_member_enumeration_order_is_irrelevant(self):
+        """Membership arrives as dict keys / set iteration in places —
+        the ring must canonicalize, not trust enumeration order."""
+        a = HashRing([0, 1, 2])
+        b = HashRing([2, 0, 1, 0])  # permuted, with a duplicate
+        assert a == b and a.members == b.members
+        for k in range(200):
+            assert a.owner(k) == b.owner(k)
+
+    def test_leave_moves_only_the_leavers_keys(self):
+        """Consistent hashing's whole point: removing one of N members
+        relocates only the keys the leaver owned (~1/N), everything else
+        stays put — this is what bounds reshard traffic under churn."""
+        keys = range(300)
+        ring = HashRing([0, 1, 2])
+        shrunk = ring.without(1)
+        moved = 0
+        for k in keys:
+            old = ring.owner(k)
+            if old == 1:
+                moved += 1
+                assert shrunk.owner(k) in (0, 2)
+            else:
+                assert shrunk.owner(k) == old  # survivor keys never move
+        assert 0 < moved <= 150  # ~1/3 of 300; far from a full reshuffle
+
+    def test_join_after_leave_restores_exactly(self):
+        ring = HashRing([0, 1, 2])
+        back = ring.without(1).with_member(1)
+        assert back == ring
+        for k in range(200):
+            assert back.owner(k) == ring.owner(k)
+        assert back.version == ring.version + 2  # churn still visible
+
+    def test_version_bumps_on_every_membership_change(self):
+        ring = HashRing([0, 1])
+        assert ring.version == 0
+        assert ring.with_member(2).version == 1
+        assert ring.without(0).version == 1
+
+
+# ------------------------------------------------------ reshard schedule
+
+
+class TestReshardSchedule:
+    def test_moves_cover_exactly_the_leavers_shards(self):
+        old = ShardMap(HashRing([0, 1, 2]), 300, 12)
+        new = old.with_ring(old.ring.without(1))
+        moves = reshard_schedule(old, new)
+        assert {m["shard"] for m in moves} == {
+            sid for sid in range(12) if old.assignment[sid] == 1
+        }
+        for m in moves:
+            assert m["src"] == 1 and m["dst"] in (0, 2)
+            assert m["size"] == old.shard_size(m["shard"])
+
+    def test_peak_memory_is_old_slice_plus_incoming(self):
+        """The acceptance bound: executing the schedule in order, no
+        server ever materializes more than its old slice plus what it is
+        adopting — never a full-model duplicate."""
+        old = ShardMap(HashRing([0, 1, 2]), 300, 12)
+        new = old.with_ring(old.ring.without(1))
+        moves = reshard_schedule(old, new)
+        peak = schedule_peak_elems(moves, old)
+        incoming = {r: 0 for r in old.ring.members}
+        for m in moves:
+            incoming[m["dst"]] += m["size"]
+        for r in old.ring.members:
+            assert peak[r] <= old.owned_size(r) + incoming[r]
+            assert peak[r] < old.param_size  # never the full model
+        assert peak[1] == old.owned_size(1)  # the source never grows
+        # and the end state is exactly the new ownership
+        assert sum(new.owned_size(r) for r in (0, 2)) == 300
+
+    def test_layout_mismatch_rejected(self):
+        a = ShardMap(HashRing([0, 1]), 300, 12)
+        b = ShardMap(HashRing([0, 1]), 301, 12)
+        with pytest.raises(ValueError, match="identical layout"):
+            reshard_schedule(a, b)
+
+    def test_layout_is_contiguous_and_near_equal(self):
+        bounds = shard_layout(97, 6)
+        assert bounds[0][0] == 0 and bounds[-1][1] == 97
+        sizes = [e - s for s, e in bounds]
+        assert max(sizes) - min(sizes) <= 1
+        for (_, e), (s2, _) in zip(bounds, bounds[1:]):
+            assert e == s2
+
+
+# ------------------------------------- per-destination scatter coalescing
+
+
+class TestScatterCoalescing:
+    def test_non_adjacent_chunks_same_rank(self):
+        """The lifted restriction: ranks ``[0, 1, 0]`` used to raise —
+        now all chunks bound for one destination coalesce into a single
+        send/recv pair regardless of adjacency."""
+        tps = Broker(3).transports()
+        flat0 = np.arange(12, dtype=np.float32)
+        s0 = PServer(
+            tps[0], np.concatenate([flat0[0:4], flat0[8:12]]), 1
+        )
+        s1 = PServer(tps[1], flat0[4:8], 1)
+        t0, t1 = spawn_server_thread(s0), spawn_server_thread(s1)
+        c = PClient(tps[2], [0, 1, 0], 12, timeout=5)
+        assert c.ranks == [0, 1]
+        assert c._rank_chunks == {0: [(0, 4), (8, 12)], 1: [(4, 8)]}
+        np.testing.assert_allclose(c.fetch(), flat0)
+        c.push_easgd(flat0)  # push == center: a no-op update
+        np.testing.assert_allclose(c.fetch(), flat0)
+        c.stop()
+        t0.join(5)
+        t1.join(5)
+        assert not t0.is_alive() and not t1.is_alive()
+        assert s0.error is None and s1.error is None
+        # ONE push per destination, though rank 0 serves two chunks
+        assert s0.counts["push_easgd"] == 1
+        assert s1.counts["push_easgd"] == 1
+
+
+# --------------------------------------------- sharded wire: happy path
+
+
+class TestShardedProtocol:
+    def test_fetch_and_easgd_round_trip(self):
+        """Two servers, ring-routed shards: fetch reassembles the flat
+        vector exactly, and an EASGD push moves every shard's center by
+        alpha toward the pushed params — byte-identical to the single-
+        server math, just cut along the static layout."""
+        flat0 = _flat()
+        tps = Broker(4).transports()
+        s0 = PServer(
+            tps[0], _owned_concat(flat0, _shard_map(), 0), 2,
+            client_ranks=[2, 3], shard_map=_shard_map(),
+        )
+        s1 = PServer(
+            tps[1], _owned_concat(flat0, _shard_map(), 1), 2,
+            client_ranks=[2, 3], shard_map=_shard_map(),
+        )
+        t0, t1 = spawn_server_thread(s0), spawn_server_thread(s1)
+        c2 = PClient(tps[2], [0, 1], DIM, timeout=5,
+                     shard_map=_shard_map())
+        c3 = PClient(tps[3], [0, 1], DIM, timeout=5,
+                     shard_map=_shard_map())
+        np.testing.assert_allclose(c2.fetch(), flat0)
+        c2.push_easgd(flat0)  # center == push: no-op
+        c3.push_easgd(np.zeros(DIM, np.float32))
+        # alpha=0.5 pulls every shard's center halfway toward zero
+        np.testing.assert_allclose(c3.fetch(), flat0 * 0.5)
+        c2.stop()
+        c3.stop()
+        t0.join(5)
+        t1.join(5)
+        assert s0.counts["push_easgd"] == 2
+        assert s1.counts["push_easgd"] == 2
+        assert s0.error is None and s1.error is None
+
+
+# ------------------------------------ failure during failure: the point
+
+
+class TestKillRepair:
+    def test_killed_server_is_a_reshard_not_an_outage(self, tmp_path):
+        """One of two servers dies mid-training. The round must NOT be
+        skipped: each client times out on the dead rank, drops it from
+        its ring view, re-offers the failed chunks to the surviving
+        owner (``repaired_chunks``), and the survivor adopts the orphan
+        shards from the push payloads. Then the killed server's
+        snapshot is restored — and a replayed pre-kill push must still
+        be a dup, because the dedup window rode the snapshot."""
+        flat0 = _flat()
+        path = str(tmp_path / "shard_1.msgpack")
+        killed = str(tmp_path / "shard_1.killed.msgpack")
+        tps = Broker(4).transports()
+        s0 = PServer(
+            tps[0], _owned_concat(flat0, _shard_map(), 0), 2,
+            client_ranks=[2, 3], shard_map=_shard_map(),
+        )
+        s1 = PServer(
+            tps[1], _owned_concat(flat0, _shard_map(), 1), 2,
+            client_ranks=[2, 3], shard_map=_shard_map(),
+            ckpt_path=path, ckpt_every=1,
+        )
+        t0, t1 = spawn_server_thread(s0), spawn_server_thread(s1)
+        c2 = PClient(tps[2], [0, 1], DIM, timeout=0.3, max_retries=0,
+                     shard_map=_shard_map())
+        c3 = PClient(tps[3], [0, 1], DIM, timeout=0.3, max_retries=0,
+                     shard_map=_shard_map())
+        local2, local3 = flat0.copy(), flat0.copy()
+
+        # healthy round: both clients' seq 1 admitted at both servers
+        for c, loc in ((c2, local2), (c3, local3)):
+            c.fetch(fallback=loc)
+            c.push_easgd(loc)
+        deadline = time.monotonic() + 5
+        while s1.counts["push_easgd"] < 2 and time.monotonic() < deadline:
+            time.sleep(0.01)
+        assert s1.counts["push_easgd"] == 2
+
+        # preempt server 1 (both clients' transports release teardown);
+        # freeze its snapshot BEFORE the stops rewrite membership
+        shutil.copy(path, killed)
+        tps[2].send(1, TAG_STOP, None)
+        tps[3].send(1, TAG_STOP, None)
+        t1.join(5)
+        assert not t1.is_alive()
+
+        # post-kill rounds: never an exception, never a skipped round
+        skipped = 0
+        for _ in range(3):
+            for c, loc in ((c2, local2), (c3, local3)):
+                try:
+                    c.fetch(fallback=loc)
+                    c.push_easgd(loc)
+                except Exception:
+                    skipped += 1
+        assert skipped == 0
+        assert c2.repaired_chunks > 0 and c3.repaired_chunks > 0
+        assert s0.counts["adopted_shards"] > 0
+        assert len(s0.owned_ranges()) == NSHARDS  # survivor owns it all
+        assert c2.fetch(fallback=local2).shape == (DIM,)
+        c2.stop()
+        c3.stop()
+        t0.join(5)
+        assert s0.error is None
+
+        # restore the killed server from its frozen snapshot: the dedup
+        # window came back with the center, so the pre-kill (epoch, 1)
+        # push is STILL a replay — crash-restore cannot double-apply
+        tps2 = Broker(4).transports()
+        revived = PServer(
+            tps2[1], _owned_concat(flat0, _shard_map(), 1), 2,
+            client_ranks=[2, 3], shard_map=_shard_map(),
+            ckpt_path=killed, ckpt_every=1,
+        )
+        t1b = spawn_server_thread(revived)
+        assert revived.restored
+        parts = [
+            (sid, local2[s:e])
+            for sid, s, e in revived.owned_ranges()
+        ]
+        tps2[2].send(1, TAG_PUSH_EASGD, (c2._epoch, 1, 0, parts))
+        deadline = time.monotonic() + 5
+        while (
+            revived.counts["dup_dropped"] < 1
+            and time.monotonic() < deadline
+        ):
+            time.sleep(0.01)
+        assert revived.counts["dup_dropped"] == 1
+        assert revived.counts["push_easgd"] == 0
+        # ...while a FRESH seq under the same epoch applies normally
+        tps2[2].send(1, TAG_PUSH_EASGD, (c2._epoch, 99, 0, parts))
+        tps2[2].send(1, TAG_STOP, None)
+        tps2[3].send(1, TAG_STOP, None)
+        t1b.join(5)
+        assert not t1b.is_alive() and revived.error is None
+        assert revived.counts["push_easgd"] == 1
+
+
+class TestGracefulHandoff:
+    def test_handoff_carries_the_dedup_window(self, ):
+        """A TAG_SHARD_MAP announce moves shards to a joining server via
+        TAG_RESHARD slice exchanges. Exactly-once must survive the
+        handoff: a push the OLD owner already admitted is a dup at the
+        NEW owner too — the window travels with the slice (the seeded
+        mcheck mutation ``handoff_carries_dedup=False`` is exactly this
+        bug, caught as MPT009)."""
+        flat0 = _flat()
+        sm0 = ShardMap(HashRing([0]), DIM, NSHARDS)
+        tps = Broker(4).transports()
+        s0 = PServer(tps[0], flat0.copy(), 2, client_ranks=[2, 3],
+                     shard_map=ShardMap(HashRing([0]), DIM, NSHARDS))
+        s1 = PServer(tps[1], np.zeros(0, np.float32), 2,
+                     client_ranks=[2, 3],
+                     shard_map=ShardMap(HashRing([0]), DIM, NSHARDS))
+        t0, t1 = spawn_server_thread(s0), spawn_server_thread(s1)
+        c2 = PClient(tps[2], [0], DIM, timeout=2,
+                     shard_map=ShardMap(HashRing([0]), DIM, NSHARDS))
+        c2.fetch()
+        c2.push_easgd(flat0)  # admitted at server 0 as (epoch, seq=1)
+
+        # membership change: rank 1 joins the ring → ownership moves
+        ring1 = sm0.ring.with_member(1)
+        announce = (ring1.version, list(ring1.members))
+        tps[2].send(0, TAG_SHARD_MAP, announce)
+        tps[2].send(1, TAG_SHARD_MAP, announce)
+        sm1 = sm0.with_ring(ring1)
+        moved = [
+            sid for sid in range(NSHARDS) if sm1.assignment[sid] == 1
+        ]
+        assert moved  # the join must actually relocate something
+        deadline = time.monotonic() + 5
+        while (
+            s1.counts["reshard"] < len(moved)
+            and time.monotonic() < deadline
+        ):
+            time.sleep(0.01)
+        assert s0.counts["handoff_sent"] == len(moved)
+        assert s1.counts["reshard"] == len(moved)
+        assert len(s0.owned_ranges()) == NSHARDS - len(moved)
+        assert len(s1.owned_ranges()) == len(moved)
+
+        # replay the already-admitted push AT THE NEW OWNER: still a dup
+        parts = [
+            (sid, flat0[s:e])
+            for sid, (s, e) in enumerate(sm1.layout)
+            if sid in moved
+        ]
+        tps[2].send(1, TAG_PUSH_EASGD, (c2._epoch, 1, 0, parts))
+        deadline = time.monotonic() + 5
+        while (
+            s1.counts["dup_dropped"] < 1 and time.monotonic() < deadline
+        ):
+            time.sleep(0.01)
+        assert s1.counts["dup_dropped"] == 1
+        assert s1.counts["push_easgd"] == 0
+        # a fresh seq is new work, not a replay
+        tps[2].send(1, TAG_PUSH_EASGD, (c2._epoch, 2, 0, parts))
+        for dst in (0, 1):
+            tps[2].send(dst, TAG_STOP, None)
+            tps[3].send(dst, TAG_STOP, None)
+        t0.join(5)
+        t1.join(5)
+        assert not t0.is_alive() and not t1.is_alive()
+        assert s0.error is None and s1.error is None
+        assert s1.counts["push_easgd"] == 1

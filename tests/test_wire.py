@@ -341,10 +341,15 @@ class TestCoalescedScatter:
         thread.join(timeout=5)
         assert server.error is None
 
-    def test_non_adjacent_repeat_rejected(self):
+    def test_non_adjacent_repeat_accepted(self):
+        # the old non-adjacent restriction is lifted: ring placement can
+        # hand one rank non-contiguous chunks, and they coalesce into one
+        # message per destination (behavior pinned end-to-end in
+        # tests/test_sharding.py::TestScatterCoalescing)
         tps = Broker(3).transports()
-        with pytest.raises(ValueError, match="non-adjacent"):
-            PClient(tps[2], [0, 1, 0], 12)
+        client = PClient(tps[2], [0, 1, 0], 12)
+        assert client.ranks == [0, 1]
+        assert client._rank_chunks[0] == [(0, 4), (8, 12)]
 
     def test_dedup_holds_across_coalesced_envelope(self):
         tps, server, thread = self._world()
