@@ -1332,6 +1332,117 @@ def bench_load(cpu_smoke: bool = False, seed: int = 0) -> dict:
     }
 
 
+def bench_fleet_load(
+    cpu_smoke: bool = False, seed: int = 0, n_replicas: int = 3,
+    policy: str = "p2c",
+) -> dict:
+    """The fleet variant of :func:`bench_load`: the same seeded open-loop
+    workload offered to a ``mpit_tpu.fleet`` router over ``n_replicas``
+    in-process replicas instead of one Server. e2e/goodput/tokens come
+    from the ROUTER journal (admission-to-ack, the number a client
+    feels); TTFT/TPOT come from the replica journals pooled per-replica
+    (replica rid spaces collide, so they aggregate separately and the
+    histograms merge). ``replica_count``/``router_policy`` ride the JSON
+    line as comparability keys — scripts/bench_gate.py never trends a
+    3-replica round against a 1-replica round.
+    """
+    import glob
+    import tempfile
+
+    import jax
+    import jax.numpy as jnp
+
+    from mpit_tpu.fleet import FleetHarness, audit_lifecycle
+    from mpit_tpu.loadgen import (
+        LoadSpec, aggregate_paths, make_workload, pooled_latencies,
+    )
+    from mpit_tpu.models import Server
+    from mpit_tpu.models.transformer import TransformerLM
+    from mpit_tpu.obs.core import ObsConfig
+
+    # same workload shapes as bench_load, cancellations off (the fleet
+    # wire has no CANCEL lane)
+    if cpu_smoke:
+        dims = dict(vocab_size=101, num_layers=2, d_model=32,
+                    num_heads=4, max_len=64)
+        spec = LoadSpec(requests=12, rate=500.0, seed=seed,
+                        cancel_prob=0.0)
+        max_batch, segment = 2, 8
+    else:
+        dims = dict(vocab_size=10_000, num_layers=6, d_model=768,
+                    num_heads=12, max_len=512)
+        spec = LoadSpec(
+            requests=48, rate=50.0, seed=seed, cancel_prob=0.0,
+            prompt_buckets=((8, 48, 0.6), (48, 128, 0.4)),
+            output_buckets=((16, 64, 0.6), (64, 160, 0.4)),
+        )
+        max_batch, segment = 8, 32
+    model = TransformerLM(**dims)
+    params = model.init(
+        jax.random.key(0), jnp.zeros((1, 8), jnp.int32)
+    )["params"]
+    work = make_workload(spec, dims["vocab_size"],
+                         max_len=dims["max_len"])
+
+    # warmup drain: replicas share this process's compile cache, so one
+    # drain of every bucket shape warms the whole fleet
+    warm = Server(model, params, max_batch=max_batch, segment=segment)
+    for r in work:
+        warm.submit(list(r.prompt), r.max_new)
+    warm.drain()
+
+    with tempfile.TemporaryDirectory() as out:
+        rep_dirs = {}
+
+        def factory(rank):
+            d = os.path.join(out, f"rep{rank}")
+            os.makedirs(d, exist_ok=True)
+            rep_dirs[rank] = d
+            return Server(model, params, max_batch=max_batch,
+                          segment=segment, obs=ObsConfig(dir=d))
+
+        router_dir = os.path.join(out, "router")
+        os.makedirs(router_dir)
+        fleet = FleetHarness(
+            factory, work, n_replicas=n_replicas, policy=policy,
+            seed=seed, obs_dir=router_dir,
+        )
+        rep = fleet.run()
+        router_paths = sorted(
+            glob.glob(os.path.join(router_dir, "obs_rank*.jsonl"))
+        )
+        report = aggregate_paths(router_paths)
+        audit = audit_lifecycle(router_paths)
+        lat = pooled_latencies(
+            sorted(glob.glob(os.path.join(d, "obs_rank*.jsonl")))
+            for d in rep_dirs.values()
+        )
+    tps = report["tokens_per_sec"]
+    return {
+        "tokens_per_sec": (
+            float(tps) if tps is not None
+            else report["tokens"] / max(rep.wall_s, 1e-9)
+        ),
+        "requests": spec.requests,
+        "rate": spec.rate,
+        "seed": seed,
+        "max_batch": max_batch,
+        "segment": segment,
+        "replica_count": n_replicas,
+        "router_policy": policy,
+        "ttft_p50_ms": lat["ttft"].get("p50_ms"),
+        "ttft_p99_ms": lat["ttft"].get("p99_ms"),
+        "tpot_p50_ms": lat["tpot"].get("p50_ms"),
+        "e2e_p99_ms": report["e2e"].get("p99_ms"),
+        "goodput": report["goodput"],
+        "finished": report["requests"]["finished"],
+        "unfinished": report["requests"]["unfinished"],
+        "lost": len(audit["lost"]),
+        "audit_ok": bool(audit["ok"]),
+        "model": "transformer-large" if not cpu_smoke else "tiny",
+    }
+
+
 def bench_spec(cpu_smoke: bool = False, k: int = 4) -> dict:
     """Speculative-decoding throughput: greedy tokens/sec of
     ``generate_speculative`` vs the plain cached decode on the SAME
@@ -1634,6 +1745,27 @@ def main():
 
     if "--load" in sys.argv:
         seed = int(flag_arg("--seed") or 0)
+        fleet = flag_arg("--fleet")
+        if fleet is not None:
+            n = int(fleet)
+            if n < 1:
+                print("--fleet requires N >= 1", file=sys.stderr)
+                raise SystemExit(2)
+            policy = flag_arg("--policy") or "p2c"
+            with trace(profile_dir):
+                res = bench_fleet_load(
+                    cpu_smoke=cpu, seed=seed, n_replicas=n,
+                    policy=policy,
+                )
+            emit_tokens_metric(
+                "serve_load_tokens_per_sec", f"serve-load-fleet{n}", res,
+                ("requests", "rate", "seed", "max_batch", "segment",
+                 "replica_count", "router_policy", "finished",
+                 "unfinished", "lost", "audit_ok", "model"),
+                ("ttft_p50_ms", "ttft_p99_ms", "tpot_p50_ms",
+                 "e2e_p99_ms", "goodput"),
+            )
+            return
         with trace(profile_dir):
             res = bench_load(cpu_smoke=cpu, seed=seed)
         emit_tokens_metric(

@@ -17,8 +17,10 @@ then lints the result.
 Subcommands:
 
 ``python -m mpit_tpu.analysis mcheck [--package PATH]``
-    Run only the protocol model check and print per-configuration state
-    counts — the exhaustiveness receipt behind MPT009–011.
+    Run only the protocol model checks and print per-configuration state
+    counts — the exhaustiveness receipt behind MPT009–011, plus the
+    ``fleet-route`` configuration (MPT019: no routed request lost under
+    a single replica kill) when the serving-fleet roles are in the scan.
 
 ``python -m mpit_tpu.analysis conform <obs-dir> [--package PATH]``
     Replay an observability run (``obs_rank*.jsonl`` + ``faults*.jsonl``)
@@ -95,7 +97,8 @@ def _main_mcheck(argv) -> int:
     if not Path(args.package).exists():
         print(f"error: no such path: {args.package}", file=sys.stderr)
         return 2
-    sem = protocol.extract_semantics(_load_project(args.package))
+    project = _load_project(args.package)
+    sem = protocol.extract_semantics(project)
     if sem is None or not sem.has_fault_machinery:
         print(
             "error: no fault-tolerant protocol pair extracted from "
@@ -105,6 +108,11 @@ def _main_mcheck(argv) -> int:
         )
         return 2
     results = mcheck.check_all(mcheck.from_protocol(sem))
+    fsem = protocol.extract_fleet_semantics(project)
+    if fsem is not None:
+        results.append(
+            mcheck.check_fleet(mcheck.fleet_from_protocol(fsem))
+        )
     bad = False
     if args.json:
         print(json.dumps([

@@ -1222,3 +1222,207 @@ def check_all(sem: ModelSemantics, configs=None, quick: bool = False) -> list:
             # exactly-once across ownership moves too
             configs = tuple(configs) + (sharded_config(quick),)
     return [check(sem, cfg) for cfg in configs]
+
+
+# ---------------------------------------------------------------------------
+# the serving-fleet routing model (MPT019)
+#
+# A different conversation from the PS pair, so a different model: one
+# router admits R requests and routes each to one of S replicas; a
+# replica that receives a ROUTE answers with a REPLY; the single fault
+# is a replica KILL (at most one, never the last replica standing),
+# which silently discards every message to or from the dead rank —
+# including a consumed-but-unreplied request, the orphan the redispatch
+# path exists for. The property checked is the soak gate's invariant in
+# model form: **no admitted request is both lost and unacked** — every
+# routed request reaches finished in every schedule, with the kill
+# allowed anywhere. Recovery requires BOTH extracted facts: a
+# redispatch send path (``redispatch_on_death``) and a timeout on the
+# router's reply recv (``reply_recv_timeout`` — a router blocked forever
+# on a dead replica's reply never reaches its redispatch code).
+#
+# state = (reqs, alive, net, kill_available)
+#   req   = (status, assignee)   status 0 unrouted / 1 routed / 2 done;
+#           assignee = replica rank (model index), -1 while unrouted
+#   alive = tuple of bools per replica
+#   msg   = the shared 6-tuple shape: (K_REQ, -1, s, rid, 0, 0) for
+#           ROUTE, (K_REP, s, -1, rid, 0, 0) for REPLY (router = -1) —
+#           _canon/_deliverable apply unchanged
+#
+# The weight lanes (13/14) and STOP are not modeled: they carry no
+# request-lifecycle obligation (installs are idempotent, teardown is
+# never faulted — same stance as the PS model's STOP).
+
+
+@dataclasses.dataclass(frozen=True)
+class FleetModelSemantics:
+    """The two extracted facts the fleet model branches on."""
+
+    redispatch_on_death: bool = True
+    reply_timeout: bool = True
+
+    @property
+    def can_recover(self) -> bool:
+        return self.redispatch_on_death and self.reply_timeout
+
+
+def fleet_from_protocol(fsem) -> FleetModelSemantics:
+    """FleetModelSemantics from a ``protocol.FleetSemantics``."""
+    return FleetModelSemantics(
+        redispatch_on_death=fsem.redispatch_on_death,
+        reply_timeout=fsem.reply_recv_timeout,
+    )
+
+
+def fleet_config(quick: bool = False) -> ModelConfig:
+    """The fleet acceptance configuration: 1 router x 2 replicas (the
+    minimum where a kill leaves a survivor to redispatch to), 3 requests
+    (2 quick) — enough that the kill can land before, between and after
+    routes. ``script``/``window``/``kinds`` are unused by the fleet
+    explorer; ``rounds`` counts requests."""
+    return ModelConfig(
+        algo="fleet-route",
+        script=("route",),
+        clients=1,
+        servers=2,
+        rounds=2 if quick else 3,
+        kinds=(),
+    )
+
+
+def _fleet_terminal(state) -> bool:
+    return all(r[0] == 2 for r in state[0])
+
+
+def _fleet_successors(state, fsem, cfg, viol, points):
+    reqs, alive, net, kill_avail = state
+    out = []
+    # admit+route the next unrouted request (admission order) to each
+    # live replica — the policy is nondeterministic here; every policy's
+    # choice is some schedule
+    for rid, (status, _a) in enumerate(reqs):
+        if status == 0:
+            for s, up in enumerate(alive):
+                if up:
+                    out.append((
+                        _set(reqs, rid, (1, s)),
+                        alive,
+                        net + ((K_REQ, -1, s, rid, 0, 0),),
+                        kill_avail,
+                    ))
+            break
+    # deliveries
+    for i in _deliverable(net):
+        m = net[i]
+        rest = net[:i] + net[i + 1:]
+        kind, rid = m[0], m[3]
+        if kind == K_REQ:
+            s = m[2]
+            if not alive[s]:  # raced a kill; the filter owns this
+                out.append((reqs, alive, rest, kill_avail))
+            else:  # replica consumes the route, its reply takes wing
+                out.append((
+                    reqs, alive,
+                    rest + ((K_REP, s, -1, rid, 0, 0),),
+                    kill_avail,
+                ))
+        elif kind == K_REP:
+            status, assignee = reqs[rid]
+            if status == 1 and assignee == m[1]:
+                out.append((
+                    _set(reqs, rid, (2, assignee)), alive, rest,
+                    kill_avail,
+                ))
+            else:  # a redispatched rid's late original reply: dropped
+                out.append((reqs, alive, rest, kill_avail))
+    # the kill fault: one replica, never the last one standing; every
+    # message to or from the dead rank dies with it (a consumed-but-
+    # unreplied request becomes an orphan via its discarded REPLY)
+    if kill_avail and sum(alive) >= 2:
+        for s, up in enumerate(alive):
+            if up:
+                points.add(("kill", (s,)))
+                out.append((
+                    reqs,
+                    _set(alive, s, False),
+                    tuple(m for m in net if m[1] != s and m[2] != s),
+                    False,
+                ))
+    # orphan recovery: the router's detect-timeout fires and the
+    # redispatch path re-routes each dead-assigned request — only when
+    # the implementation has both halves of that path
+    if fsem.can_recover:
+        for rid, (status, assignee) in enumerate(reqs):
+            if status == 1 and assignee >= 0 and not alive[assignee]:
+                for s, up in enumerate(alive):
+                    if up:
+                        out.append((
+                            _set(reqs, rid, (1, s)),
+                            alive,
+                            net + ((K_REQ, -1, s, rid, 0, 0),),
+                            kill_avail,
+                        ))
+    return out
+
+
+def _fleet_describe_stuck(state, cfg) -> str:
+    reqs, alive = state[0], state[1]
+    lost = [
+        f"request {rid} routed to dead replica {assignee}"
+        for rid, (status, assignee) in enumerate(reqs)
+        if status == 1 and assignee >= 0 and not alive[assignee]
+    ]
+    return (
+        f"[{cfg.label}] a replica kill strands "
+        + "; ".join(lost)
+        + " with no recovery path — the request is lost but was never "
+        "shed or nacked (redispatch-on-death + reply-recv timeout are "
+        "the two halves the router needs)"
+    )
+
+
+def check_fleet(fsem: FleetModelSemantics,
+                cfg: Optional[ModelConfig] = None) -> CheckResult:
+    """Exhaustively explore the fleet-route configuration. A reachable
+    state where nothing can move and some routed request is unfinished
+    is the MPT019 violation (request lost under a single replica
+    kill)."""
+    cfg = cfg or fleet_config()
+    init = (
+        tuple((0, -1) for _ in range(cfg.rounds)),
+        tuple(True for _ in range(cfg.servers)),
+        (),
+        True,
+    )
+    visited = {init}
+    stack = [init]
+    viol: dict = {}
+    points: set = set()
+    truncated = False
+    while stack:
+        if viol:
+            break  # first witness wins, same stance as check()
+        st = stack.pop()
+        succ = _fleet_successors(st, fsem, cfg, viol, points)
+        if not succ:
+            if not _fleet_terminal(st):
+                viol.setdefault(
+                    "MPT019", _fleet_describe_stuck(st, cfg)
+                )
+            continue
+        for s2 in succ:
+            s2 = s2[:2] + (_canon(s2[2]),) + s2[3:]
+            if s2 in visited:
+                continue
+            if len(visited) >= cfg.max_states:
+                truncated = True
+                continue
+            visited.add(s2)
+            stack.append(s2)
+    return CheckResult(
+        config=cfg,
+        states=len(visited),
+        fault_points=len(points),
+        violations=viol,
+        truncated=truncated,
+    )

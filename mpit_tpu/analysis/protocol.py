@@ -290,6 +290,10 @@ def extract_roles(project) -> dict:
     graph = project.graph
     roles: dict = {}
     for mod in project.modules:
+        # module_role tokenizes the whole source for comments — gate it
+        # behind a cheap substring scan (the marker is a literal)
+        if not any("protocol-role[" in ln for ln in mod.source_lines):
+            continue
         marked = module_role(mod.source_lines)
         if marked is None:
             continue
@@ -703,6 +707,134 @@ def _extract_handoff_dedup(server, by_rel) -> Optional[bool]:
                 return True
             found = False
     return found
+
+
+# ---------------------------------------------------------------------------
+# serving-fleet semantics — the router/replica routing protocol
+#
+# The fleet roles (mpit_tpu/fleet/) speak a different conversation from
+# the PS pair: a ROUTE/REPLY request lane plus auxiliary weight-refresh
+# and stop lanes. What the model checker needs from it is small: which
+# tag pair is the request lane, whether the router's reply wait can time
+# out (the death-detection escape), and whether a redispatch path exists
+# (a router-role send of the route tag from a ``redispatch``-named
+# function — the recovery idiom ``fleet/router.py`` carries). Extraction
+# is recognized-idiom, resolve-or-skip, like everything above.
+
+
+@dataclasses.dataclass(frozen=True)
+class FleetSemantics:
+    """Everything the fleet-route model checker needs."""
+
+    router_role: str
+    replica_role: str
+    route_tag: int  # the request lane (lowest shared tag — see extract)
+    reply_tag: int
+    stop_tag: Optional[int]
+    #: a router-role function whose name mentions ``redispatch`` re-sends
+    #: the route tag — the orphan-recovery path exists
+    redispatch_on_death: bool
+    #: the router's reply recv carries a timeout (it can notice a dead
+    #: replica instead of blocking forever)
+    reply_recv_timeout: bool
+    route_send: Optional[ProtoOp]  # finding anchor
+
+
+def extract_fleet_semantics(project) -> Optional[FleetSemantics]:
+    """The routed-serving pair's semantics, or None when the scan set has
+    no replica-style role (a wildcard-recv dispatcher whose role name
+    contains ``replica``) talking to a marked counterpart.
+
+    Tag-pair selection: the request lane is the LOWEST router-sent tag
+    the replica dispatches on, answered by the LOWEST replica-sent tag
+    the router concretely recvs — the registry orders a protocol's
+    request/reply lane before its auxiliary lanes (ROUTE=11/REPLY=12
+    precede the weight lanes 13/14), and the rule keeps extraction
+    deterministic without guessing at payload flow."""
+    roles = project.roles
+    replica = None
+    for name in sorted(roles):
+        cand = roles[name]
+        if (
+            "replica" in name
+            and cand.has_wildcard_recv
+            and roles.get(cand.counterpart) is not None
+        ):
+            replica = cand
+            break
+    if replica is None:
+        return None
+    router = roles[replica.counterpart]
+    route_cands = sorted(
+        t for t in (router.sent_tags & replica.dispatch_tags)
+        if t is not None
+    )
+    reply_cands = sorted(
+        t for t in (
+            replica.sent_tags
+            & {op.tag for op in router.concrete_recvs}
+        )
+        if t is not None
+    )
+    if not route_cands or not reply_cands:
+        return None
+    route_tag, reply_tag = route_cands[0], reply_cands[0]
+
+    by_rel = {m.rel: m for m in project.modules}
+    graph = project.graph
+    # the stop lane: a replica dispatch branch whose body sets a
+    # ``stop``-named attribute (``self.stopped = True``)
+    stop_tag = None
+    for rel in replica.rels:
+        mod = by_rel.get(rel)
+        if mod is None:
+            continue
+        info = graph.module_for_rel(rel)
+        for node in mod.nodes:
+            if not isinstance(node, ast.If) or not isinstance(
+                node.test, ast.Compare
+            ):
+                continue
+            tags = [
+                graph.resolve_constant(info, dotted)
+                for _c, dotted in _dispatch_tag_nodes(node.test)
+            ]
+            tags = [t for t in tags if t is not None]
+            if not tags:
+                continue
+            sets_stop = any(
+                isinstance(sub, ast.Assign)
+                and any(
+                    isinstance(t, ast.Attribute) and "stop" in t.attr
+                    for t in sub.targets
+                )
+                for stmt in node.body
+                for sub in ast.walk(stmt)
+            )
+            if sets_stop and stop_tag is None:
+                stop_tag = tags[0]
+    redispatch = any(
+        op.tag == route_tag and "redispatch" in op.symbol
+        for op in router.sends
+    )
+    _checked, reply_recv_timeout = _client_reply_handling(
+        router, by_rel, graph, reply_tag
+    )
+    route_send = min(
+        (op for op in router.sends if op.tag == route_tag),
+        key=lambda op: (op.rel, op.line, op.col),
+        default=None,
+    )
+    return FleetSemantics(
+        router_role=router.role,
+        replica_role=replica.role,
+        route_tag=route_tag,
+        reply_tag=reply_tag,
+        stop_tag=stop_tag,
+        redispatch_on_death=redispatch,
+        reply_recv_timeout=reply_recv_timeout,
+        route_send=route_send,
+    )
 
 
 def extract_semantics(project) -> Optional[ProtocolSemantics]:

@@ -11,6 +11,7 @@ from __future__ import annotations
 from mpit_tpu.analysis.rules import (
     collectives,
     concurrency,
+    fleet_check,
     host_sync,
     jit_signature,
     locks,
@@ -31,6 +32,7 @@ RULE_MODULES = (
     wire_format,
     protocol_roles,
     model_check,
+    fleet_check,
     metric_names,
     concurrency,
     payload_schema,

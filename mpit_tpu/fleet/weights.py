@@ -1,0 +1,135 @@
+# mpit-analysis: protocol-role[serving_router->serving_replica]
+"""Live weight streaming into serving replicas (router side).
+
+Reuses the PS fetch *shapes* — named ndarray/QuantArray leaves with a
+version counter — without the PS machinery: serving is read-only, so
+there is no error feedback, no push path, and a missed refresh costs
+staleness, not correctness. The publisher answers replica
+``WEIGHT_SUB`` subscriptions (and explicit rolling pushes) with one
+``WEIGHT_PUSH`` carrying ``(version, names, arrays)``; quantization
+(``bf16``/``int8`` per :mod:`mpit_tpu.quant`) amortizes refresh bytes
+exactly like the quantized PARAM fetch does for training pulls.
+
+Leaf naming uses the pytree path string; the replica rebuilds against
+its OWN treedef (same architecture by construction) and cross-checks
+the names, so a publisher/replica model mismatch fails loudly instead
+of silently scattering weights.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import numpy as np
+
+from mpit_tpu.fleet.replica import TAG_WEIGHT_PUSH
+from mpit_tpu.quant import QUANT_MODES, QuantArray, dequantize, quantize
+
+
+def flatten_named(params) -> tuple:
+    """``(names, arrays)`` — one host ndarray per pytree leaf, names from
+    the jax key path (deterministic leaf order: the treedef's)."""
+    leaves = jax.tree_util.tree_flatten_with_path(params)[0]
+    names = [jax.tree_util.keystr(path) for path, _ in leaves]
+    arrays = [np.asarray(leaf) for _, leaf in leaves]
+    return names, arrays
+
+
+def unflatten_like(template, names, arrays):
+    """Rebuild a params pytree with ``template``'s structure from a
+    ``(names, arrays)`` pair, dequantizing any QuantArray leaves."""
+    paths_leaves, treedef = jax.tree_util.tree_flatten_with_path(template)
+    want = [jax.tree_util.keystr(path) for path, _ in paths_leaves]
+    if list(names) != want:
+        diff = next(
+            ((a, b) for a, b in zip(names, want) if a != b),
+            (len(names), len(want)),
+        )
+        raise ValueError(
+            "weight push names do not match this replica's model "
+            f"(first difference: {diff})"
+        )
+    leaves = [
+        dequantize(a) if isinstance(a, QuantArray) else np.asarray(a)
+        for a in arrays
+    ]
+    return jax.tree_util.tree_unflatten(treedef, leaves)
+
+
+class StaticWeightSource:
+    """A versioned in-memory weight source (checkpoint stand-in).
+
+    ``version`` starts at 1 so a fresh replica (construction-time
+    weights = version 0) always has something to pull; :meth:`bump`
+    installs new params under the next version — the rolling-refresh
+    driver for tests and soaks. A PServer-backed source is the same
+    two-method surface (``version``/``current``) over the versioned
+    PARAM fetch."""
+
+    def __init__(self, params, version: int = 1):
+        if version < 1:
+            raise ValueError("version must be >= 1")
+        self._params = params
+        self.version = int(version)
+
+    def current(self) -> tuple:
+        return self.version, self._params
+
+    def bump(self, params) -> int:
+        self._params = params
+        self.version += 1
+        return self.version
+
+
+class WeightPublisher:
+    """Serve versioned weights to replicas over the router's transport.
+
+    ``quant``: ``off``/``bf16``/``int8`` — the wire precision of pushed
+    leaves (error feedback deliberately absent: each push is a fresh
+    quantization of the source truth, so refresh error never
+    accumulates across versions)."""
+
+    def __init__(self, transport, source, quant: str = "off"):
+        if quant not in QUANT_MODES:
+            raise ValueError(f"quant must be one of {QUANT_MODES}")
+        self.transport = transport
+        self.source = source
+        self.quant = quant
+        #: rank -> last version pushed (audit surface for the harness)
+        self.pushed: dict[int, int] = {}
+
+    def _encode(self, params) -> tuple:
+        names, arrays = flatten_named(params)
+        if self.quant != "off":
+            arrays = [
+                quantize(np.asarray(a, np.float32), self.quant)
+                for a in arrays
+            ]
+        return names, arrays
+
+    def publish_to(self, rank: int) -> int:
+        """Push the current source version to one replica; returns the
+        version pushed."""
+        version, params = self.source.current()
+        names, arrays = self._encode(params)
+        self.transport.send(
+            rank, TAG_WEIGHT_PUSH, (int(version), names, arrays)
+        )
+        self.pushed[rank] = int(version)
+        return int(version)
+
+    def on_sub(self, rank: int, have_version: int) -> Optional[int]:
+        """Answer one WEIGHT_SUB: push iff the source is newer than what
+        the replica reports serving. Returns the pushed version or
+        None."""
+        if int(have_version) >= self.source.version:
+            return None
+        return self.publish_to(rank)
+
+    def push_all(self, ranks) -> dict:
+        """Rolling refresh: push the current version to every rank, one
+        at a time (the one-at-a-time order is what keeps a fleet serving
+        through a refresh — at most one replica pays install latency at
+        any moment)."""
+        return {r: self.publish_to(r) for r in ranks}

@@ -18,6 +18,7 @@ from mpit_tpu.loadgen.slo import (
     evaluate_gate,
     format_report,
     load_gate,
+    pooled_latencies,
     validate_gate,
 )
 from mpit_tpu.loadgen.workload import LoadSpec, Request, make_workload
@@ -34,5 +35,6 @@ __all__ = [
     "evaluate_gate",
     "format_report",
     "load_gate",
+    "pooled_latencies",
     "validate_gate",
 ]

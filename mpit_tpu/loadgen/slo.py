@@ -223,13 +223,10 @@ class SLOAggregator:
         }
 
 
-def aggregate_paths(
-    paths, default_slo_ms: Optional[float] = None
-) -> dict:
-    """Stream journal files through one aggregator; returns the report.
-    Unparseable lines are skipped (a crashed writer's torn tail must
-    not take the postmortem down with it)."""
-    agg = SLOAggregator(default_slo_ms=default_slo_ms)
+def _stream(paths, agg: SLOAggregator) -> SLOAggregator:
+    """Feed journal files into ``agg``. Unparseable lines are skipped
+    (a crashed writer's torn tail must not take the postmortem down
+    with it)."""
     for path in paths:
         with open(path) as f:
             for line in f:
@@ -241,7 +238,38 @@ def aggregate_paths(
                 except (json.JSONDecodeError, ValueError):
                     continue
                 agg.observe(rec)
-    return agg.report()
+    return agg
+
+
+def aggregate_paths(
+    paths, default_slo_ms: Optional[float] = None
+) -> dict:
+    """Stream journal files through one aggregator; returns the report."""
+    return _stream(
+        paths, SLOAggregator(default_slo_ms=default_slo_ms)
+    ).report()
+
+
+def pooled_latencies(groups, names=("ttft", "tpot", "e2e")) -> dict:
+    """Latency summaries pooled across journal *groups* whose rid
+    spaces collide — one group per serving replica (each replica's
+    ``Server`` numbers requests locally, so rid 0 in two replica
+    journals is two different requests and they must never share one
+    aggregator). Each group streams through its own
+    :class:`SLOAggregator`; the geometric histograms then merge exactly
+    (bucket counts add). Returns ``{name: summary}`` in the same shape
+    as the per-histogram ``summary()`` of :func:`aggregate_paths`."""
+    pooled = {name: _Hist() for name in names}
+    for paths in groups:
+        agg = _stream(list(paths), SLOAggregator())
+        for name in names:
+            h: _Hist = getattr(agg, name)
+            dst = pooled[name]
+            for b, c in h.counts.items():
+                dst.counts[b] = dst.counts.get(b, 0) + c
+            dst.total += h.total
+            dst.sum_s += h.sum_s
+    return {name: hist.summary() for name, hist in pooled.items()}
 
 
 # gate keys: latency ceilings in ms, plus run-shape floors/ceilings

@@ -439,10 +439,15 @@ class Server:
             if spec_k < 1 or spec_rounds < 1:
                 raise ValueError("spec_k and spec_rounds must be >= 1")
         self.model = model
+        self._weights_dtype = weights_dtype
         self.params = (
             sampling.cast_weights(params, jnp.bfloat16)
             if weights_dtype in ("bf16", jnp.bfloat16) else params
         )
+        # serving-weights provenance: 0 = construction-time weights;
+        # bumped by install_weights (the fleet's rolling-refresh path)
+        # and stamped into every fleet REPLY for the version audit
+        self._weights_version = 0
         self.max_batch = int(max_batch)
         self.segment = int(segment)
         self.temperature = float(temperature)
@@ -519,6 +524,45 @@ class Server:
         """Bucket cap for prompt chunks: the cache headroom above the
         prefix clock, or effectively unbounded for horizon-free RNNs."""
         return (self._max_len - pfx) if self._max_len else (1 << 30)
+
+    # ----------------------------------------------------- weight refresh
+
+    @property
+    def weights_version(self) -> int:
+        """The version stamp of the weights currently serving (0 =
+        construction-time weights, never refreshed)."""
+        return self._weights_version
+
+    def install_weights(self, params, version: Optional[int] = None) -> int:
+        """Swap in a new weight pytree between scheduling steps (the
+        fleet's rolling-refresh path). The same ``weights_dtype`` cast
+        as construction applies, so a refreshed server serves at the
+        precision it advertised. In-flight requests finish their
+        remaining segments under the NEW weights — acceptable for
+        serving (each segment reads ``self.params`` afresh) and exactly
+        what a rolling fleet refresh means; callers needing per-request
+        weight pinning must drain first.
+
+        ``version``: the source's version counter (must move forward);
+        None auto-increments. Returns the installed version."""
+        if version is None:
+            version = self._weights_version + 1
+        version = int(version)
+        if version <= self._weights_version:
+            raise ValueError(
+                f"weights version must advance: {version} <= "
+                f"{self._weights_version} (rolling refreshes are "
+                "monotonic — the audit trail depends on it)"
+            )
+        self._check_poisoned()
+        self.params = (
+            sampling.cast_weights(params, jnp.bfloat16)
+            if self._weights_dtype in ("bf16", jnp.bfloat16) else params
+        )
+        self._weights_version = version
+        if self._obs is not None:
+            self._obs.event("weights_install", version=version)
+        return version
 
     # ------------------------------------------------------------- intake
 

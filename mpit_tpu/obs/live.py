@@ -100,6 +100,14 @@ M_SERVE_FAULTS = "serve.faults"
 M_LOAD_PENDING = "load.pending"
 M_LOAD_LATENESS_S = "load.submit_lateness_s"
 
+# serving-fleet plane (published by fleet/router.py and fleet/replica.py)
+M_FLEET_ROUTED = "fleet.routed"
+M_FLEET_REDISPATCHED = "fleet.redispatched"
+M_FLEET_SHED = "fleet.shed"
+M_FLEET_REPLICAS = "fleet.replicas"
+M_FLEET_OUTSTANDING = "fleet.outstanding"
+M_FLEET_WEIGHTS_VERSION = "fleet.weights_version"
+
 # base-1.1 geometric buckets on microseconds — kept in lockstep with
 # mpit_tpu.loadgen.slo (bucket b covers (1.1^(b-1), 1.1^b] µs, any
 # percentile within one ~10% step); replicated here so this module stays

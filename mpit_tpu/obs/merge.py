@@ -47,9 +47,10 @@ import json
 import os
 from typing import Iterable, Optional
 
-# protocol tag names for display; source of truth is
-# mpit_tpu/parallel/pserver.py (kept literal here so the merger imports
-# nothing heavier than the standard library)
+# protocol tag names for display; sources of truth are
+# mpit_tpu/parallel/pserver.py (1-10) and mpit_tpu/fleet/replica.py
+# (11-15) — kept literal here so the merger imports nothing heavier
+# than the standard library
 TAG_NAMES = {
     1: "FETCH",
     2: "PUSH_EASGD",
@@ -59,6 +60,13 @@ TAG_NAMES = {
     6: "HEARTBEAT",
     7: "JOIN",
     8: "LEAVE",
+    9: "SHARD_MAP",
+    10: "RESHARD",
+    11: "ROUTE",
+    12: "REPLY",
+    13: "WEIGHT_SUB",
+    14: "WEIGHT_PUSH",
+    15: "FLEET_STOP",
 }
 
 

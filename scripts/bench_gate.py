@@ -97,6 +97,11 @@ _EXCHANGE_KEYS = (
     # change who serves which slice — a resharded round is a different
     # exchange, not a slower one
     "ps_shards", "ring_version",
+    # serving-fleet shape (bench.py --load --fleet N): per-replica
+    # goodput/latency scales with fleet size, and the routing policy
+    # changes which replica absorbs the tail — different fleet, not a
+    # regression
+    "replica_count", "router_policy",
 )
 
 

@@ -1,0 +1,90 @@
+#!/usr/bin/env bash
+# Multi-seed fleet soak: replica-kill + rolling-weight-refresh runs
+# gated on the p99-moves-p50-doesn't pin.
+#
+#   scripts/fleet_soak.sh [N_SEEDS] [MAX_SECONDS]
+#
+# Each round runs the SAME seeded workload twice through
+# `python -m mpit_tpu.fleet run` (3 replicas + 1 spare, controller
+# armed):
+#
+#   clean  — no faults, rolling weight refreshes only;
+#   chaos  — a replica SIGKILL (in-process kill flag) at a router
+#            boundary, plus the same refreshes, so the kill lands while
+#            versions are rolling.
+#
+# Both runs must audit zero-lost with monotone weight versions (the
+# `run` exit code), then the round gates on:
+#
+#   `fleet pin --expect-kill`  — chaos e2e p50 within 3x of clean p50
+#       (a kill may move the TAIL — the orphans pay a redispatch — but
+#       must not move the MEDIAN), the kill demonstrably fired, and
+#       nothing was lost;
+#   `obs slo --gate fleet_smoke.json`   — the chaos run still clears
+#       the serving floor (all requests finish, goodput >= 0.5);
+#   `fleet audit`  — prints the postmortem naming the killed replica
+#       and the redispatch count (and re-checks version monotonicity).
+#
+# Wall-clock is bounded like serve_soak.sh: no new round starts once
+# MAX_SECONDS (default 600) is spent. A failing seed prints its exact
+# replay lines — each run is a pure function of its flags.
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+N_SEEDS="${1:-3}"
+MAX_SECONDS="${2:-600}"
+START=$SECONDS
+FAILED=0
+
+# rate 25 spreads the 16 arrivals over ~0.6s so the fleet is NOT
+# saturated — the p50 pin is only an honest claim under non-saturating
+# load (killing 1 of 3 replicas in a full-burst run cuts capacity for
+# the whole run and rightly moves the median); --kill-after 30 lands
+# the kill mid-run, while requests are in flight and versions rolling
+RUN_FLAGS=(--requests 16 --rate 25 --replicas 3 --refresh-at 20,60 --quant bf16)
+CHAOS_FLAGS=(--kill-after 30 --kill-rank 1 --spares 1 --controller)
+
+for ((i = 0; i < N_SEEDS; i++)); do
+  if ((SECONDS - START >= MAX_SECONDS)); then
+    echo "fleet_soak: budget of ${MAX_SECONDS}s spent after ${i} round(s); stopping" >&2
+    break
+  fi
+  echo "=== fleet soak round $((i + 1))/${N_SEEDS} (seed ${i}) ==="
+  OUT="$(mktemp -d)"
+  trap 'rm -rf "$OUT"' EXIT
+  if ! env JAX_PLATFORMS=cpu python -m mpit_tpu.fleet run \
+      --out "$OUT/clean" --seed "$i" "${RUN_FLAGS[@]}"; then
+    FAILED=1
+  elif ! env JAX_PLATFORMS=cpu python -m mpit_tpu.fleet run \
+      --out "$OUT/chaos" --seed "$i" "${RUN_FLAGS[@]}" "${CHAOS_FLAGS[@]}"; then
+    FAILED=1
+  # --p50-factor 5 (vs the pin's default 3): thread-fleet medians on a
+  # loaded CPU runner swing ~2x run-to-run; the LOST gate is the sharp
+  # one, the factor only has to catch median collapse, not noise
+  elif ! env JAX_PLATFORMS=cpu python -m mpit_tpu.fleet pin \
+      "$OUT/clean" "$OUT/chaos" --expect-kill --p50-factor 5; then
+    FAILED=1
+  elif ! env JAX_PLATFORMS=cpu python -m mpit_tpu.obs slo "$OUT/chaos" \
+      --gate scripts/fleet_smoke.json; then
+    FAILED=1
+  fi
+  # the postmortem: names the killed replica, the redispatch count, and
+  # the per-replica weight-version trail (exit 1 on loss/regression)
+  if ! env JAX_PLATFORMS=cpu python -m mpit_tpu.fleet audit "$OUT/chaos"; then
+    FAILED=1
+  fi
+  rm -rf "$OUT"
+  trap - EXIT
+  if ((FAILED)); then
+    break
+  fi
+done
+
+if ((FAILED)); then
+  echo "fleet_soak: FAILED at seed ${i} — replay with:" >&2
+  echo "  python -m mpit_tpu.fleet run --out /tmp/fleet_soak_${i}_clean --seed ${i} ${RUN_FLAGS[*]}" >&2
+  echo "  python -m mpit_tpu.fleet run --out /tmp/fleet_soak_${i}_chaos --seed ${i} ${RUN_FLAGS[*]} ${CHAOS_FLAGS[*]}" >&2
+  echo "  python -m mpit_tpu.fleet pin /tmp/fleet_soak_${i}_clean /tmp/fleet_soak_${i}_chaos --expect-kill" >&2
+  exit 1
+fi
+echo "fleet_soak: OK"

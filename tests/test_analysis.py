@@ -716,7 +716,9 @@ def test_mpt008_repo_roles_pair_up():
             modules.append(ctx)
     project = lint.Project(modules=modules, config=lint.Config())
     roles = protocol_mod.extract_roles(project)
-    assert set(roles) == {"client", "server"}
+    assert set(roles) == {
+        "client", "server", "serving_router", "serving_replica"
+    }
     client, server = roles["client"], roles["server"]
     # FETCH/PUSH*/STOP/HEARTBEAT/JOIN/LEAVE/SHARD_MAP
     assert client.sent_tags == {1, 2, 3, 5, 6, 7, 8, 9}
@@ -727,6 +729,14 @@ def test_mpt008_repo_roles_pair_up():
     assert 10 in server.dispatch_tags
     assert {op.tag for op in client.concrete_recvs} == {4}
     assert server.has_wildcard_recv
+    # the serving fleet closes the same way: ROUTE/WEIGHT_PUSH/STOP
+    # down to replicas, REPLY/WEIGHT_SUB back up into concrete recvs
+    router, replica = roles["serving_router"], roles["serving_replica"]
+    assert router.sent_tags == {11, 14, 15}
+    assert router.sent_tags <= replica.dispatch_tags
+    assert replica.sent_tags == {12, 13}
+    assert {op.tag for op in router.concrete_recvs} == {12, 13}
+    assert replica.has_wildcard_recv
 
 
 def test_baseline_counts_surplus(tmp_path):

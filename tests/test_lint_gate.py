@@ -60,7 +60,7 @@ def test_gate_script_passes_within_wall_clock_bound():
     # the wire-schema trio (lock check, fixtures, fuzz)
     assert "states" in proc.stdout, proc.stdout
     assert "violation(s)" in proc.stdout, proc.stdout
-    assert "10 tag(s) match" in proc.stdout, proc.stdout
+    assert "15 tag(s) match" in proc.stdout, proc.stdout
     assert "fuzz gate ok" in proc.stdout, proc.stdout
     # per-gate wall-clock lines are the budget ledger: parse them and
     # hold the wire-schema gate to its own 20 s sub-budget

@@ -682,6 +682,29 @@ class TestBenchGate:
         assert bg.main(["--strict", str(tmp_path)]) == 1
         assert "WARNING" in capsys.readouterr().out
 
+    def test_fleet_shape_change_not_comparable(self, tmp_path, capsys):
+        """A 3-replica round must never be scored against a 1-replica
+        round (per-replica goodput/latency scales with fleet size), nor
+        p2c against least-loaded — different fleet, not a regression."""
+        bg = _bench_gate()
+        base = {"metric": "serve_load_tokens_per_sec", "platform": "cpu",
+                "replica_count": 3, "router_policy": "p2c"}
+        _bench_round(tmp_path, 1, {**base, "value": 200.0})
+        _bench_round(tmp_path, 2, {**base, "value": 100.0,
+                                   "replica_count": 1})
+        assert bg.main(["--strict", str(tmp_path)]) == 0
+        assert "not comparable" in capsys.readouterr().out
+        # same fleet size but the routing policy changed: also a boundary
+        _bench_round(tmp_path, 3, {**base, "value": 100.0,
+                                   "router_policy": "least"})
+        assert bg.main(["--strict", str(tmp_path)]) == 0
+        assert "not comparable" in capsys.readouterr().out
+        # identical fleet shape on both sides still flags a real drop
+        _bench_round(tmp_path, 4, {**base, "value": 50.0,
+                                   "router_policy": "least"})
+        assert bg.main(["--strict", str(tmp_path)]) == 1
+        assert "WARNING" in capsys.readouterr().out
+
     def test_fewer_than_two_rounds_is_clean(self, tmp_path, capsys):
         bg = _bench_gate()
         assert bg.main([str(tmp_path)]) == 0

@@ -268,11 +268,16 @@ def test_mcheck_cli_reports_state_counts():
     proc = _cli("mcheck", "--json")
     assert proc.returncode == 0, proc.stdout + proc.stderr
     doc = json.loads(proc.stdout)
-    assert len(doc) == 4  # easgd, downpour, easgd-elastic, easgd-sharded
+    # easgd, downpour, easgd-elastic, easgd-sharded + fleet-route
+    assert len(doc) == 5
     for entry in doc:
         assert entry["violations"] == {}
-        assert entry["states"] > 10_000
         assert not entry["truncated"]
+    for entry in doc[:4]:  # the PS configs: exhaustive, not a smoke walk
+        assert entry["states"] > 10_000
+    fleet = doc[4]
+    assert "fleet-route" in fleet["config"]
+    assert fleet["states"] > 100  # small model, still a real exploration
 
 
 # ------------------------------------------------ exit-gate consistency

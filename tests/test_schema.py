@@ -52,9 +52,9 @@ def package_schema():
 # ------------------------------------------------------------------ model
 
 
-def test_all_ten_tags_have_both_halves(package_schema):
+def test_all_fifteen_tags_have_both_halves(package_schema):
     doc = package_schema.to_json()
-    assert sorted(doc["tags"], key=int) == [str(t) for t in range(1, 11)]
+    assert sorted(doc["tags"], key=int) == [str(t) for t in range(1, 16)]
     for tag, entry in doc["tags"].items():
         assert entry["sender"], f"tag {tag} has no sender schema"
         assert entry["receiver"], f"tag {tag} has no receiver schema"
@@ -153,7 +153,7 @@ def test_cli_schema_json_emits_all_tags():
     assert r.returncode == 0, r.stderr
     doc = json.loads(r.stdout)
     assert doc["version"] == schema_mod.SCHEMA_LOCK_VERSION
-    assert sorted(doc["tags"], key=int) == [str(t) for t in range(1, 11)]
+    assert sorted(doc["tags"], key=int) == [str(t) for t in range(1, 16)]
     for entry in doc["tags"].values():
         assert entry["sender"] and entry["receiver"]
 
@@ -161,7 +161,7 @@ def test_cli_schema_json_emits_all_tags():
 def test_cli_schema_check_clean_against_committed_lock():
     r = _cli("schema", "--check")
     assert r.returncode == 0, r.stdout + r.stderr
-    assert "10 tag(s) match" in r.stdout
+    assert "15 tag(s) match" in r.stdout
 
 
 def test_cli_schema_check_fails_on_undeclared_drift(tmp_path):
