@@ -41,6 +41,13 @@ Subcommands:
     drift; ``--update-lock`` regenerates the lock — protocol-shape
     changes are *declared*, never silent.
 
+``python -m mpit_tpu.analysis numerics [--package PATH] [--json]``
+    Print the whole-program precision-dataflow model behind MPT020–022:
+    every quantize site with its error-feedback verdict (paired /
+    ef-off[reason] / escapes / unpaired), dequantize mode/scale
+    provenance, reductions whose operand is quantized codes, and the
+    per-wire-tag precision ledger vs the lockfile's precision column.
+
 ``python -m mpit_tpu.analysis fuzz [--corpus PATH] [--examples N]``
     The differential codec fuzz gate: seeded strategies over the
     structural payload grammar drive encode→decode roundtrips,
@@ -358,7 +365,7 @@ def _schema_drift_lines(locked: dict, inferred: dict) -> list:
         if it is None:
             out.append(f"  {name} ({key}): in lock but no longer inferred")
             continue
-        for side in ("sender", "receiver"):
+        for side in ("sender", "receiver", "precision"):
             if lt.get(side) != it.get(side):
                 out.append(
                     f"  {name} ({key}) {side}: lock {lt.get(side)} != "
@@ -470,11 +477,71 @@ def _main_schema(argv) -> int:
         print(f"{name} ({key})")
         print(f"  sender:   {', '.join(ent['sender']) or '(none seen)'}")
         print(f"  receiver: {', '.join(ent['receiver']) or '(none seen)'}")
+        if ent.get("precision"):
+            print(f"  precision: {', '.join(ent['precision'])}")
     snap = doc["snapshot"]
     print(
         f"snapshot: writes {snap['writes'] or '(none)'} / "
         f"reads {snap['reads'] or '(none)'}"
     )
+    return 0
+
+
+def _main_numerics(argv) -> int:
+    parser = argparse.ArgumentParser(
+        prog="python -m mpit_tpu.analysis numerics",
+        description="Dump the whole-program precision-dataflow model "
+        "(quantize sites with error-feedback verdicts, dequantize "
+        "provenance, code-operand reductions, per-tag wire precision) "
+        "that rules MPT020-MPT022 consume.",
+    )
+    parser.add_argument(
+        "--package",
+        default=_default_scan_path(),
+        help="package to analyze (default: mpit_tpu)",
+    )
+    parser.add_argument(
+        "--json", action="store_true", help="machine-readable output"
+    )
+    args = parser.parse_args(argv)
+    if not Path(args.package).exists():
+        print(f"error: no such path: {args.package}", file=sys.stderr)
+        return 2
+    doc = _load_project(args.package).numerics.to_json()
+    if args.json:
+        print(json.dumps(doc, indent=2))
+        return 0
+    print(f"{len(doc['quant_sites'])} quantize site(s):")
+    for q in doc["quant_sites"]:
+        reason = (
+            f"  ({q['ef_off_reason']})" if "ef_off_reason" in q else ""
+        )
+        print(
+            f"  {q['site']}  {q['func']}[{q['mode']}]  "
+            f"ef={q['ef']}{reason}  <{q['symbol']}>"
+        )
+    print(f"\n{len(doc['dequant_sites'])} dequantize site(s):")
+    for d in doc["dequant_sites"]:
+        print(
+            f"  {d['site']}  {d['func']}[declared={d['declared_mode']} "
+            f"codes={d['codes_mode']} scale={d['scale']}]  "
+            f"<{d['symbol']}>"
+        )
+    print(
+        f"\n{len(doc['reduce_sites'])} code-operand reduction(s):"
+        + ("" if doc["reduce_sites"] else "  (clean)")
+    )
+    for r in doc["reduce_sites"]:
+        print(f"  {r['site']}  {r['func']}({r['operand']})  <{r['symbol']}>")
+    if doc["tags"]:
+        print(f"\n{len(doc['tags'])} wire tag(s) with a precision pin:")
+        for key in sorted(doc["tags"], key=int):
+            ent = doc["tags"][key]
+            mark = "" if ent["inferred"] == ent["locked"] else "  DRIFT"
+            print(
+                f"  {ent['name']} ({key}): inferred {ent['inferred']} / "
+                f"locked {ent['locked']}{mark}"
+            )
     return 0
 
 
@@ -546,6 +613,8 @@ def main(argv=None) -> int:
         return _main_threads(argv[1:])
     if argv and argv[0] == "schema":
         return _main_schema(argv[1:])
+    if argv and argv[0] == "numerics":
+        return _main_numerics(argv[1:])
     if argv and argv[0] == "fuzz":
         return _main_fuzz(argv[1:])
     parser = argparse.ArgumentParser(

@@ -127,6 +127,9 @@ class Project:
     # lazily-built wire payload-schema model (analysis/schema.py) — the
     # MPT016-018 rules and the `schema` CLI/lockfile share one build
     _schema: object = dataclasses.field(default=None, repr=False)
+    # lazily-built precision-dataflow model (analysis/numerics.py) — the
+    # MPT020-022 rules and the `numerics` CLI share one build
+    _numerics: object = dataclasses.field(default=None, repr=False)
 
     @property
     def graph(self):
@@ -151,6 +154,14 @@ class Project:
 
             self._threads = threads_mod.build_model(self)
         return self._threads
+
+    @property
+    def numerics(self):
+        if self._numerics is None:
+            from mpit_tpu.analysis import numerics as numerics_mod
+
+            self._numerics = numerics_mod.build_model(self)
+        return self._numerics
 
     @property
     def schema(self):

@@ -17,6 +17,7 @@ from mpit_tpu.analysis.rules import (
     locks,
     metric_names,
     model_check,
+    numerics_flow,
     payload_schema,
     protocol_roles,
     tags,
@@ -36,6 +37,7 @@ RULE_MODULES = (
     metric_names,
     concurrency,
     payload_schema,
+    numerics_flow,
 )
 
 # rule id -> (title, one-line rationale); the CLI's --list-rules output and

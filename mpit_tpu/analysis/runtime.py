@@ -28,6 +28,18 @@ of which short-circuit on the module-level ``_ACTIVE`` being None):
   not ordered after the previous conflicting access by that clock algebra
   is a data race REGARDLESS of how the schedule happened to interleave —
   the dynamic complement of static MPT013, reported with both stacks.
+- **RT104 numerics sanitizer** (opt-in: ``numerics=True`` or
+  ``MPIT_RT_NUMERICS=1``). The dynamic complement of static MPT020-022:
+  the quant kernels' host faces (:mod:`mpit_tpu.quant` peeks for an armed
+  checker, never the other way round), the PServer apply path, and the
+  sync/PS error-feedback state report into :func:`note_numeric_array` /
+  ``on_quantize`` / :func:`note_residual_norm`. Checks: NaN/Inf reaching
+  a quantize or the server center, int8 absmax overflow (non-finite or
+  non-positive scale), the zero-absmax pin (scale 1, codes all zero —
+  quant.py's hardened contract), and EF-residual norm boundedness — the
+  same per-round norm the dynamics plane journals as ``elastic`` must
+  stay finite and not grow without bound. One finding per call site,
+  with the caller's stack.
 
 Usage::
 
@@ -57,7 +69,7 @@ ANY = -1  # mirrors transport.ANY_SOURCE/ANY_TAG without importing transport
 
 @dataclasses.dataclass(frozen=True)
 class RuntimeFinding:
-    rule: str  # "RT101" | "RT102" | "RT103"
+    rule: str  # "RT101" | "RT102" | "RT103" | "RT104"
     message: str
 
     def format(self) -> str:
@@ -93,7 +105,7 @@ class RuntimeChecker:
     :func:`checking` (or :func:`enable`/:func:`disable` for long-lived
     diagnostics sessions)."""
 
-    def __init__(self, race: bool = False):
+    def __init__(self, race: bool = False, numerics: bool = False):
         self._mu = threading.Lock()
         self.findings: list = []
         # lock-order graph over lock INSTANCES (ids) — names alias freely
@@ -112,6 +124,10 @@ class RuntimeChecker:
         self._clocks: dict = {}  # tid -> {tid: clk}
         self._vars: dict = {}  # key -> {"w": epoch|None, "r": {tid: epoch}}
         self._reported_races: set = set()
+        # -- RT104 numerics state (numerics=True only) --
+        self.numerics = numerics
+        self._reported_numerics: set = set()  # (caller file:line, kind)
+        self._resid_norms: dict = {}  # key -> [observed finite norms]
 
     # -- lock-order graph -------------------------------------------------
 
@@ -318,6 +334,154 @@ class RuntimeChecker:
             else:
                 st["r"][tid] = me
 
+    # -- RT104 numerics sanitizer -------------------------------------------
+    #
+    # Armed-only cost (every hook is behind ``checker.numerics``); numpy
+    # is imported lazily inside the methods so this module stays
+    # stdlib-only at import time for the reader tools that sit on it.
+
+    #: EF-residual boundedness: a norm this many times the largest norm
+    #: seen in the first observations of a stream is divergence, not the
+    #: bounded O(scale) rounding floor the EF recurrence guarantees
+    RESIDUAL_GROWTH_BOUND = 1000.0
+    _RESID_WARMUP = 3
+
+    def _numerics_site(self) -> tuple:
+        """(file:line, stack tail) of the first frame outside this module
+        and quant.py — the USER call site, so one buggy caller reports
+        once however many chunks it pushes."""
+        frames = traceback.extract_stack()[:-3]
+        skip = (os.sep + "quant.py", os.sep + "runtime.py")
+        caller = None
+        for fr in reversed(frames):
+            if not fr.filename.endswith(skip):
+                caller = fr
+                break
+        where = (
+            f"{caller.filename}:{caller.lineno}" if caller else "<unknown>"
+        )
+        stack = "".join(traceback.format_list(frames[-6:]))
+        return where, stack
+
+    def _numerics_report(self, kind: str, message: str) -> None:
+        where, stack = self._numerics_site()
+        with self._mu:
+            if (where, kind) in self._reported_numerics:
+                return
+            self._reported_numerics.add((where, kind))
+            self.findings.append(
+                RuntimeFinding(
+                    "RT104", f"{message} at {where}:\n{stack}"
+                )
+            )
+
+    def on_quantize(self, face: str, arr, mode: str, scale, codes) -> None:
+        """Called by the host quant kernels (quant.py) when armed."""
+        import numpy as np
+
+        a = np.asarray(arr)
+        n_bad = int(a.size - np.count_nonzero(np.isfinite(a)))
+        if n_bad:
+            self._numerics_report(
+                "non-finite-input",
+                f"{n_bad} non-finite value(s) reached {face}[{mode}] "
+                f"(shape {a.shape}) — a NaN/Inf is about to cross the "
+                "wire; the quantizer pins it, but the producer is broken",
+            )
+        if mode != "int8" or not a.size:
+            return
+        s = np.asarray(scale)
+        if not bool(np.all(np.isfinite(s))) or not bool(np.all(s > 0)):
+            self._numerics_report(
+                "scale-overflow",
+                f"{face}[int8] produced a non-finite or non-positive "
+                f"scale (absmax overflow) — codes are garbage",
+            )
+            return
+        # the zero-absmax pin (quant.py's hardened contract): a row with
+        # no finite signal must quantize to scale 1 / all-zero codes so
+        # it dequantizes to exact zeros
+        finite_amax = np.max(
+            np.where(np.isfinite(a), np.abs(a), 0),
+            axis=-1 if s.ndim else None,
+        )
+        c = np.asarray(codes)
+        zero_rows = finite_amax == 0
+        if bool(np.any(zero_rows)):
+            row_codes = c if not s.ndim else c[np.asarray(zero_rows)]
+            if bool(np.any(row_codes)):
+                self._numerics_report(
+                    "zero-absmax",
+                    f"{face}[int8] emitted nonzero codes for a "
+                    "zero-absmax row — the hardened zero/NaN pin "
+                    "regressed; dequantize will fabricate signal",
+                )
+
+    def on_dequantize(self, face: str, scale, mode: str) -> None:
+        import numpy as np
+
+        if mode != "int8":
+            return
+        s = np.asarray(scale)
+        if not bool(np.all(np.isfinite(s))) or not bool(np.all(s > 0)):
+            self._numerics_report(
+                "bad-dequant-scale",
+                f"{face}[int8] called with a non-finite or non-positive "
+                "scale — the codes' scale was dropped or corrupted in "
+                "transit",
+            )
+
+    def on_numeric_array(self, site: str, arr) -> None:
+        """NaN/Inf check on a host-boundary array (server apply path,
+        collective accumulation exits). Traced values don't convert —
+        callers only hand in concrete host arrays."""
+        import numpy as np
+
+        try:
+            a = np.asarray(arr)
+        except Exception:
+            return  # a tracer or non-array: not checkable here
+        if a.dtype.kind != "f":
+            return
+        n_bad = int(a.size - np.count_nonzero(np.isfinite(a)))
+        if n_bad:
+            self._numerics_report(
+                f"nonfinite:{site}",
+                f"{n_bad} non-finite value(s) in {site} "
+                f"(shape {a.shape}) — poisoned state is being applied",
+            )
+
+    def on_residual_norm(self, key: str, norm: float) -> None:
+        """EF-residual boundedness, cross-checked against the same norm
+        the dynamics plane journals as ``elastic``: the residual is the
+        quantizer's one-step rounding error and must stay O(scale) —
+        finite always, and never orders of magnitude above the stream's
+        early rounds."""
+        import math
+
+        if not math.isfinite(norm):
+            self._numerics_report(
+                f"resid-nonfinite:{key}",
+                f"error-feedback residual norm for {key} is {norm!r} — "
+                "the EF state is poisoned and every future push "
+                "inherits it",
+            )
+            return
+        with self._mu:
+            seen = self._resid_norms.setdefault(key, [])
+            if len(seen) < self._RESID_WARMUP:
+                seen.append(norm)
+                return
+            bound = self.RESIDUAL_GROWTH_BOUND * max(max(seen), 1e-12)
+        if norm > bound:
+            self._numerics_report(
+                f"resid-growth:{key}",
+                f"error-feedback residual norm for {key} reached "
+                f"{norm:.3e}, over {self.RESIDUAL_GROWTH_BOUND:.0f}x the "
+                "warmup rounds' ceiling — the EF recurrence is diverging "
+                "instead of carrying bounded rounding error",
+            )
+
 
 class _TrackedLock:
     """threading.Lock wrapper reporting acquisition order to a checker.
@@ -460,11 +624,32 @@ def note(key: str, write: bool) -> None:
         checker.on_var_access(key, write)
 
 
+def note_numeric_array(site: str, arr) -> None:
+    """Annotate one host-boundary array for RT104 (server apply path,
+    collective-accumulation exits). Free when no numerics-mode checker
+    is active."""
+    checker = _ACTIVE
+    if checker is not None and checker.numerics:
+        checker.on_numeric_array(site, arr)
+
+
+def note_residual_norm(key: str, norm: float) -> None:
+    """Annotate one error-feedback residual norm for RT104 — callers
+    hand in the SAME value the dynamics plane journals as ``elastic``,
+    so the sanitizer and the journal can never disagree about what the
+    residual was."""
+    checker = _ACTIVE
+    if checker is not None and checker.numerics:
+        checker.on_residual_norm(key, float(norm))
+
+
 def enable(
-    checker: Optional[RuntimeChecker] = None, race: bool = False
+    checker: Optional[RuntimeChecker] = None,
+    race: bool = False,
+    numerics: bool = False,
 ) -> RuntimeChecker:
     global _ACTIVE
-    _ACTIVE = checker or RuntimeChecker(race=race)
+    _ACTIVE = checker or RuntimeChecker(race=race, numerics=numerics)
     return _ACTIVE
 
 
@@ -474,37 +659,56 @@ def disable() -> None:
 
 
 @contextlib.contextmanager
-def checking(race: bool = False) -> Iterator[RuntimeChecker]:
+def checking(
+    race: bool = False, numerics: bool = False
+) -> Iterator[RuntimeChecker]:
     """Enable a fresh checker for the block; disables on exit (the checker
     object and its findings stay readable afterwards)."""
-    checker = enable(race=race)
+    checker = enable(race=race, numerics=numerics)
     try:
         yield checker
     finally:
         disable()
 
 
+def _env_on(name: str) -> bool:
+    return os.environ.get(name, "0") not in ("", "0")
+
+
 def _arm_from_env() -> None:
-    """``MPIT_RT_RACE=1`` arms a race-mode checker for the whole process
-    (each launch.py rank imports this module early, so transport locks are
-    created tracked) and reports findings at exit — the chaos-soak wiring."""
-    if os.environ.get("MPIT_RT_RACE", "0") in ("", "0"):
+    """``MPIT_RT_RACE=1`` / ``MPIT_RT_NUMERICS=1`` arm one shared
+    process-wide checker (each launch.py rank imports this module early,
+    so transport locks are created tracked and the quant kernels see the
+    checker) and report findings at exit — the chaos-soak wiring. Each
+    armed plane prints its own banner and its own finding count, so the
+    soak can gate the two independently."""
+    race, numerics = _env_on("MPIT_RT_RACE"), _env_on("MPIT_RT_NUMERICS")
+    if not race and not numerics:
         return
-    checker = enable(race=True)
-    print(
-        f"[rt-race] vector-clock race sanitizer armed (pid {os.getpid()})",
-        file=sys.stderr,
-    )
+    checker = enable(race=race, numerics=numerics)
+    if race:
+        print(
+            "[rt-race] vector-clock race sanitizer armed "
+            f"(pid {os.getpid()})",
+            file=sys.stderr,
+        )
+    if numerics:
+        print(
+            f"[rt-numerics] numerics sanitizer armed (pid {os.getpid()})",
+            file=sys.stderr,
+        )
     import atexit
 
     @atexit.register
     def _report() -> None:
         for finding in checker.findings:
             print(finding.format(), file=sys.stderr)
-        print(
-            f"[rt-race] {len(checker.findings)} finding(s)",
-            file=sys.stderr,
-        )
+        if race:
+            n = sum(1 for f in checker.findings if f.rule != "RT104")
+            print(f"[rt-race] {n} finding(s)", file=sys.stderr)
+        if numerics:
+            n = sum(1 for f in checker.findings if f.rule == "RT104")
+            print(f"[rt-numerics] {n} finding(s)", file=sys.stderr)
 
 
 _arm_from_env()

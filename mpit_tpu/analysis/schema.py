@@ -189,18 +189,38 @@ class SchemaModel:
         tags = sorted(set(self.senders) | set(self.receivers))
         doc: dict = {"version": SCHEMA_LOCK_VERSION, "tags": {}}
         for tag in tags:
+            sender = sorted(
+                {kind_repr(s.shape) for s in self.senders.get(tag, ())}
+            )
+            receiver = receiver_repr(self.receivers.get(tag))
             doc["tags"][str(tag)] = {
                 "name": self.tag_names.get(tag, ""),
-                "sender": sorted(
-                    {kind_repr(s.shape) for s in self.senders.get(tag, ())}
-                ),
-                "receiver": receiver_repr(self.receivers.get(tag)),
+                "sender": sender,
+                "receiver": receiver,
+                "precision": tag_precision(sender, receiver),
             }
         doc["snapshot"] = {
             "writes": sorted(self.snapshot_writes),
             "reads": sorted(self.snapshot_reads),
         }
         return doc
+
+
+def tag_precision(sender_reprs, receiver_reprs) -> list:
+    """The per-tag payload precision column (the MPT022 wire-drift
+    anchor): ``"codes"`` when any modeled shape on either side carries
+    quantized codes (a ``quant`` kind — QuantArray in transit), ``"f32"``
+    when raw float32 ndarrays ride the tag. Control tags get ``[]``.
+    Derived from the same kind strings the lock already pins, so a PR
+    that flips a tag between raw and quantized payloads shows up as a
+    one-line lock diff — the lockfile, not prose, is the authority."""
+    blob = " ".join(list(sender_reprs) + list(receiver_reprs))
+    out = []
+    if "quant" in blob:
+        out.append("codes")
+    if "ndarray" in blob:
+        out.append("f32")
+    return out
 
 
 def is_tuple_kind(kind) -> bool:

@@ -249,6 +249,10 @@ def quantized_psum_scatter(
     w = lax.axis_size(axis)
     x = jnp.asarray(flat, jnp.float32)
     rows = x.reshape(w, -1)  # requires W-divisible flats, like tiled=True
+    # The ZeRO scatter is stateless by design: each shard owner sees
+    # fresh gradients every step, and the dynamics plane is the
+    # convergence guardrail (docstring above).
+    # mpit-analysis: ef-off[ZeRO scatter is stateless by design]
     codes, scales = _quant.quantize_rows_jnp(rows, mode)
     codes_x = lax.all_to_all(codes, axis, split_axis=0, concat_axis=0)
     if mode == "int8":

@@ -103,6 +103,9 @@ class WeightPublisher:
         names, arrays = flatten_named(params)
         if self.quant != "off":
             arrays = [
+                # Each push is a fresh full snapshot, not an accumulating
+                # stream — residual state would correct nothing.
+                # mpit-analysis: ef-off[serving push is a fresh snapshot]
                 quantize(np.asarray(a, np.float32), self.quant)
                 for a in arrays
             ]

@@ -40,7 +40,11 @@ from typing import Optional, Sequence
 
 import numpy as np
 
-from mpit_tpu.analysis.runtime import make_lock
+from mpit_tpu.analysis.runtime import (
+    active_checker as _rt_active,
+    make_lock,
+    note_residual_norm as _rt_residual,
+)
 from mpit_tpu.parallel.pserver import (
     TAG_FETCH,
     TAG_HEARTBEAT,
@@ -639,6 +643,12 @@ class PClient:
         # Each chunk carries that server's last-fetched center version
         # as its staleness basis (0 = never fetched a versioned reply).
         seq = next(self._push_seq)
+        # RT104 boundedness probe: one norm per EF-residual update when
+        # the numerics sanitizer is armed, zero host work otherwise
+        rt_checker = _rt_active()
+        rt_numerics = rt_checker is not None and getattr(
+            rt_checker, "numerics", False
+        )
         if self._shard_map is not None:
             # ring mode: one envelope per live server carrying its
             # (sid, chunk) parts — after a repair the re-offered shards
@@ -654,7 +664,15 @@ class PClient:
                         res = self._residual.get(key)
                         comp = chunk if res is None else chunk + res
                         q = quantize(comp, self.quant)
-                        self._residual[key] = comp - dequantize(q)
+                        new_res = comp - dequantize(q)
+                        self._residual[key] = new_res
+                        if rt_numerics:
+                            _rt_residual(
+                                f"pclient.ef[{tag}:{sid}]",
+                                # host numpy, sanitizer-gated — no
+                                # device sync happens here
+                                float(np.linalg.norm(new_res)),  # mpit-analysis: ignore[MPT005]
+                            )
                         parts.append((sid, q))
                     else:
                         parts.append((sid, chunk))
@@ -681,7 +699,14 @@ class PClient:
                 res = self._residual.get(key)
                 comp = chunk if res is None else chunk + res
                 q = quantize(comp, self.quant)
-                self._residual[key] = comp - dequantize(q)
+                new_res = comp - dequantize(q)
+                self._residual[key] = new_res
+                if rt_numerics:
+                    _rt_residual(
+                        f"pclient.ef[{tag}:{rank}]",
+                        # host numpy, sanitizer-gated — no device sync
+                        float(np.linalg.norm(new_res)),  # mpit-analysis: ignore[MPT005]
+                    )
                 payload_chunk = q
             else:
                 payload_chunk = chunk

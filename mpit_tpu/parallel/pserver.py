@@ -98,7 +98,11 @@ from typing import Optional, Sequence
 
 import numpy as np
 
-from mpit_tpu.analysis.runtime import make_lock, note as _rt_note
+from mpit_tpu.analysis.runtime import (
+    make_lock,
+    note as _rt_note,
+    note_numeric_array as _rt_numeric,
+)
 from mpit_tpu.comm.topology import HashRing
 from mpit_tpu.obs.live import M_STALENESS, live_registry
 from mpit_tpu.parallel.elastic import ElasticMembership
@@ -643,10 +647,14 @@ class PServer:
     def _quant_chunk(self, snapshot):
         if self.quant == "off":
             return snapshot
+        # Param-fetch replies quantize a fresh center snapshot each
+        # time, not an accumulating stream — no residual to carry.
         if isinstance(snapshot, list):
             return [
+                # mpit-analysis: ef-off[fetch reply is a fresh snapshot]
                 (sid, ver, quantize(arr, self.quant)) for sid, ver, arr in snapshot
             ]
+        # mpit-analysis: ef-off[fetch reply is a fresh snapshot]
         return quantize(snapshot, self.quant)
 
     def _apply_update(self, msg, easgd: bool) -> None:
@@ -987,6 +995,9 @@ class PServer:
             return None
         if arr.shape != self.center.shape:
             return None
+        # RT104: the server apply boundary — a NaN/Inf push admitted
+        # here poisons the center for every subsequent fetch
+        _rt_numeric("pserver.apply", arr)
         return arr
 
     def _validate_parts(self, parts) -> Optional[list]:
@@ -1017,6 +1028,7 @@ class PServer:
             s, e = self._shard_map.layout[sid]
             if arr.shape != (e - s,):
                 return None
+            _rt_numeric("pserver.apply", arr)
             out.append((int(sid), arr))  # mpit-analysis: ignore[MPT005]
         return out
 

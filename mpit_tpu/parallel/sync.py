@@ -52,6 +52,8 @@ import optax
 from jax import lax
 from jax.sharding import PartitionSpec as P
 
+from mpit_tpu.analysis import runtime as _runtime
+
 from mpit_tpu import quant as _quant
 from mpit_tpu.comm.topology import topology as _current_topology
 from mpit_tpu.comm.topology import Topology
@@ -604,8 +606,11 @@ class DataParallelTrainer:
             state, metrics = self._apply_p(state, loss, gathered)
             _settle(metrics)
 
-        if armed:
-            self._round += 1
+        rt_numerics = (
+            _runtime.active_checker() is not None
+            and getattr(_runtime.active_checker(), "numerics", False)
+        )
+        if armed or rt_numerics:
             elastic = (
                 float(
                     np.sqrt(
@@ -617,6 +622,12 @@ class DataParallelTrainer:
                 if res_sq
                 else 0.0
             )
+            # RT104 sees the SAME value the dynamics plane journals as
+            # `elastic` — the sanitizer and the journal can never
+            # disagree about what the EF residual norm was
+            _runtime.note_residual_norm("sync-dp.elastic", elastic)
+        if armed:
+            self._round += 1
             pn = float(metrics["param_norm"])
             un = float(metrics["update_norm"])
             # dynamics plane (docs/OBSERVABILITY.md "dynamics"): elastic

@@ -27,6 +27,7 @@ reader tools that sit behind it) never pays a jax import.
 from __future__ import annotations
 
 import dataclasses
+import sys
 
 import numpy as np
 
@@ -63,6 +64,24 @@ class QuantArray:
 # -- host (numpy) path ----------------------------------------------------
 
 
+def _rt_numerics_checker():
+    """The RT104 numerics sanitizer, IF some other code armed it.
+
+    This module must stay importable with only numpy (lint.sh gate 6
+    pins it jax- and analysis-free), so we never import the analysis
+    package here: ``sys.modules`` is peeked for an already-imported
+    ``analysis.runtime`` — exactly the processes that armed the checker
+    (``MPIT_RT_NUMERICS=1`` ranks, ``checking(numerics=True)`` tests)
+    have it loaded. Costs one dict lookup per quantize when unarmed."""
+    rt = sys.modules.get("mpit_tpu.analysis.runtime")
+    if rt is None:
+        return None
+    checker = rt.active_checker()
+    if checker is not None and getattr(checker, "numerics", False):
+        return checker
+    return None
+
+
 def quantize(arr: np.ndarray, mode: str) -> QuantArray:
     """Pack a float32 array into a :class:`QuantArray` (copies — the
     quantized buffer is new; the input is never aliased)."""
@@ -72,14 +91,31 @@ def quantize(arr: np.ndarray, mode: str) -> QuantArray:
         # round-to-nearest-even on the dropped mantissa half; the +
         # carries into the exponent correctly for halfway cases
         data = ((u + 0x7FFF + ((u >> 16) & 1)) >> 16).astype(np.uint16)
+        checker = _rt_numerics_checker()
+        if checker is not None:
+            checker.on_quantize("quantize", a, mode, None, data)
         return QuantArray("bf16", 1.0, data)
     if mode == "int8":
-        amax = np.float32(np.max(np.abs(a))) if a.size else np.float32(0)
+        # NaN/Inf never drive the block scale (an all-NaN chunk used to
+        # poison amax and cast NaN to int8 — undefined codes); the scale
+        # comes from the finite elements only, so it stays finite
+        finite = np.isfinite(a)
+        amax = (
+            np.float32(np.max(np.where(finite, np.abs(a), np.float32(0))))
+            if a.size
+            else np.float32(0)
+        )
         # f32 division, not float64-then-cast: the jnp path divides in
         # f32 and the two must agree to the bit (all-zero chunk: scale
         # is moot, pick 1)
         scale = amax / np.float32(127.0) if amax > 0 else np.float32(1.0)
-        data = np.clip(np.rint(a / scale), -127, 127).astype(np.int8)
+        codes = np.clip(np.rint(a / scale), -127, 127)
+        # ±Inf saturates to ±127 via the clip; NaN pins to code 0, so a
+        # poisoned element dequantizes to 0 instead of garbage
+        data = np.where(np.isnan(a), np.float32(0), codes).astype(np.int8)
+        checker = _rt_numerics_checker()
+        if checker is not None:
+            checker.on_quantize("quantize", a, mode, scale, data)
         return QuantArray("int8", float(scale), data)
     raise ValueError(f"unknown quantization mode {mode!r}")
 
@@ -90,9 +126,60 @@ def dequantize(q: QuantArray) -> np.ndarray:
         data = np.ascontiguousarray(q.data, dtype=np.uint16)
         return (data.astype(np.uint32) << 16).view(np.float32)
     if q.mode == "int8":
+        checker = _rt_numerics_checker()
+        if checker is not None:
+            checker.on_dequantize("dequantize", q.scale, q.mode)
         data = np.asarray(q.data, dtype=np.int8)
         return data.astype(np.float32) * np.float32(q.scale)
     raise ValueError(f"unknown quantization mode {q.mode!r}")
+
+
+def quantize_rows(a: np.ndarray, mode: str):
+    """Host twin of :func:`quantize_rows_jnp`: blockwise quantization of
+    a 2-D float32 array, one absmax scale per row. Returns
+    ``(codes (B, n), scales (B, 1))``, bit-identical to the jnp face on
+    the same input (pinned in tests/test_wire.py) — the reference the
+    RT104 sanitizer and the property suite probe without a jax import."""
+    a = np.ascontiguousarray(a, dtype=np.float32)
+    if a.ndim != 2:
+        raise ValueError(f"quantize_rows wants a 2-D array, got {a.shape}")
+    if mode == "bf16":
+        return quantize(a, "bf16").data, np.ones(
+            (a.shape[0], 1), np.float32
+        )
+    if mode == "int8":
+        finite = np.isfinite(a)
+        amax = np.max(
+            np.where(finite, np.abs(a), np.float32(0)),
+            axis=1,
+            keepdims=True,
+        ).astype(np.float32) if a.size else np.zeros(
+            (a.shape[0], 1), np.float32
+        )
+        scales = np.where(
+            amax > 0, amax / np.float32(127.0), np.float32(1.0)
+        ).astype(np.float32)
+        codes = np.clip(np.rint(a / scales), -127, 127)
+        codes = np.where(np.isnan(a), np.float32(0), codes).astype(np.int8)
+        checker = _rt_numerics_checker()
+        if checker is not None:
+            checker.on_quantize("quantize_rows", a, mode, scales, codes)
+        return codes, scales
+    raise ValueError(f"unknown quantization mode {mode!r}")
+
+
+def dequantize_rows(codes: np.ndarray, scales, mode: str) -> np.ndarray:
+    """Host twin of :func:`dequantize_rows_jnp` (scales broadcast over
+    rows; ignored for bf16)."""
+    if mode == "bf16":
+        return dequantize(QuantArray("bf16", 1.0, codes))
+    if mode == "int8":
+        checker = _rt_numerics_checker()
+        if checker is not None:
+            checker.on_dequantize("dequantize_rows", scales, mode)
+        data = np.asarray(codes, dtype=np.int8)
+        return data.astype(np.float32) * np.asarray(scales, np.float32)
+    raise ValueError(f"unknown quantization mode {mode!r}")
 
 
 # -- device (jnp) path ----------------------------------------------------
@@ -123,10 +210,18 @@ def quantize_jnp(x, mode: str):
         codes = ((u + 0x7FFF + ((u >> 16) & 1)) >> 16).astype(jnp.uint16)
         return codes, jnp.float32(1.0)
     if mode == "int8":
-        amax = jnp.max(jnp.abs(a)) if a.size else jnp.float32(0)
+        # same NaN/Inf guards as the host path (scale from finite
+        # elements only; Inf saturates, NaN pins to code 0) — the two
+        # faces must stay bit-identical on ANY input, not just clean ones
+        amax = (
+            jnp.max(jnp.where(jnp.isfinite(a), jnp.abs(a), 0.0))
+            if a.size
+            else jnp.float32(0)
+        )
         scale = jnp.where(amax > 0, amax / jnp.float32(127.0), 1.0)
         scale = scale.astype(jnp.float32)
-        codes = jnp.clip(jnp.rint(a / scale), -127, 127).astype(jnp.int8)
+        codes = jnp.clip(jnp.rint(a / scale), -127, 127)
+        codes = jnp.where(jnp.isnan(a), 0.0, codes).astype(jnp.int8)
         return codes, scale
     raise ValueError(f"unknown quantization mode {mode!r}")
 
@@ -153,10 +248,15 @@ def quantize_rows_jnp(x, mode: str):
         codes, _ = quantize_jnp(a, "bf16")
         return codes, jnp.ones((a.shape[0], 1), jnp.float32)
     if mode == "int8":
-        amax = jnp.max(jnp.abs(a), axis=1, keepdims=True)
+        amax = jnp.max(
+            jnp.where(jnp.isfinite(a), jnp.abs(a), 0.0),
+            axis=1,
+            keepdims=True,
+        )
         scale = jnp.where(amax > 0, amax / jnp.float32(127.0), 1.0)
         scale = scale.astype(jnp.float32)
-        codes = jnp.clip(jnp.rint(a / scale), -127, 127).astype(jnp.int8)
+        codes = jnp.clip(jnp.rint(a / scale), -127, 127)
+        codes = jnp.where(jnp.isnan(a), 0.0, codes).astype(jnp.int8)
         return codes, scale
     raise ValueError(f"unknown quantization mode {mode!r}")
 

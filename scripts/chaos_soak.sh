@@ -12,7 +12,10 @@
 # the alert engine (`obs live --once`): unexpected alerts exit nonzero.
 # A second 3-rank round runs with MPIT_RT_RACE=1 — every rank arms the
 # vector-clock race sanitizer (RT103, docs/ANALYSIS.md) and a healthy
-# run must report zero findings from every process.
+# run must report zero findings from every process. A third runs with
+# MPIT_RT_NUMERICS=1 under int8 quantization — every rank arms the
+# numerics sanitizer (RT104) and a healthy quantized run must likewise
+# report zero findings from every process.
 set -euo pipefail
 cd "$(dirname "$0")/.."
 
@@ -90,5 +93,39 @@ if ((SECONDS - START < MAX_SECONDS)); then
   trap - EXIT
 else
   echo "chaos_soak: budget spent; skipping RT103-armed round" >&2
+fi
+
+# RT104-armed round: the same 3-rank shape with int8 quantized pushes
+# and the runtime numerics sanitizer on in every rank process. The gate
+# is two-sided and per-process — the armed marker must appear in ALL
+# THREE processes (the knob can't silently rot, and a rank that never
+# armed proves nothing), and no rank may report a numerics finding
+# (quantize/dequantize edge cases, server apply NaN/Inf, EF-residual
+# boundedness must all hold under real quantized traffic).
+if ((SECONDS - START < MAX_SECONDS)); then
+  echo "=== chaos soak: RT104-armed 3-rank round (int8) ===" >&2
+  OUT="$(mktemp -d)"
+  LOG="$OUT/rt_numerics.log"
+  trap 'rm -rf "$OUT"' EXIT
+  env JAX_PLATFORMS=cpu MPIT_RT_NUMERICS=1 MPIT_WIRE_QUANT=int8 \
+      MPIT_OBS_DIR="$OUT" \
+      timeout -k 10 120 \
+      python -m mpit_tpu.launch -n 3 examples/ptest_proc.py \
+      --model mlp --steps 16 --train-size 256 --algo ps-easgd \
+      2>&1 | tee "$LOG"
+  ARMED=$(grep -c "rt-numerics.*armed" "$LOG" || true)
+  if ((ARMED < 3)); then
+    echo "chaos_soak: MPIT_RT_NUMERICS=1 armed only ${ARMED}/3 processes" >&2
+    exit 1
+  fi
+  if grep "\[rt-numerics\]" "$LOG" | grep -v "armed" | grep -qv " 0 finding(s)"; then
+    echo "chaos_soak: RT104 reported numerics finding(s):" >&2
+    grep -B1 -A12 "RT104" "$LOG" >&2 || true
+    exit 1
+  fi
+  rm -rf "$OUT"
+  trap - EXIT
+else
+  echo "chaos_soak: budget spent; skipping RT104-armed round" >&2
 fi
 echo "chaos_soak: OK"

@@ -11,7 +11,7 @@
 #                                   # so one rule iterates without the full
 #                                   # pass (--only also works standalone)
 #
-# The default run is nine gates behind the one baseline:
+# The default run is ten gates behind the one baseline:
 #   1. the static lint (MPT001-008, MPT012) + protocol model check
 #      (MPT009-011);
 #   2. an explicit `mcheck` pass, so the exhaustive state counts land in
@@ -45,7 +45,13 @@
 #      10k seeded examples (roundtrip + framed-vs-pickle differential +
 #      mutation corpus: every corrupted frame lands on WireDecodeError
 #      or the original value — never a wrong value or a crash) plus a
-#      replay of the checked-in corpus under tests/fixtures/wire_corpus.
+#      replay of the checked-in corpus under tests/fixtures/wire_corpus;
+#  10. the numerics gate: each seeded MPT020/021/022 fixture (code
+#      accumulation, unpaired error feedback, mode/scale provenance
+#      mismatch) must trip exactly its rule through the real CLI, and
+#      the RT104 numerics sanitizer must catch a seeded NaN injection
+#      and a zero-absmax row while staying silent on a clean
+#      quantize→dequantize round.
 # Every gate prints its wall-clock ([lint] gate N ... Xs); the whole
 # default run is bounded to < 30 s with the wire-schema gate itself
 # under 20 s (tests/test_lint_gate.py enforces both, and separately
@@ -229,6 +235,47 @@ EOF
     python -m mpit_tpu.analysis fuzz --examples 10000 \
         --corpus tests/fixtures/wire_corpus/corpus.jsonl
     gate_done wire-schema
+    # gate 10: the numerics contract. (a) Each seeded precision-flow
+    # fixture must trip exactly its rule through the REAL CLI (same
+    # expected-exit-1 discipline as gates 8/9 — a regression in the
+    # dataflow walk must not turn these scans silently green).
+    for rule in MPT020 MPT021 MPT022; do
+        low=$(echo "$rule" | tr '[:upper:]' '[:lower:]')
+        if python -m mpit_tpu.analysis --no-baseline --only "$rule" \
+                "tests/fixtures/analysis/fixture_${low}.py" > /dev/null; then
+            echo "numerics gate: fixture_${low} no longer trips ${rule}" >&2
+            exit 1
+        fi
+    done
+    # (b) RT104 smoke: the numerics sanitizer must stay silent on a
+    # clean quantize→dequantize round (including a legitimate all-zero
+    # row), catch a seeded NaN injection exactly once per site, and
+    # catch a non-finite EF-residual norm
+    python - <<'EOF'
+import numpy as np
+from mpit_tpu import quant
+from mpit_tpu.analysis import runtime as rt
+
+with rt.checking(numerics=True) as ck:
+    clean = np.arange(12, dtype=np.float32).reshape(3, 4)
+    clean[1] = 0.0  # zero-absmax row: legitimate, must not trip
+    codes, scales = quant.quantize_rows(clean, "int8")
+    quant.dequantize_rows(codes, scales, "int8")
+    quant.dequantize(quant.quantize(clean.ravel(), "int8"))
+assert not ck.findings, f"RT104 smoke: clean round tripped {ck.findings}"
+
+with rt.checking(numerics=True) as ck2:
+    poisoned = np.ones(8, np.float32)
+    poisoned[3] = np.nan  # seeded NaN injection
+    for _ in range(3):  # once-per-site dedup: 3 calls, 1 finding
+        quant.quantize(poisoned, "int8")
+    rt.note_residual_norm("gate.ef", float("nan"))
+rules = [f.rule for f in ck2.findings]
+assert rules == ["RT104", "RT104"], f"RT104 smoke: got {rules}"
+assert 'File "' in ck2.findings[0].message, "RT104 smoke: missing stack"
+print("numerics gate: 3 fixtures trip their rules, RT104 smoke ok")
+EOF
+    gate_done numerics
     # bench trajectory drift should be SEEN at lint time; it blocks only
     # under --strict (CI), because bench noise must never block a commit
     if [[ "$STRICT" == "1" ]]; then

@@ -1,0 +1,22 @@
+"""Seeded MPT020: a reduction over quantized codes.
+
+The block-quantized rows are summed in their wire representation —
+unscaled int8 integers — instead of the f32 reconstruction, so the
+accumulator is garbage whenever rows carry different absmax scales.
+The error-feedback fold is present (the quantize is paired), so MPT021
+must stay quiet: the numerics rule must flag the ``jnp.sum`` site
+(MPT020) and nothing else. Parsed by the linter tests, never imported.
+"""
+
+import jax.numpy as jnp
+
+from mpit_tpu.quant import dequantize_rows_jnp, quantize_rows_jnp
+
+
+def reduce_blocks(rows, mode):
+    codes, scales = quantize_rows_jnp(rows, mode)
+    deq = dequantize_rows_jnp(codes, scales, mode)
+    residual = rows - deq  # error feedback: the quantize is paired
+    # BUG: accumulates the wire codes, not the f32 reconstruction
+    total = jnp.sum(codes, axis=0)
+    return total, residual

@@ -1,0 +1,20 @@
+"""Seeded MPT021: a lossy push with no error-feedback fold.
+
+The delta is quantized and its codes reach the wire, but the residual
+``delta - dequantize(q)`` is never computed, so the compression error
+is dropped every round instead of being re-injected on the next push —
+a biased compressor on a training path. The numerics rule must flag the
+quantize site (MPT021) and nothing else; folding the residual (or an
+explicit ``# mpit-analysis: ef-off[...]`` marker) silences it. Parsed
+by the linter tests, never imported.
+"""
+
+from mpit_tpu.quant import quantize
+
+TAG_GRAD_PUSH = 32
+
+
+def push_update(transport, rank, delta):
+    # BUG: codes reach the wire, residual never folded into EF state
+    q = quantize(delta, "int8")
+    transport.send(rank, TAG_GRAD_PUSH, q)

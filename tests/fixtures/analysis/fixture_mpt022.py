@@ -1,0 +1,20 @@
+"""Seeded MPT022: codes dequantized with the wrong mode (and no scale).
+
+The rows are quantized as int8 (codes + per-row absmax scales) but the
+reconstruction declares bf16 — the int8 codes are reinterpreted as
+bf16 bit halves and the scales are dropped on the floor, so the
+"reconstruction" is numerically unrelated to the input. The quantize is
+paired (MPT021 quiet) and nothing reduces codes (MPT020 quiet): the
+numerics rule must flag the dequantize site (MPT022) and nothing else.
+Parsed by the linter tests, never imported.
+"""
+
+from mpit_tpu.quant import dequantize_rows_jnp, quantize_rows_jnp
+
+
+def roundtrip(rows):
+    codes, scales = quantize_rows_jnp(rows, "int8")
+    # BUG: int8 codes decoded as bf16, per-row scales dropped
+    deq = dequantize_rows_jnp(codes, None, "bf16")
+    residual = rows - deq
+    return residual, scales

@@ -115,6 +115,12 @@ def test_baseline_is_not_stale():
         ("fixture_mpt016", "MPT016"),
         ("fixture_mpt017.py", "MPT017"),
         ("fixture_mpt018.py", "MPT018"),
+        # numerics rules: the precision-dataflow model over a seeded
+        # codes-accumulation (MPT020), an unpaired lossy push (MPT021),
+        # and a mode/scale provenance mismatch (MPT022)
+        ("fixture_mpt020.py", "MPT020"),
+        ("fixture_mpt021.py", "MPT021"),
+        ("fixture_mpt022.py", "MPT022"),
     ],
 )
 def test_fixture_triggers_exactly_its_rule(fixture, rule):

@@ -241,6 +241,103 @@ class TestQuantization:
             PClient(Broker(2).transports()[1], [0], DIM, quant="fp4")
 
 
+class TestQuantHardening:
+    """The hardened-kernel contract (docs/ANALYSIS.md, RT104): on the
+    int8 faces, non-finite inputs and degenerate blocks must produce a
+    finite scale and finite codes — a NaN gradient element may poison
+    ITS lane's code (pinned to 0) but never the block scale, and an
+    all-zero or all-NaN block quantizes to zeros at scale 1 instead of
+    dividing by zero. bf16 represents NaN and passes it through bit-true
+    (RT104 reports it at the boundary instead of the kernel hiding it)."""
+
+    def test_all_nan_block_pins_scale_and_codes(self):
+        q = quantize(np.full(6, np.nan, np.float32), "int8")
+        assert q.scale == 1.0
+        np.testing.assert_array_equal(q.data, np.zeros(6, np.int8))
+        np.testing.assert_array_equal(dequantize(q), np.zeros(6))
+
+    def test_inf_sets_scale_from_finite_values_nan_lane_zeroed(self):
+        a = np.array([1.0, np.inf, -np.inf, np.nan], np.float32)
+        q = quantize(a, "int8")
+        # absmax over the FINITE values only: 1.0 -> scale 1/127
+        assert q.scale == pytest.approx(1.0 / 127.0)
+        # inf lanes saturate, the nan lane pins to 0
+        np.testing.assert_array_equal(
+            q.data, np.array([127, 127, -127, 0], np.int8)
+        )
+        out = dequantize(q)
+        assert np.isfinite(out).all()
+
+    def test_empty_chunk_roundtrips_on_both_layouts(self):
+        from mpit_tpu import quant as qk
+
+        q = quantize(np.zeros(0, np.float32), "int8")
+        assert q.scale == 1.0 and dequantize(q).shape == (0,)
+        codes, scales = qk.quantize_rows(
+            np.zeros((0, 4), np.float32), "int8"
+        )
+        assert codes.shape == (0, 4) and scales.shape == (0, 1)
+        assert qk.dequantize_rows(codes, scales, "int8").shape == (0, 4)
+
+    def test_rows_face_matches_per_row_scalar_on_poisoned_input(self):
+        from mpit_tpu import quant as qk
+
+        rng = np.random.default_rng(11)
+        a = rng.standard_normal((5, 32)).astype(np.float32)
+        a[0, 3] = np.nan
+        a[1, :] = np.nan  # all-NaN row
+        a[2, 7] = np.inf
+        a[3, :] = 0.0  # all-zero row
+        codes, scales = qk.quantize_rows(a, "int8")
+        for j in range(a.shape[0]):
+            host = quantize(a[j], "int8")
+            np.testing.assert_array_equal(codes[j], host.data)
+            assert np.float32(host.scale).tobytes() == (
+                scales[j].astype(np.float32).tobytes()
+            )
+        np.testing.assert_array_equal(
+            qk.dequantize_rows(codes, scales, "int8"),
+            np.stack([dequantize(quantize(a[j], "int8"))
+                      for j in range(a.shape[0])]),
+        )
+
+    def test_jnp_faces_match_host_on_poisoned_input(self):
+        from mpit_tpu import quant as qk
+
+        a = np.array(
+            [[1.0, np.inf, np.nan, -2.0],
+             [np.nan, np.nan, np.nan, np.nan],
+             [0.0, 0.0, 0.0, 0.0]],
+            np.float32,
+        )
+        codes, scale = qk.quantize_jnp(a.ravel(), "int8")
+        host = quantize(a.ravel(), "int8")
+        np.testing.assert_array_equal(np.asarray(codes), host.data)
+        assert np.isfinite(
+            np.asarray(qk.dequantize_jnp(codes, scale, "int8"))
+        ).all()
+        codes, scales = qk.quantize_rows_jnp(a, "int8")
+        h_codes, h_scales = qk.quantize_rows(a, "int8")
+        np.testing.assert_array_equal(np.asarray(codes), h_codes)
+        np.testing.assert_array_equal(
+            np.asarray(scales, np.float32), h_scales.astype(np.float32)
+        )
+
+    def test_bf16_preserves_nan_and_rt104_reports_it(self):
+        # bf16 REPRESENTS NaN, so the kernel passes it through bit-true
+        # (no silent zeroing that would hide the bug) — detection is the
+        # runtime sanitizer's job, at the quantize boundary
+        from mpit_tpu.analysis import runtime as rt
+
+        a = np.array([1.5, np.nan, -2.25], np.float32)
+        out = dequantize(quantize(a, "bf16"))
+        assert np.isnan(out[1])
+        assert out[0] == pytest.approx(1.5) and out[2] == pytest.approx(-2.25)
+        with rt.checking(numerics=True) as ck:
+            quantize(a, "bf16")
+        assert [f.rule for f in ck.findings] == ["RT104"]
+
+
 class TestHostDeviceKernelEquivalence:
     """The factored kernels (mpit_tpu.quant) must agree BIT-FOR-BIT
     between the numpy (wire) and jnp (collective) paths: the error-
